@@ -1,0 +1,40 @@
+"""spark_rapids_jni_tpu — TPU-native columnar backend for the RAPIDS
+Accelerator for Apache Spark.
+
+A ground-up re-design of the capability surface of ``spark-rapids-jni``
+(+ its pinned libcudf) for JAX/XLA/Pallas on TPU: Arrow-layout device
+buffers in HBM, the reference's packed row format (RowConversion.java:43-102)
+as compiled XLA computations, a null-aware columnar op library, and
+partition-exchange over ICI collectives instead of UCX/NCCL.
+
+Layer map (mirrors SURVEY.md §1, re-architected):
+  Java facade (java/)             — ai.rapids.cudf-compatible API
+  JNI/C ABI native runtime (src/) — handle registry, host row codec
+  Python runtime (this package)   — Column/Table pytrees + op library
+  XLA/Pallas kernels              — the compute path on TPU
+"""
+
+import os
+
+# Spark's data model is int64/float64-centric; enable 64-bit types unless the
+# embedder opts out. (TPU executes f64 via software emulation — ops that care
+# about throughput should cast to f32/bf16 explicitly.)
+if os.environ.get("SPARK_RAPIDS_TPU_DISABLE_X64", "0") != "1":
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+from . import dtype
+from .dtype import DType, TypeId
+from .column import Column, Table
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "dtype",
+    "DType",
+    "TypeId",
+    "Column",
+    "Table",
+    "__version__",
+]

@@ -1,0 +1,349 @@
+"""Column / Table: the device-resident columnar substrate.
+
+This is the TPU-native analog of cudf's ``column``/``table`` and the Java
+``ai.rapids.cudf.ColumnVector``/``Table`` that the reference binds to
+(reference: RowConversion.java:19-22 imports; ownership model
+RowConversionJni.cpp:31-37).
+
+TPU-first design decisions
+--------------------------
+* A column is a pair of ``jax.Array`` buffers in HBM: a data buffer and an
+  optional *boolean* validity mask. Arrow packs validity as 1 bit/value; on
+  TPU a bool vector is the fusable representation (XLA lowers selects/masks
+  on it directly), so bits are packed/unpacked only at host-interop and
+  row-format boundaries (``rows.py``, ``interop.py``).
+* ``Column`` and ``Table`` are registered pytrees: they flow through ``jit``,
+  ``shard_map`` and collectives like any other JAX value. This replaces the
+  reference's opaque ``long`` native handles (RowConversionJni.cpp:31) —
+  under XLA, the compiler owns buffer lifetime via donation, so the
+  handle-registry role is only needed at the foreign-language boundary
+  (see ``src/`` native runtime).
+* Strings use a padded byte-matrix layout: ``data`` is ``(n, pad_width)``
+  uint8 and ``lengths`` is ``(n,)`` int32. Static shapes keep XLA happy; the
+  pad width is a per-column compile-time constant (chosen at ingest).
+* Row counts are static Python ints (shape metadata), but *logical* row
+  counts after data-dependent ops (filter/join/groupby) can be device
+  scalars with padded buffers — see ``ops/`` two-phase patterns mirroring the
+  reference's two-phase 2GB batching (row_conversion.cu:505-511).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dt
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(eq=False)
+class Column:
+    """One column: HBM data buffer + optional validity mask (+ string lengths).
+
+    Invariants:
+      * fixed-width: ``data.shape == (n,)`` with ``data.dtype ==
+        dtype.device_dtype``.
+      * string: ``data.shape == (n, pad)`` uint8, ``lengths.shape == (n,)``
+        int32, bytes past ``lengths[i]`` are zero.
+      * ``validity`` is None (no nulls) or ``(n,)`` bool, True = valid —
+        matching Arrow/cudf polarity.
+    """
+
+    data: jax.Array
+    dtype: dt.DType
+    validity: Optional[jax.Array] = None
+    lengths: Optional[jax.Array] = None
+
+    # --- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.validity, self.lengths), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity, lengths = children
+        return cls(data=data, dtype=aux, validity=validity, lengths=lengths)
+
+    # --- basic properties -------------------------------------------------
+    @property
+    def row_count(self) -> int:
+        return int(self.data.shape[0])
+
+    def __len__(self) -> int:
+        return self.row_count
+
+    @property
+    def has_validity(self) -> bool:
+        return self.validity is not None
+
+    def null_count(self) -> int:
+        """Number of nulls (host sync)."""
+        if self.validity is None:
+            return 0
+        return int(self.row_count - jnp.count_nonzero(self.validity))
+
+    @property
+    def pad_width(self) -> int:
+        if not self.dtype.is_string:
+            raise TypeError("pad_width only applies to STRING columns")
+        return int(self.data.shape[1])
+
+    # --- construction -----------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        arr: np.ndarray,
+        validity: Optional[np.ndarray] = None,
+        dtype: Optional[dt.DType] = None,
+    ) -> "Column":
+        """Build a fixed-width column from host data (uploads to device).
+
+        ``dtype`` overrides inference — required for decimals (pass e.g.
+        ``dt.decimal32(-3)`` with an int32 array of unscaled values, the
+        representation the reference round-trips in RowConversionTest.java:37-38).
+        """
+        arr = np.asarray(arr)
+        if arr.ndim != 1:
+            raise ValueError("expected 1-D host array")
+        if dtype is None:
+            dtype = dt.from_numpy_dtype(arr.dtype)
+        if arr.dtype.kind in "Mm":
+            arr = arr.view(np.dtype(f"i{arr.dtype.itemsize}"))
+        dev = jnp.asarray(arr, dtype=dtype.device_dtype)
+        if dev.dtype != np.dtype(dtype.device_dtype):
+            # jax_enable_x64 is off (SPARK_RAPIDS_TPU_DISABLE_X64=1): jnp
+            # silently downgrades 64-bit dtypes, which would corrupt data
+            # while the DType metadata still claims 64 bits.
+            raise TypeError(
+                f"device buffer dtype {dev.dtype} != {dtype.device_dtype}; "
+                "64-bit types require jax_enable_x64 (unset "
+                "SPARK_RAPIDS_TPU_DISABLE_X64)"
+            )
+        valid = None
+        if validity is not None:
+            valid = jnp.asarray(np.asarray(validity, dtype=np.bool_))
+            if valid.shape != dev.shape:
+                raise ValueError("validity shape mismatch")
+        return Column(data=dev, dtype=dtype, validity=valid)
+
+    @staticmethod
+    def from_strings(
+        values: Sequence[Optional[Union[str, bytes]]],
+        pad_width: Optional[int] = None,
+    ) -> "Column":
+        """Build a STRING column (padded byte-matrix device layout)."""
+        # surrogateescape keeps arbitrary binary payloads lossless through the
+        # str representation (Arrow binary arrays also land here).
+        raw = [
+            v.encode("utf-8", "surrogateescape") if isinstance(v, str) else v
+            for v in values
+        ]
+        n = len(raw)
+        max_len = max((len(v) for v in raw if v is not None), default=0)
+        pad = pad_width if pad_width is not None else max(max_len, 1)
+        if max_len > pad:
+            raise ValueError(f"string of length {max_len} exceeds pad width {pad}")
+        mat = np.zeros((n, pad), dtype=np.uint8)
+        lens = np.zeros((n,), dtype=np.int32)
+        valid = np.ones((n,), dtype=np.bool_)
+        for i, v in enumerate(raw):
+            if v is None:
+                valid[i] = False
+                continue
+            mat[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
+            lens[i] = len(v)
+        return Column(
+            data=jnp.asarray(mat),
+            dtype=dt.STRING,
+            validity=None if valid.all() else jnp.asarray(valid),
+            lengths=jnp.asarray(lens),
+        )
+
+    # --- host readback ------------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        """Raw data buffer on host (nulls have unspecified payload)."""
+        arr = np.asarray(self.data)
+        if self.dtype.is_timestamp or self.dtype.is_duration:
+            unit = {
+                dt.TypeId.TIMESTAMP_DAYS: "D",
+                dt.TypeId.TIMESTAMP_SECONDS: "s",
+                dt.TypeId.TIMESTAMP_MILLISECONDS: "ms",
+                dt.TypeId.TIMESTAMP_MICROSECONDS: "us",
+                dt.TypeId.TIMESTAMP_NANOSECONDS: "ns",
+                dt.TypeId.DURATION_DAYS: "D",
+                dt.TypeId.DURATION_SECONDS: "s",
+                dt.TypeId.DURATION_MILLISECONDS: "ms",
+                dt.TypeId.DURATION_MICROSECONDS: "us",
+                dt.TypeId.DURATION_NANOSECONDS: "ns",
+            }[self.dtype.id]
+            kind = "M" if self.dtype.is_timestamp else "m"
+            # numpy datetime64/timedelta64 are 8-byte regardless of unit;
+            # widen our int32 day counts before the view.
+            return arr.astype(np.int64).view(np.dtype(f"{kind}8[{unit}]"))
+        return arr
+
+    def validity_to_numpy(self) -> np.ndarray:
+        if self.validity is None:
+            return np.ones((self.row_count,), dtype=np.bool_)
+        return np.asarray(self.validity)
+
+    def to_pylist(self) -> list:
+        """Python values with None for nulls (testing convenience)."""
+        valid = self.validity_to_numpy()
+        if self.dtype.is_string:
+            mat = np.asarray(self.data)
+            lens = np.asarray(self.lengths)
+            return [
+                bytes(mat[i, : lens[i]]).decode("utf-8", "surrogateescape")
+                if valid[i]
+                else None
+                for i in range(self.row_count)
+            ]
+        arr = self.to_numpy()
+        out = []
+        for i in range(self.row_count):
+            if not valid[i]:
+                out.append(None)
+            elif self.dtype.is_decimal:
+                out.append(int(arr[i]))
+            else:
+                out.append(arr[i].item())
+        return out
+
+    # --- misc ----------------------------------------------------------------
+    def with_validity(self, validity: Optional[jax.Array]) -> "Column":
+        return dataclasses.replace(self, validity=validity)
+
+    def merged_validity(self, *others: "Column") -> Optional[jax.Array]:
+        """AND of this column's validity with others' (null-propagation)."""
+        masks = [c.validity for c in (self, *others) if c.validity is not None]
+        if not masks:
+            return None
+        out = masks[0]
+        for m in masks[1:]:
+            out = jnp.logical_and(out, m)
+        return out
+
+
+@jax.tree_util.register_pytree_node_class
+class Table:
+    """An ordered collection of equal-length columns, optionally named.
+
+    The analog of ``cudf::table`` / ``ai.rapids.cudf.Table``
+    (reference: RowConversion.java:104 takes a Table; the JNI side views it
+    as a ``cudf::table_view`` at RowConversionJni.cpp:31).
+    """
+
+    def __init__(
+        self,
+        columns: Sequence[Column],
+        names: Optional[Sequence[str]] = None,
+    ):
+        columns = tuple(columns)
+        if columns:
+            n = columns[0].row_count
+            for c in columns[1:]:
+                if c.row_count != n:
+                    raise ValueError("column length mismatch")
+        if names is not None:
+            names = tuple(names)
+            if len(names) != len(columns):
+                raise ValueError("names/columns length mismatch")
+        self.columns = columns
+        self.names = names
+
+    # --- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        return self.columns, self.names
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.columns = tuple(children)
+        obj.names = aux
+        return obj
+
+    # --- accessors ---------------------------------------------------------
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def row_count(self) -> int:
+        return self.columns[0].row_count if self.columns else 0
+
+    def column(self, key: Union[int, str]) -> Column:
+        if isinstance(key, str):
+            if self.names is None:
+                raise KeyError("table has no column names")
+            key = self.names.index(key)
+        return self.columns[key]
+
+    def __getitem__(self, key) -> Column:
+        return self.column(key)
+
+    def dtypes(self) -> tuple[dt.DType, ...]:
+        return tuple(c.dtype for c in self.columns)
+
+    def schema_wire(self) -> tuple[list[int], list[int]]:
+        """(type ids, scales) — the JNI wire arrays of the reference
+        (RowConversion.java:116-122)."""
+        ids, scales = [], []
+        for c in self.columns:
+            i, s = c.dtype.to_wire()
+            ids.append(i)
+            scales.append(s)
+        return ids, scales
+
+    def select(self, keys: Sequence[Union[int, str]]) -> "Table":
+        cols = [self.column(k) for k in keys]
+        names = None
+        if self.names is not None:
+            names = [
+                k if isinstance(k, str) else self.names[k] for k in keys
+            ]
+        return Table(cols, names)
+
+    @staticmethod
+    def from_pydict(data: dict, dtypes: Optional[dict] = None) -> "Table":
+        """Host-side convenience constructor (numpy arrays or string lists)."""
+        cols, names = [], []
+        for name, values in data.items():
+            want = (dtypes or {}).get(name)
+            if want is not None and want.is_string:
+                cols.append(Column.from_strings(values))
+            elif (
+                isinstance(values, (list, tuple))
+                and values
+                and isinstance(values[0], (str, bytes, type(None)))
+                and any(isinstance(v, (str, bytes)) for v in values)
+            ):
+                cols.append(Column.from_strings(values))
+            else:
+                arr = np.asarray(values)
+                if arr.dtype == object:
+                    mask = np.array([v is not None for v in values])
+                    filled = np.array(
+                        [v if v is not None else 0 for v in values]
+                    )
+                    cols.append(Column.from_numpy(filled, mask, want))
+                else:
+                    cols.append(Column.from_numpy(arr, dtype=want))
+            names.append(name)
+        return Table(cols, names)
+
+    def to_pydict(self) -> dict:
+        if self.names is None:
+            names = [f"c{i}" for i in range(self.num_columns)]
+        else:
+            names = list(self.names)
+        return {n: c.to_pylist() for n, c in zip(names, self.columns)}
+
+    def __repr__(self) -> str:
+        parts = []
+        for i, c in enumerate(self.columns):
+            name = self.names[i] if self.names else f"c{i}"
+            parts.append(f"{name}: {c.dtype!r}[{c.row_count}]")
+        return f"Table({', '.join(parts)})"

@@ -1,0 +1,332 @@
+"""Columnar type system for the TPU-native Spark RAPIDS backend.
+
+Plays the role of cudf's ``data_type`` / the Java ``ai.rapids.cudf.DType``:
+a *type id* plus a decimal *scale*, which is exactly the wire format the
+reference marshals across the JNI boundary as two parallel int arrays
+(reference: spark-rapids-jni/src/main/cpp/src/RowConversionJni.cpp:56-61 and
+spark-rapids-jni/src/main/java/com/nvidia/spark/rapids/jni/RowConversion.java:113-124).
+
+TPU-first design notes
+----------------------
+* Fixed-width types map 1:1 onto jnp dtypes; decimals are *unscaled integers*
+  (int32/int64) carried with a scale, the same representation cudf uses.
+* BOOL8 is one byte in the packed row format (reference row format spec,
+  RowConversion.java:43-102) but lives as ``jnp.bool_`` on device so XLA can
+  fuse mask arithmetic; width bookkeeping here is about the *row wire format*.
+* TIMESTAMP_*/DURATION_* are int32/int64 ticks — no special device type.
+* STRING has no fixed width; string columns use a padded byte-matrix device
+  layout (see ``column.py``) and are rejected by the row transpose, matching
+  the reference's fixed-width-only gate (row_conversion.cu:514-516).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeId(enum.IntEnum):
+    """Stable numeric type ids.
+
+    Values mirror the native ids of the cudf 22.04 type enum that the
+    reference pins (pom.xml:88) and ships across JNI as
+    ``DType.getTypeId().getNativeId()`` (RowConversion.java:119).
+    """
+
+    EMPTY = 0
+    INT8 = 1
+    INT16 = 2
+    INT32 = 3
+    INT64 = 4
+    UINT8 = 5
+    UINT16 = 6
+    UINT32 = 7
+    UINT64 = 8
+    FLOAT32 = 9
+    FLOAT64 = 10
+    BOOL8 = 11
+    TIMESTAMP_DAYS = 12
+    TIMESTAMP_SECONDS = 13
+    TIMESTAMP_MILLISECONDS = 14
+    TIMESTAMP_MICROSECONDS = 15
+    TIMESTAMP_NANOSECONDS = 16
+    DURATION_DAYS = 17
+    DURATION_SECONDS = 18
+    DURATION_MILLISECONDS = 19
+    DURATION_MICROSECONDS = 20
+    DURATION_NANOSECONDS = 21
+    DICTIONARY32 = 22
+    STRING = 23
+    LIST = 24
+    DECIMAL32 = 25
+    DECIMAL64 = 26
+    DECIMAL128 = 27
+    STRUCT = 28
+
+
+# Row-format width in bytes for fixed-width types (the packed-row layout uses
+# these widths; reference layout computation row_conversion.cu:432-456).
+_WIDTHS = {
+    TypeId.INT8: 1,
+    TypeId.INT16: 2,
+    TypeId.INT32: 4,
+    TypeId.INT64: 8,
+    TypeId.UINT8: 1,
+    TypeId.UINT16: 2,
+    TypeId.UINT32: 4,
+    TypeId.UINT64: 8,
+    TypeId.FLOAT32: 4,
+    TypeId.FLOAT64: 8,
+    TypeId.BOOL8: 1,
+    TypeId.TIMESTAMP_DAYS: 4,
+    TypeId.TIMESTAMP_SECONDS: 8,
+    TypeId.TIMESTAMP_MILLISECONDS: 8,
+    TypeId.TIMESTAMP_MICROSECONDS: 8,
+    TypeId.TIMESTAMP_NANOSECONDS: 8,
+    TypeId.DURATION_DAYS: 4,
+    TypeId.DURATION_SECONDS: 8,
+    TypeId.DURATION_MILLISECONDS: 8,
+    TypeId.DURATION_MICROSECONDS: 8,
+    TypeId.DURATION_NANOSECONDS: 8,
+    TypeId.DICTIONARY32: 4,
+    TypeId.DECIMAL32: 4,
+    TypeId.DECIMAL64: 8,
+    TypeId.DECIMAL128: 16,
+}
+
+# Device (jnp) storage dtype for each fixed-width type id. Bool is stored as
+# jnp.bool_ on device; row packing widens it to one byte.
+_DEVICE_DTYPES = {
+    TypeId.INT8: jnp.int8,
+    TypeId.INT16: jnp.int16,
+    TypeId.INT32: jnp.int32,
+    TypeId.INT64: jnp.int64,
+    TypeId.UINT8: jnp.uint8,
+    TypeId.UINT16: jnp.uint16,
+    TypeId.UINT32: jnp.uint32,
+    TypeId.UINT64: jnp.uint64,
+    TypeId.FLOAT32: jnp.float32,
+    TypeId.FLOAT64: jnp.float64,
+    TypeId.BOOL8: jnp.bool_,
+    TypeId.TIMESTAMP_DAYS: jnp.int32,
+    TypeId.TIMESTAMP_SECONDS: jnp.int64,
+    TypeId.TIMESTAMP_MILLISECONDS: jnp.int64,
+    TypeId.TIMESTAMP_MICROSECONDS: jnp.int64,
+    TypeId.TIMESTAMP_NANOSECONDS: jnp.int64,
+    TypeId.DURATION_DAYS: jnp.int32,
+    TypeId.DURATION_SECONDS: jnp.int64,
+    TypeId.DURATION_MILLISECONDS: jnp.int64,
+    TypeId.DURATION_MICROSECONDS: jnp.int64,
+    TypeId.DURATION_NANOSECONDS: jnp.int64,
+    TypeId.DICTIONARY32: jnp.int32,
+    TypeId.DECIMAL32: jnp.int32,
+    TypeId.DECIMAL64: jnp.int64,
+}
+
+_SIGNED_INT_IDS = frozenset(
+    {TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64}
+)
+_UNSIGNED_INT_IDS = frozenset(
+    {TypeId.UINT8, TypeId.UINT16, TypeId.UINT32, TypeId.UINT64}
+)
+_FLOAT_IDS = frozenset({TypeId.FLOAT32, TypeId.FLOAT64})
+_DECIMAL_IDS = frozenset({TypeId.DECIMAL32, TypeId.DECIMAL64, TypeId.DECIMAL128})
+_TIMESTAMP_IDS = frozenset(
+    {
+        TypeId.TIMESTAMP_DAYS,
+        TypeId.TIMESTAMP_SECONDS,
+        TypeId.TIMESTAMP_MILLISECONDS,
+        TypeId.TIMESTAMP_MICROSECONDS,
+        TypeId.TIMESTAMP_NANOSECONDS,
+    }
+)
+_DURATION_IDS = frozenset(
+    {
+        TypeId.DURATION_DAYS,
+        TypeId.DURATION_SECONDS,
+        TypeId.DURATION_MILLISECONDS,
+        TypeId.DURATION_MICROSECONDS,
+        TypeId.DURATION_NANOSECONDS,
+    }
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DType:
+    """A columnar data type: (type id, decimal scale).
+
+    ``scale`` is only meaningful for DECIMAL32/64/128 and uses cudf's
+    convention: the stored integer x represents ``x * 10**scale`` (so the
+    reference test's decimal32 with scale -3 stores milli-units;
+    RowConversionTest.java:37-38).
+    """
+
+    id: TypeId
+    scale: int = 0
+
+    def __post_init__(self):
+        if self.scale != 0 and self.id not in _DECIMAL_IDS:
+            raise ValueError(f"non-zero scale on non-decimal type {self.id!r}")
+
+    # --- classification -------------------------------------------------
+    @property
+    def is_fixed_width(self) -> bool:
+        return self.id in _WIDTHS
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.id in _DECIMAL_IDS
+
+    @property
+    def is_integer(self) -> bool:
+        return self.id in _SIGNED_INT_IDS or self.id in _UNSIGNED_INT_IDS
+
+    @property
+    def is_floating(self) -> bool:
+        return self.id in _FLOAT_IDS
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_floating or self.is_decimal
+
+    @property
+    def is_boolean(self) -> bool:
+        return self.id == TypeId.BOOL8
+
+    @property
+    def is_timestamp(self) -> bool:
+        return self.id in _TIMESTAMP_IDS
+
+    @property
+    def is_duration(self) -> bool:
+        return self.id in _DURATION_IDS
+
+    @property
+    def is_string(self) -> bool:
+        return self.id == TypeId.STRING
+
+    # --- widths and device mapping -------------------------------------
+    @property
+    def itemsize(self) -> int:
+        """Width in bytes in the packed row format (cudf ``size_of``)."""
+        try:
+            return _WIDTHS[self.id]
+        except KeyError:
+            raise TypeError(f"{self.id!r} is not fixed-width") from None
+
+    @property
+    def device_dtype(self):
+        """The jnp dtype used for this column's device buffer."""
+        if self.id == TypeId.DECIMAL128:
+            raise TypeError("DECIMAL128 has no native device dtype on TPU")
+        try:
+            return _DEVICE_DTYPES[self.id]
+        except KeyError:
+            raise TypeError(f"{self.id!r} has no device dtype") from None
+
+    # --- wire format ----------------------------------------------------
+    def to_wire(self) -> tuple[int, int]:
+        """(native type id, scale) — the JNI int-array pair of the reference."""
+        return int(self.id), int(self.scale)
+
+    @staticmethod
+    def from_wire(type_id: int, scale: int = 0) -> "DType":
+        return DType(TypeId(type_id), scale)
+
+    def __repr__(self) -> str:
+        if self.is_decimal:
+            return f"DType({self.id.name}, scale={self.scale})"
+        return f"DType({self.id.name})"
+
+
+# Convenience singletons (the ai.rapids.cudf.DType static instances analog).
+INT8 = DType(TypeId.INT8)
+INT16 = DType(TypeId.INT16)
+INT32 = DType(TypeId.INT32)
+INT64 = DType(TypeId.INT64)
+UINT8 = DType(TypeId.UINT8)
+UINT16 = DType(TypeId.UINT16)
+UINT32 = DType(TypeId.UINT32)
+UINT64 = DType(TypeId.UINT64)
+FLOAT32 = DType(TypeId.FLOAT32)
+FLOAT64 = DType(TypeId.FLOAT64)
+BOOL8 = DType(TypeId.BOOL8)
+STRING = DType(TypeId.STRING)
+TIMESTAMP_DAYS = DType(TypeId.TIMESTAMP_DAYS)
+TIMESTAMP_SECONDS = DType(TypeId.TIMESTAMP_SECONDS)
+TIMESTAMP_MILLISECONDS = DType(TypeId.TIMESTAMP_MILLISECONDS)
+TIMESTAMP_MICROSECONDS = DType(TypeId.TIMESTAMP_MICROSECONDS)
+TIMESTAMP_NANOSECONDS = DType(TypeId.TIMESTAMP_NANOSECONDS)
+DURATION_DAYS = DType(TypeId.DURATION_DAYS)
+DURATION_SECONDS = DType(TypeId.DURATION_SECONDS)
+DURATION_MILLISECONDS = DType(TypeId.DURATION_MILLISECONDS)
+DURATION_MICROSECONDS = DType(TypeId.DURATION_MICROSECONDS)
+DURATION_NANOSECONDS = DType(TypeId.DURATION_NANOSECONDS)
+
+
+def decimal32(scale: int) -> DType:
+    return DType(TypeId.DECIMAL32, scale)
+
+
+def decimal64(scale: int) -> DType:
+    return DType(TypeId.DECIMAL64, scale)
+
+
+_NP_TO_TYPEID = {
+    np.dtype(np.int8): TypeId.INT8,
+    np.dtype(np.int16): TypeId.INT16,
+    np.dtype(np.int32): TypeId.INT32,
+    np.dtype(np.int64): TypeId.INT64,
+    np.dtype(np.uint8): TypeId.UINT8,
+    np.dtype(np.uint16): TypeId.UINT16,
+    np.dtype(np.uint32): TypeId.UINT32,
+    np.dtype(np.uint64): TypeId.UINT64,
+    np.dtype(np.float32): TypeId.FLOAT32,
+    np.dtype(np.float64): TypeId.FLOAT64,
+    np.dtype(np.bool_): TypeId.BOOL8,
+}
+
+
+def from_numpy_dtype(np_dtype, scale: int = 0) -> DType:
+    """Infer a DType from a numpy/jnp dtype (non-decimal, non-temporal)."""
+    np_dtype = np.dtype(np_dtype)
+    if np_dtype.kind == "M":  # datetime64
+        unit = np.datetime_data(np_dtype)[0]
+        return {
+            "D": TIMESTAMP_DAYS,
+            "s": TIMESTAMP_SECONDS,
+            "ms": TIMESTAMP_MILLISECONDS,
+            "us": TIMESTAMP_MICROSECONDS,
+            "ns": TIMESTAMP_NANOSECONDS,
+        }[unit]
+    if np_dtype.kind == "m":  # timedelta64
+        unit = np.datetime_data(np_dtype)[0]
+        return {
+            "D": DURATION_DAYS,
+            "s": DURATION_SECONDS,
+            "ms": DURATION_MILLISECONDS,
+            "us": DURATION_MICROSECONDS,
+            "ns": DURATION_NANOSECONDS,
+        }[unit]
+    try:
+        return DType(_NP_TO_TYPEID[np_dtype], scale)
+    except KeyError:
+        raise TypeError(f"unsupported numpy dtype {np_dtype}") from None
+
+
+def common_numeric_dtype(a: DType, b: DType) -> DType:
+    """Binary-op type promotion following numpy/cudf rules for plain numerics."""
+    if a.is_decimal or b.is_decimal:
+        # Decimal promotion: widest storage, max precision semantics are the
+        # caller's job; binary ops rescale explicitly (ops/binaryop.py).
+        wid = max(a.itemsize, b.itemsize)
+        scale = min(a.scale if a.is_decimal else 0, b.scale if b.is_decimal else 0)
+        return DType(TypeId.DECIMAL64 if wid >= 8 else TypeId.DECIMAL32, scale)
+    out = np.promote_types(
+        np.dtype(a.device_dtype), np.dtype(b.device_dtype)
+    )
+    return from_numpy_dtype(out)

@@ -1,0 +1,217 @@
+"""Host ⇄ device interop: Arrow is the wire/interop format.
+
+Plays the role the static Arrow build plays in the reference
+(CMakeLists.txt:90 includes arrow; CUDF_USE_ARROW_STATIC=ON at
+build-libcudf.xml:41): host data arrives as Arrow arrays/tables and becomes
+HBM-resident columns, and vice versa.
+
+Validity is 1 bit/value LSB-first in Arrow; on device we keep a bool vector
+(see column.py). Packing/unpacking happens here, vectorized on host with
+numpy (np.packbits/unpackbits with bitorder="little").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dt
+from .column import Column, Table
+
+try:  # pyarrow is optional at runtime; gate cleanly (environment contract).
+    import pyarrow as pa
+except ImportError:  # pragma: no cover
+    pa = None
+
+
+def _require_pyarrow():
+    if pa is None:  # pragma: no cover
+        raise ImportError("pyarrow is not available in this environment")
+
+
+# ---------------------------------------------------------------------------
+# Arrow validity bitmaps <-> bool vectors
+# ---------------------------------------------------------------------------
+
+def unpack_validity(bitmap: Optional[bytes], n: int, offset: int = 0) -> Optional[np.ndarray]:
+    """Arrow LSB-first validity bitmap -> (n,) bool array, or None if absent."""
+    if bitmap is None:
+        return None
+    bits = np.unpackbits(
+        np.frombuffer(bitmap, dtype=np.uint8), bitorder="little"
+    )
+    return bits[offset : offset + n].astype(np.bool_)
+
+
+def pack_validity(valid: np.ndarray) -> bytes:
+    """(n,) bool array -> Arrow LSB-first validity bitmap bytes."""
+    return np.packbits(valid.astype(np.uint8), bitorder="little").tobytes()
+
+
+# ---------------------------------------------------------------------------
+# pyarrow -> device
+# ---------------------------------------------------------------------------
+
+def _arrow_type_to_dtype(t) -> dt.DType:
+    _require_pyarrow()
+    if pa.types.is_int8(t):
+        return dt.INT8
+    if pa.types.is_int16(t):
+        return dt.INT16
+    if pa.types.is_int32(t):
+        return dt.INT32
+    if pa.types.is_int64(t):
+        return dt.INT64
+    if pa.types.is_uint8(t):
+        return dt.UINT8
+    if pa.types.is_uint16(t):
+        return dt.UINT16
+    if pa.types.is_uint32(t):
+        return dt.UINT32
+    if pa.types.is_uint64(t):
+        return dt.UINT64
+    if pa.types.is_float32(t):
+        return dt.FLOAT32
+    if pa.types.is_float64(t):
+        return dt.FLOAT64
+    if pa.types.is_boolean(t):
+        return dt.BOOL8
+    if pa.types.is_date32(t):
+        return dt.TIMESTAMP_DAYS
+    if pa.types.is_timestamp(t):
+        return {
+            "s": dt.TIMESTAMP_SECONDS,
+            "ms": dt.TIMESTAMP_MILLISECONDS,
+            "us": dt.TIMESTAMP_MICROSECONDS,
+            "ns": dt.TIMESTAMP_NANOSECONDS,
+        }[t.unit]
+    if pa.types.is_duration(t):
+        return {
+            "s": dt.DURATION_SECONDS,
+            "ms": dt.DURATION_MILLISECONDS,
+            "us": dt.DURATION_MICROSECONDS,
+            "ns": dt.DURATION_NANOSECONDS,
+        }[t.unit]
+    if pa.types.is_decimal(t):
+        # cudf maps precision<=9 -> DECIMAL32, <=18 -> DECIMAL64. Arrow scale
+        # is positive-right-of-point; cudf wire scale is its negation
+        # (RowConversionTest.java:37-38 uses negative scales).
+        if t.precision <= 9:
+            return dt.decimal32(-t.scale)
+        if t.precision <= 18:
+            return dt.decimal64(-t.scale)
+        raise TypeError("decimal precision > 18 (DECIMAL128) not yet supported")
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
+        return dt.STRING
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+def column_from_arrow(arr, pad_width: Optional[int] = None) -> Column:
+    """pyarrow Array/ChunkedArray -> device Column."""
+    _require_pyarrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dtype = _arrow_type_to_dtype(arr.type)
+
+    if dtype.is_string:
+        # from_strings accepts str/bytes/None directly (binary arrays arrive
+        # as bytes and stay lossless via surrogateescape).
+        return Column.from_strings(arr.to_pylist(), pad_width=pad_width)
+
+    n = len(arr)
+    valid_np = None
+    if arr.null_count:
+        valid_np = np.asarray(arr.is_valid())
+
+    if dtype.is_decimal:
+        # Arrow decimal128 stores 16-byte little-endian two's-complement
+        # unscaled ints. The precision<=18 gate guarantees values fit in the
+        # low signed 64 bits, so a vectorized view of the data buffer
+        # suffices (no per-row Python Decimal objects).
+        buf = arr.buffers()[1]
+        words = np.frombuffer(buf, dtype=np.int64)
+        lo = words[arr.offset * 2 : (arr.offset + n) * 2 : 2]
+        host = lo.astype(np.dtype(dtype.device_dtype))
+    elif dtype.is_boolean:
+        host = np.asarray(arr.fill_null(False))
+    else:
+        filler = 0
+        host = np.asarray(arr.fill_null(filler))
+        if host.dtype.kind in "Mm":
+            host = host.view(np.dtype(f"i{host.dtype.itemsize}"))
+
+    return Column(
+        data=jnp.asarray(host, dtype=dtype.device_dtype),
+        dtype=dtype,
+        validity=None if valid_np is None else jnp.asarray(valid_np),
+    )
+
+
+def table_from_arrow(tbl, pad_widths: Optional[dict] = None) -> Table:
+    """pyarrow Table -> device Table (names preserved)."""
+    _require_pyarrow()
+    cols = []
+    for name in tbl.column_names:
+        pw = (pad_widths or {}).get(name)
+        cols.append(column_from_arrow(tbl.column(name), pad_width=pw))
+    return Table(cols, tbl.column_names)
+
+
+# ---------------------------------------------------------------------------
+# device -> pyarrow
+# ---------------------------------------------------------------------------
+
+def column_to_arrow(col: Column):
+    """Device Column -> pyarrow Array (null payloads masked out)."""
+    _require_pyarrow()
+    valid = col.validity_to_numpy()
+    mask = ~valid  # pyarrow wants a null mask
+    if col.dtype.is_string:
+        vals = col.to_pylist()
+        try:
+            return pa.array(vals, type=pa.string())
+        except (UnicodeEncodeError, pa.ArrowInvalid):
+            # Non-UTF8 payload (ingested from an Arrow binary array):
+            # export as binary, losslessly undoing surrogateescape.
+            return pa.array(
+                [
+                    None if v is None else v.encode("utf-8", "surrogateescape")
+                    for v in vals
+                ],
+                type=pa.binary(),
+            )
+    arr = col.to_numpy()
+    if col.dtype.is_decimal:
+        scale = -col.dtype.scale
+        typ = pa.decimal128(18 if col.dtype.itemsize == 8 else 9, scale)
+        py = [
+            None if not valid[i] else int(arr[i])
+            for i in range(col.row_count)
+        ]
+        import decimal as _dec
+
+        return pa.array(
+            [
+                None if v is None else _dec.Decimal(v).scaleb(-scale)
+                for v in py
+            ],
+            type=typ,
+        )
+    if col.dtype.id == dt.TypeId.DURATION_DAYS:
+        # Arrow has no duration[D] unit; export as duration[s].
+        arr = arr.astype("timedelta64[s]")
+    return pa.array(arr, mask=mask if mask.any() else None)
+
+
+def table_to_arrow(tbl: Table):
+    _require_pyarrow()
+    names = (
+        list(tbl.names)
+        if tbl.names is not None
+        else [f"c{i}" for i in range(tbl.num_columns)]
+    )
+    return pa.table(
+        {n: column_to_arrow(c) for n, c in zip(names, tbl.columns)}
+    )

@@ -1,0 +1,222 @@
+"""Phase-0 tests: DType wire format, Column/Table pytrees, Arrow interop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu as srt
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import interop
+from spark_rapids_jni_tpu.column import Column, Table
+
+
+class TestDType:
+    def test_wire_roundtrip(self):
+        for d in [dt.INT64, dt.FLOAT32, dt.BOOL8, dt.decimal32(-3), dt.decimal64(-8)]:
+            tid, scale = d.to_wire()
+            assert dt.DType.from_wire(tid, scale) == d
+
+    def test_widths(self):
+        assert dt.INT8.itemsize == 1
+        assert dt.INT64.itemsize == 8
+        assert dt.BOOL8.itemsize == 1
+        assert dt.decimal32(-3).itemsize == 4
+        assert dt.decimal64(-8).itemsize == 8
+        assert dt.TIMESTAMP_DAYS.itemsize == 4
+
+    def test_decimal_scale_gate(self):
+        with pytest.raises(ValueError):
+            dt.DType(dt.TypeId.INT32, scale=-2)
+
+    def test_string_not_fixed_width(self):
+        assert not dt.STRING.is_fixed_width
+        with pytest.raises(TypeError):
+            dt.STRING.itemsize
+
+
+class TestColumn:
+    def test_fixed_width_roundtrip(self, rng):
+        arr = rng.integers(-100, 100, 1000, dtype=np.int64)
+        col = Column.from_numpy(arr)
+        assert col.dtype == dt.INT64
+        assert col.row_count == 1000
+        np.testing.assert_array_equal(col.to_numpy(), arr)
+
+    def test_validity(self, rng):
+        arr = rng.standard_normal(64).astype(np.float32)
+        valid = rng.random(64) > 0.3
+        col = Column.from_numpy(arr, validity=valid)
+        assert col.null_count() == int((~valid).sum())
+        got = col.to_pylist()
+        for i in range(64):
+            if valid[i]:
+                assert got[i] == pytest.approx(float(arr[i]))
+            else:
+                assert got[i] is None
+
+    def test_decimal(self):
+        col = Column.from_numpy(
+            np.array([1234, -5678, 0], dtype=np.int32), dtype=dt.decimal32(-3)
+        )
+        assert col.dtype.scale == -3
+        assert col.to_pylist() == [1234, -5678, 0]
+
+    def test_strings(self):
+        col = Column.from_strings(["spark", None, "", "rapids-tpu"])
+        assert col.dtype.is_string
+        assert col.to_pylist() == ["spark", None, "", "rapids-tpu"]
+        assert col.null_count() == 1
+
+    def test_pytree(self, rng):
+        arr = rng.integers(0, 10, 128, dtype=np.int32)
+        valid = rng.random(128) > 0.5
+        col = Column.from_numpy(arr, validity=valid)
+        leaves, treedef = jax.tree_util.tree_flatten(col)
+        col2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert col2.dtype == col.dtype
+        np.testing.assert_array_equal(col2.to_numpy(), arr)
+
+    def test_jit_through(self, rng):
+        col = Column.from_numpy(rng.integers(0, 10, 64, dtype=np.int64))
+
+        @jax.jit
+        def double(c: Column) -> Column:
+            return Column(data=c.data * 2, dtype=c.dtype, validity=c.validity)
+
+        out = double(col)
+        np.testing.assert_array_equal(out.to_numpy(), col.to_numpy() * 2)
+
+    def test_timestamps(self):
+        ts = np.array(["2026-01-01", "2026-07-29"], dtype="datetime64[D]")
+        col = Column.from_numpy(ts)
+        assert col.dtype == dt.TIMESTAMP_DAYS
+        np.testing.assert_array_equal(col.to_numpy(), ts)
+
+
+class TestTable:
+    def test_basic(self, rng):
+        t = Table.from_pydict(
+            {
+                "a": rng.integers(0, 5, 100, dtype=np.int64),
+                "b": rng.standard_normal(100),
+                "s": ["x", "yy", None, "zzz"] * 25,
+            }
+        )
+        assert t.num_columns == 3
+        assert t.row_count == 100
+        assert t["a"].dtype == dt.INT64
+        assert t["s"].dtype.is_string
+        assert t.select(["b", "a"]).names == ("b", "a")
+
+    def test_schema_wire(self):
+        t = Table.from_pydict(
+            {"a": np.array([1], dtype=np.int64)},
+            dtypes=None,
+        )
+        ids, scales = t.schema_wire()
+        assert ids == [int(dt.TypeId.INT64)]
+        assert scales == [0]
+
+    def test_length_mismatch(self):
+        with pytest.raises(ValueError):
+            Table(
+                [
+                    Column.from_numpy(np.arange(3)),
+                    Column.from_numpy(np.arange(4)),
+                ]
+            )
+
+    def test_pytree_through_jit(self, rng):
+        t = Table.from_pydict(
+            {"a": rng.integers(0, 5, 32, dtype=np.int64), "b": rng.standard_normal(32)}
+        )
+
+        @jax.jit
+        def addone(tbl: Table) -> Table:
+            cols = [
+                Column(c.data + 1, c.dtype, c.validity) for c in tbl.columns
+            ]
+            return Table(cols, tbl.names)
+
+        out = addone(t)
+        np.testing.assert_array_equal(out["a"].to_numpy(), t["a"].to_numpy() + 1)
+        assert out.names == t.names
+
+
+class TestArrowInterop:
+    def test_roundtrip_numeric_with_nulls(self, rng):
+        pa = pytest.importorskip("pyarrow")
+        arr = pa.array([1, None, 3, 4, None], type=pa.int64())
+        col = interop.column_from_arrow(arr)
+        assert col.null_count() == 2
+        back = interop.column_to_arrow(col)
+        assert back.to_pylist() == arr.to_pylist()
+
+    def test_validity_bit_packing(self, rng):
+        valid = rng.random(77) > 0.5
+        packed = interop.pack_validity(valid)
+        unpacked = interop.unpack_validity(packed, 77)
+        np.testing.assert_array_equal(unpacked, valid)
+
+    def test_table_roundtrip(self):
+        pa = pytest.importorskip("pyarrow")
+        tbl = pa.table(
+            {
+                "i": pa.array([1, 2, None], type=pa.int32()),
+                "f": pa.array([1.5, None, 3.5], type=pa.float64()),
+                "b": pa.array([True, False, None]),
+                "s": pa.array(["a", None, "ccc"]),
+            }
+        )
+        dev = interop.table_from_arrow(tbl)
+        assert dev.row_count == 3
+        back = interop.table_to_arrow(dev)
+        assert back.to_pydict() == tbl.to_pydict()
+
+    def test_decimal_roundtrip(self):
+        pa = pytest.importorskip("pyarrow")
+        import decimal
+
+        arr = pa.array(
+            [decimal.Decimal("1.234"), None, decimal.Decimal("-9.876")],
+            type=pa.decimal128(9, 3),
+        )
+        col = interop.column_from_arrow(arr)
+        assert col.dtype == dt.decimal32(-3)
+        assert col.to_pylist() == [1234, None, -9876]
+        back = interop.column_to_arrow(col)
+        assert back.to_pylist() == arr.to_pylist()
+
+
+class TestReviewRegressions:
+    """Regressions from the phase-0 code review."""
+
+    def test_binary_payload_lossless(self):
+        pa = pytest.importorskip("pyarrow")
+        arr = pa.array([b"\xff\x00ab", None], type=pa.binary())
+        col = interop.column_from_arrow(arr)
+        back = interop.column_to_arrow(col)
+        assert back.to_pylist() == [b"\xff\x00ab", None]
+
+    def test_sliced_decimal_ingest(self):
+        pa = pytest.importorskip("pyarrow")
+        import decimal
+
+        arr = pa.array(
+            [decimal.Decimal("1.234"), None, decimal.Decimal("-9.876")],
+            type=pa.decimal128(9, 3),
+        ).slice(1, 2)
+        col = interop.column_from_arrow(arr)
+        assert col.to_pylist() == [None, -9876]
+
+    def test_duration_days_export(self):
+        pa = pytest.importorskip("pyarrow")
+        col = Column.from_numpy(np.array([1, 2], dtype="timedelta64[D]"))
+        out = interop.column_to_arrow(col)
+        assert out.to_pylist()[0].days == 1
+
+    def test_column_eq_does_not_raise(self):
+        a = Column.from_numpy(np.arange(5))
+        b = Column.from_numpy(np.arange(5))
+        assert (a == b) is False  # identity comparison, not elementwise
