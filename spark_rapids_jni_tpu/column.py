@@ -39,6 +39,27 @@ import numpy as np
 from . import dtype as dt
 
 
+def encode_storage(arr: np.ndarray, dtype: dt.DType) -> jax.Array:
+    """Upload a host array as a column storage buffer.
+
+    Single place for the FLOAT64 bit-view rule (DType.storage_dtype) and
+    the x64-downgrade guard, shared by Column.from_numpy and interop.
+    """
+    if dtype.id == dt.TypeId.FLOAT64:
+        arr = np.ascontiguousarray(arr, dtype=np.float64).view(np.uint64)
+    dev = jnp.asarray(arr, dtype=dtype.storage_dtype)
+    if dev.dtype != np.dtype(dtype.storage_dtype):
+        # jax_enable_x64 is off (SPARK_RAPIDS_TPU_DISABLE_X64=1): jnp
+        # silently downgrades 64-bit dtypes, which would corrupt data
+        # while the DType metadata still claims 64 bits.
+        raise TypeError(
+            f"device buffer dtype {dev.dtype} != {dtype.storage_dtype}; "
+            "64-bit types require jax_enable_x64 (unset "
+            "SPARK_RAPIDS_TPU_DISABLE_X64)"
+        )
+    return dev
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(eq=False)
 class Column:
@@ -46,7 +67,8 @@ class Column:
 
     Invariants:
       * fixed-width: ``data.shape == (n,)`` with ``data.dtype ==
-        dtype.device_dtype``.
+        dtype.storage_dtype`` (FLOAT64 stores IEEE-754 bits as uint64 —
+        see DType.storage_dtype for why).
       * string: ``data.shape == (n, pad)`` uint8, ``lengths.shape == (n,)``
         int32, bytes past ``lengths[i]`` are zero.
       * ``validity`` is None (no nulls) or ``(n,)`` bool, True = valid —
@@ -111,16 +133,7 @@ class Column:
             dtype = dt.from_numpy_dtype(arr.dtype)
         if arr.dtype.kind in "Mm":
             arr = arr.view(np.dtype(f"i{arr.dtype.itemsize}"))
-        dev = jnp.asarray(arr, dtype=dtype.device_dtype)
-        if dev.dtype != np.dtype(dtype.device_dtype):
-            # jax_enable_x64 is off (SPARK_RAPIDS_TPU_DISABLE_X64=1): jnp
-            # silently downgrades 64-bit dtypes, which would corrupt data
-            # while the DType metadata still claims 64 bits.
-            raise TypeError(
-                f"device buffer dtype {dev.dtype} != {dtype.device_dtype}; "
-                "64-bit types require jax_enable_x64 (unset "
-                "SPARK_RAPIDS_TPU_DISABLE_X64)"
-            )
+        dev = encode_storage(arr, dtype)
         valid = None
         if validity is not None:
             valid = jnp.asarray(np.asarray(validity, dtype=np.bool_))
@@ -165,6 +178,8 @@ class Column:
     def to_numpy(self) -> np.ndarray:
         """Raw data buffer on host (nulls have unspecified payload)."""
         arr = np.asarray(self.data)
+        if self.dtype.id == dt.TypeId.FLOAT64:
+            return arr.view(np.float64)
         if self.dtype.is_timestamp or self.dtype.is_duration:
             unit = {
                 dt.TypeId.TIMESTAMP_DAYS: "D",

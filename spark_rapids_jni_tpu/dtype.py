@@ -220,13 +220,30 @@ class DType:
 
     @property
     def device_dtype(self):
-        """The jnp dtype used for this column's device buffer."""
+        """The *logical* jnp dtype of this column's values."""
         if self.id == TypeId.DECIMAL128:
             raise TypeError("DECIMAL128 has no native device dtype on TPU")
         try:
             return _DEVICE_DTYPES[self.id]
         except KeyError:
             raise TypeError(f"{self.id!r} has no device dtype") from None
+
+    @property
+    def storage_dtype(self):
+        """The jnp dtype of the HBM buffer backing this column.
+
+        Equal to ``device_dtype`` except FLOAT64: TPU's f64 is a
+        double-float emulation with an f32 exponent range and ~48-bit
+        mantissa — ordinary doubles (1.1, 0.1, 1e300) do not even survive
+        an HBM upload round trip. A SQL engine cannot corrupt every DOUBLE
+        at ingest, so FLOAT64 columns store the IEEE-754 bit pattern as
+        uint64 (exact on every backend); compute ops decode to the device
+        float envelope on demand (ops/compute.py) and sorts/comparisons use
+        the order-preserving bit trick instead of decoding.
+        """
+        if self.id == TypeId.FLOAT64:
+            return jnp.uint64
+        return self.device_dtype
 
     # --- wire format ----------------------------------------------------
     def to_wire(self) -> tuple[int, int]:

@@ -142,8 +142,10 @@ def column_from_arrow(arr, pad_width: Optional[int] = None) -> Column:
         if host.dtype.kind in "Mm":
             host = host.view(np.dtype(f"i{host.dtype.itemsize}"))
 
+    from .column import encode_storage
+
     return Column(
-        data=jnp.asarray(host, dtype=dtype.device_dtype),
+        data=encode_storage(host, dtype),
         dtype=dtype,
         validity=None if valid_np is None else jnp.asarray(valid_np),
     )

@@ -1,0 +1,348 @@
+"""Row ⇄ columnar transpose: the reference's core Spark-specific kernel.
+
+Re-implements, byte-for-byte, the packed row format of
+``spark-rapids-jni`` (spec: RowConversion.java:43-102; layout computation:
+row_conversion.cu:432-456) as compiled XLA computations:
+
+* Each fixed-width column is placed at ``align_offset(cursor, width)``.
+* Validity is 1 bit per column, bytes **appended** after the last column
+  value, 1 byte per 8 columns, LSB-first (row_conversion.cu:448-453).
+* The row is padded to a 64-bit multiple so consecutive rows stay aligned
+  (row_conversion.cu:454-455).
+* A single packed output caps at INT_MAX bytes, so tables split into
+  batches of ``(INT_MAX / row_size) / 32 * 32`` rows — multiples of 32 so
+  validity words never straddle batches (row_conversion.cu:476-479).
+* Only fixed-width types are supported, mirroring the reference's gate
+  (row_conversion.cu:514-516 / :572-574).
+
+TPU-first design
+----------------
+The CUDA implementation tiles through 48 KB shared memory with warp
+ballots and byte atomics (row_conversion.cu:48-304). None of that
+translates: on TPU the whole transpose is expressed as a fused gather of
+byte-cast column buffers into an ``(n, row_size)`` uint8 matrix —
+``lax.bitcast_convert_type`` + static-slice writes — which XLA fuses into
+a single HBM-bandwidth-bound kernel; a Pallas kernel variant
+(kernels/row_transpose.py) tiles it explicitly through VMEM for large
+row counts. Validity bit packing is a vectorized (n, 8)·(powers of two)
+matmul instead of warp ballots (SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dt
+from .column import Column, Table
+
+INT_MAX = 2**31 - 1
+
+
+def align_offset(offset: int, alignment: int) -> int:
+    """Round ``offset`` up to ``alignment`` (row_conversion.cu:417-419)."""
+    return (offset + alignment - 1) & ~(alignment - 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class RowLayout:
+    """Byte layout of one packed row for a fixed-width schema.
+
+    Mirrors ``compute_fixed_width_layout`` (row_conversion.cu:432-456).
+    """
+
+    dtypes: tuple[dt.DType, ...]
+    column_offsets: tuple[int, ...]
+    column_widths: tuple[int, ...]
+    validity_offset: int
+    validity_bytes: int
+    row_size: int
+
+
+def compute_fixed_width_layout(dtypes: Sequence[dt.DType]) -> RowLayout:
+    dtypes = tuple(dtypes)
+    if not dtypes:
+        raise TypeError("row format requires at least one column")
+    for d in dtypes:
+        if not d.is_fixed_width:
+            raise TypeError(
+                f"only fixed-width types supported in row format, got {d!r}"
+            )
+    offsets, widths = [], []
+    cursor = 0
+    for d in dtypes:
+        w = d.itemsize
+        cursor = align_offset(cursor, w)
+        offsets.append(cursor)
+        widths.append(w)
+        cursor += w
+    validity_offset = cursor
+    validity_bytes = (len(dtypes) + 7) // 8
+    cursor += validity_bytes
+    # Pad to 64-bit multiple so rows stay aligned back to back
+    # (row_conversion.cu:454-455).
+    row_size = align_offset(cursor, 8)
+    return RowLayout(
+        dtypes=dtypes,
+        column_offsets=tuple(offsets),
+        column_widths=tuple(widths),
+        validity_offset=validity_offset,
+        validity_bytes=validity_bytes,
+        row_size=row_size,
+    )
+
+
+def max_rows_per_batch(row_size: int) -> int:
+    """2 GB split granularity (row_conversion.cu:476-479)."""
+    if row_size * 32 > INT_MAX:
+        raise ValueError("row size too large: 32 rows exceed INT_MAX bytes")
+    return (INT_MAX // row_size) // 32 * 32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(eq=False)
+class PackedRows:
+    """One batch of packed rows: an (n, row_size) uint8 device matrix.
+
+    This is the LIST<INT8> column of the reference flattened: the offsets
+    child is implicit (an arithmetic sequence 0, row_size, 2*row_size, …,
+    exactly what cudf::detail::sequence builds at row_conversion.cu:389-390),
+    so we don't materialize it on device; ``offsets()`` reconstructs it for
+    interop/JNI export.
+    """
+
+    data: jax.Array  # (n, row_size) uint8
+    layout: RowLayout
+
+    def tree_flatten(self):
+        return (self.data,), self.layout
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(data=children[0], layout=aux)
+
+    @property
+    def row_count(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def row_size(self) -> int:
+        return int(self.data.shape[1])
+
+    def offsets(self) -> np.ndarray:
+        """int32 offsets of the LIST<INT8> representation.
+
+        Raises if the batch exceeds INT_MAX bytes (possible via the
+        ``split=False`` / ``batch_rows`` escape hatches) — the reference
+        enforces the same cap with an assert (row_conversion.cu:384-386).
+        """
+        n = self.row_count
+        total = n * self.row_size
+        if total > INT_MAX:
+            raise ValueError(
+                f"batch of {total} bytes exceeds INT_MAX; re-pack with "
+                "to_rows(split=True)"
+            )
+        return (np.arange(n + 1, dtype=np.int64) * self.row_size).astype(
+            np.int32
+        )
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+
+# ---------------------------------------------------------------------------
+# columnar -> rows
+# ---------------------------------------------------------------------------
+
+def _column_bytes(col: Column) -> jax.Array:
+    """(n, width) uint8 little-endian view of a fixed-width column."""
+    data = col.data
+    if col.dtype.is_boolean:
+        # BOOL8 is one byte in the row format.
+        return data.astype(jnp.uint8)[:, None]
+    b = jax.lax.bitcast_convert_type(data, jnp.uint8)
+    if b.ndim == 1:  # 1-byte dtypes keep their shape
+        b = b[:, None]
+    return b
+
+
+_BIT_WEIGHTS = np.array([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.uint8)
+
+
+def _pack_validity_bytes(valid: jax.Array, num_cols: int) -> jax.Array:
+    """(n, num_cols) bool -> (n, vbytes) uint8, LSB-first within each byte.
+
+    The vectorized-masked-reduction replacement for the reference's warp
+    ballots / byte atomics (row_conversion.cu:158-165, :255-272).
+    """
+    n = valid.shape[0]
+    vbytes = (num_cols + 7) // 8
+    padded = jnp.zeros((n, vbytes * 8), dtype=jnp.uint8)
+    padded = padded.at[:, :num_cols].set(valid.astype(jnp.uint8))
+    groups = padded.reshape(n, vbytes, 8)
+    weights = jnp.asarray(_BIT_WEIGHTS)
+    return jnp.sum(groups * weights[None, None, :], axis=-1, dtype=jnp.uint8)
+
+
+def _unpack_validity_bytes(vb: jax.Array, num_cols: int) -> jax.Array:
+    """(n, vbytes) uint8 -> (n, num_cols) bool, LSB-first."""
+    n = vb.shape[0]
+    weights = jnp.asarray(_BIT_WEIGHTS)
+    bits = (vb[:, :, None] & weights[None, None, :]) != 0
+    return bits.reshape(n, -1)[:, :num_cols]
+
+
+def _pack_batch(columns: Sequence[Column], layout: RowLayout) -> jax.Array:
+    """Jittable core: pack equal-length columns into (n, row_size) uint8."""
+    n = columns[0].data.shape[0]
+    out = jnp.zeros((n, layout.row_size), dtype=jnp.uint8)
+    for col, off, w in zip(
+        columns, layout.column_offsets, layout.column_widths
+    ):
+        out = out.at[:, off : off + w].set(_column_bytes(col))
+    valid = jnp.stack(
+        [
+            c.validity
+            if c.validity is not None
+            else jnp.ones((n,), dtype=jnp.bool_)
+            for c in columns
+        ],
+        axis=1,
+    )
+    vb = _pack_validity_bytes(valid, len(columns))
+    out = out.at[
+        :, layout.validity_offset : layout.validity_offset + layout.validity_bytes
+    ].set(vb)
+    return out
+
+
+_pack_batch_jit = jax.jit(_pack_batch, static_argnames="layout")
+
+
+def to_rows(
+    table: Table, split: bool = True, batch_rows: Optional[int] = None
+) -> list[PackedRows]:
+    """Columnar -> packed rows (``convert_to_rows``, row_conversion.cu:458-517).
+
+    Returns one ``PackedRows`` per 2 GB batch, mirroring the reference's
+    ``ColumnVector[]`` return (RowConversion.java:104-111). ``batch_rows``
+    overrides the INT_MAX-derived split size (testing / memory tuning); it
+    is clamped to a multiple of 32 like the reference.
+    """
+    layout = compute_fixed_width_layout(table.dtypes())
+    n = table.row_count
+    if batch_rows is not None:
+        batch = max(batch_rows // 32 * 32, 32)
+    elif split:
+        batch = max_rows_per_batch(layout.row_size)
+    else:
+        batch = max(n, 1)
+    out = []
+    start = 0
+    while True:
+        stop = min(start + batch, n)
+        cols = [
+            Column(
+                c.data[start:stop],
+                c.dtype,
+                None if c.validity is None else c.validity[start:stop],
+            )
+            for c in table.columns
+        ]
+        out.append(PackedRows(_pack_batch_jit(cols, layout), layout))
+        start = stop
+        if start >= n:
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rows -> columnar
+# ---------------------------------------------------------------------------
+
+def _unpack_batch(
+    data: jax.Array, layout: RowLayout
+) -> tuple[list[jax.Array], jax.Array]:
+    """Jittable core: (n, row_size) uint8 -> per-column data + validity."""
+    cols = []
+    for d, off, w in zip(
+        layout.dtypes, layout.column_offsets, layout.column_widths
+    ):
+        raw = data[:, off : off + w]
+        if d.is_boolean:
+            cols.append(raw[:, 0] != 0)
+        else:
+            target = np.dtype(d.storage_dtype)
+            if target.itemsize == 1:
+                cols.append(jax.lax.bitcast_convert_type(raw[:, 0], target))
+            else:
+                cols.append(jax.lax.bitcast_convert_type(raw, target))
+    vb = data[
+        :, layout.validity_offset : layout.validity_offset + layout.validity_bytes
+    ]
+    valid = _unpack_validity_bytes(vb, len(layout.dtypes))
+    return cols, valid
+
+
+_unpack_batch_jit = jax.jit(_unpack_batch, static_argnames="layout")
+
+
+def from_rows(
+    packed: Sequence[PackedRows] | PackedRows,
+    dtypes: Optional[Sequence[dt.DType]] = None,
+    names: Optional[Sequence[str]] = None,
+) -> Table:
+    """Packed rows -> columnar (``convert_from_rows``, row_conversion.cu:519-575).
+
+    ``dtypes`` is the schema the caller asserts — the (type id, scale) wire
+    arrays of the reference JNI (RowConversionJni.cpp:56-61). Defaults to the
+    layout's recorded schema.
+    """
+    if isinstance(packed, PackedRows):
+        packed = [packed]
+    if not packed:
+        raise ValueError("no row batches")
+    layout = packed[0].layout
+    if dtypes is not None:
+        want = compute_fixed_width_layout(dtypes)
+        if want.row_size != layout.row_size or want.column_offsets != layout.column_offsets:
+            raise ValueError(
+                "schema layout does not match the packed row size "
+                f"({want.row_size} != {layout.row_size})"
+            )
+        layout = want
+
+    parts = [_unpack_batch_jit(p.data, layout) for p in packed]
+    columns = []
+    for i, d in enumerate(layout.dtypes):
+        data = jnp.concatenate([p[0][i] for p in parts]) if len(parts) > 1 else parts[0][0][i]
+        valid = jnp.concatenate([p[1][:, i] for p in parts]) if len(parts) > 1 else parts[0][1][:, i]
+        # Preserve the validity=None invariant for null-free columns so
+        # downstream ops keep their no-nulls fast path (one fused device
+        # reduction; from_rows is an eager API, the sync is fine here).
+        if bool(jnp.all(valid)):
+            valid = None
+        columns.append(Column(data=data, dtype=d, validity=valid))
+    return Table(columns, names)
+
+
+def packed_rows_from_numpy(
+    data: np.ndarray, dtypes: Sequence[dt.DType]
+) -> PackedRows:
+    """Wrap host row bytes (n, row_size) as a device PackedRows batch."""
+    layout = compute_fixed_width_layout(dtypes)
+    data = np.asarray(data, dtype=np.uint8)
+    if data.ndim == 1:
+        if data.size % layout.row_size:
+            raise ValueError("flat row buffer not a multiple of row_size")
+        data = data.reshape(-1, layout.row_size)
+    if data.shape[1] != layout.row_size:
+        raise ValueError(
+            f"row width {data.shape[1]} != layout row_size {layout.row_size}"
+        )
+    return PackedRows(jnp.asarray(data), layout)

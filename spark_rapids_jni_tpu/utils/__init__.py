@@ -1,0 +1,1 @@
+"""Shared utilities (IEEE-754 codecs, native-library loading, profiling)."""

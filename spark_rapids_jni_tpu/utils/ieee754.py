@@ -1,0 +1,132 @@
+"""Exact float64 ⇄ IEEE-754 bit-pattern codec in pure arithmetic.
+
+Why this exists: TPU XLA emulates f64 arithmetic exactly (verified: 1+2^-52
+round-trips) but its X64 legalizer cannot lower ``bitcast_convert`` involving
+f64 (nor frexp/ldexp/signbit, which use bitcasts internally). The packed row
+format (rows.py) needs the raw 8 bytes of each FLOAT64 value, so we compute
+the bit pattern with operations the TPU does support: compares, gathers from
+a constant power-of-two table, exact power-of-two multiplies/divides, and
+u64 integer arithmetic (legalized to u32 pairs).
+
+Exactness argument:
+* The biased exponent comes from ``searchsorted`` over the 2^e table —
+  pure comparisons, no rounding.
+* ``|x| / 2^e`` for 2^e a representable power of two is exact (mantissa
+  unchanged), giving m in [1,2); ``(m-1)*2^52`` is an exact <=52-bit
+  integer, and f64→u64 value conversion is exact for it.
+Contract (the envelope where this codec is used — compute-path decode/
+encode; FLOAT64 *storage* is exact uint64 bits and never passes through
+here, see DType.storage_dtype):
+* Exact for normals, zeros and infinities.
+* f64 subnormals flush to zero: XLA compiles with DAZ/FTZ, so arithmetic
+  can never observe a subnormal payload on any backend — and on TPU the
+  f64 emulation can't represent them anyway.
+* NaNs are canonicalized (quiet bit, zero payload, positive sign) — a
+  divergence from the reference's raw ``memcpy`` semantics
+  (row_conversion.cu:217-254), observationally equivalent under Spark,
+  which canonicalizes NaN itself.
+
+``float_to_bits``/``bits_to_float`` dispatch to a plain bitcast on the CPU
+backend (exact for everything, including subnormal payloads) and to this
+arithmetic codec on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# 2^e for e in [-1022, 1023]: every normal binade boundary, exact in f64.
+_EXPS = np.arange(-1022, 1024)
+_POW2 = np.ldexp(1.0, _EXPS)  # shape (2046,)
+
+_EXP_BIAS = 1023
+_FRAC_BITS = 52
+_QNAN_BITS = np.uint64(0x7FF8000000000000)
+_TWO_P537 = np.ldexp(1.0, 537)
+_TWO_M537 = np.ldexp(1.0, -537)
+
+
+def f64_to_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """float64 array -> uint64 IEEE-754 bit patterns (exact; NaN canonical)."""
+    absx = jnp.abs(x)
+    # sign: x<0, or -0.0 (detected via 1/x = -inf). NaN -> canonical sign 0.
+    neg_zero = (absx == 0) & (jnp.asarray(1.0, x.dtype) / x < 0)
+    sign = jnp.where((x < 0) | neg_zero, jnp.uint64(1), jnp.uint64(0))
+
+    table = jnp.asarray(_POW2)
+    idx = jnp.searchsorted(table, absx, side="right") - 1  # -1 => subnormal
+    # Explicit zero guard: on TPU the table's tiniest entries flush to zero
+    # under the f64 emulation, which would misclassify absx == 0.
+    is_zero = absx == 0
+    is_sub = (idx < 0) | is_zero
+    is_inf = jnp.isinf(absx)
+    is_nan = jnp.isnan(x)
+
+    safe_idx = jnp.clip(idx, 0, table.shape[0] - 1)
+    binade = table[safe_idx]
+    # normals: m in [1,2); frac = (m-1)*2^52 exact
+    m = absx / binade
+    frac_norm = ((m - 1.0) * jnp.asarray(np.ldexp(1.0, 52), x.dtype)).astype(
+        jnp.uint64
+    )
+    biased_norm = (safe_idx + 1).astype(jnp.uint64)  # table[0]=2^-1022 -> biased 1
+
+    # subnormals: frac = |x| * 2^1074, staged to stay finite
+    frac_sub = ((absx * _TWO_P537) * _TWO_P537).astype(jnp.uint64)
+
+    biased = jnp.where(is_sub, jnp.uint64(0), biased_norm)
+    frac = jnp.where(is_zero, jnp.uint64(0), jnp.where(is_sub, frac_sub, frac_norm))
+    bits = (
+        (sign << 63)
+        | (biased << _FRAC_BITS)
+        | (frac & jnp.uint64((1 << 52) - 1))
+    )
+    bits = jnp.where(
+        is_inf, (sign << 63) | jnp.uint64(0x7FF0000000000000), bits
+    )
+    bits = jnp.where(is_nan, jnp.uint64(_QNAN_BITS), bits)
+    return bits
+
+
+def bits_to_f64(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint64 IEEE-754 bit patterns -> float64 array (exact)."""
+    bits = bits.astype(jnp.uint64)
+    sign = (bits >> 63) != 0
+    biased = ((bits >> _FRAC_BITS) & jnp.uint64(0x7FF)).astype(jnp.int32)
+    frac = (bits & jnp.uint64((1 << 52) - 1)).astype(jnp.float64)
+
+    table = jnp.asarray(_POW2)
+    # normal: (1 + frac*2^-52) * 2^(biased-1023); biased-1023-(-1022) = biased-1
+    safe_pow = table[jnp.clip(biased - 1, 0, table.shape[0] - 1)]
+    m = 1.0 + frac * jnp.asarray(np.ldexp(1.0, -52))
+    val_norm = m * safe_pow
+    # subnormal: frac * 2^-1074, staged
+    val_sub = (frac * _TWO_M537) * _TWO_M537
+
+    is_special = biased == 0x7FF
+    val = jnp.where(biased == 0, val_sub, val_norm)
+    val = jnp.where(
+        is_special,
+        jnp.where(frac == 0, jnp.asarray(np.inf), jnp.asarray(np.nan)),
+        val,
+    )
+    return jnp.where(sign, -val, val)
+
+
+def float_to_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """f64 -> u64 bits; bitcast on CPU, arithmetic codec on TPU."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return jax.lax.bitcast_convert_type(x, jnp.uint64)
+    return f64_to_bits(x)
+
+
+def bits_to_float(bits: jnp.ndarray) -> jnp.ndarray:
+    """u64 bits -> f64; bitcast on CPU, arithmetic codec on TPU."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return jax.lax.bitcast_convert_type(bits, jnp.float64)
+    return bits_to_f64(bits)

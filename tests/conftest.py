@@ -9,13 +9,21 @@ no-accelerator tier that also exercises the multi-chip sharding paths
 
 import os
 
-# Must be set before jax initializes its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# The environment pins JAX_PLATFORMS to the TPU platform, and the plugin
+# re-appends itself even when the env var is overridden — so the platform
+# must be forced through the config API before backend initialization.
+# SPARK_RAPIDS_TPU_TEST_PLATFORM=axon opts a test run onto the real chip.
+jax.config.update(
+    "jax_platforms", os.environ.get("SPARK_RAPIDS_TPU_TEST_PLATFORM", "cpu")
+)
 
 import numpy as np
 import pytest

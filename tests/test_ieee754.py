@@ -1,0 +1,82 @@
+"""Tests for the arithmetic f64 <-> bits codec (utils/ieee754.py).
+
+Contract under test: exact for normals/zeros/infs; subnormals flush to zero
+(XLA DAZ/FTZ); NaN canonicalized. FLOAT64 *storage* never uses this codec.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.utils import ieee754
+
+
+NORMAL_EDGE_VALUES = np.array(
+    [
+        0.0,
+        -0.0,
+        1.0,
+        -1.0,
+        1.5,
+        1.1,
+        0.1,
+        2.0**-52 + 1.0,  # 1 + eps
+        np.nextafter(1.0, 2.0),
+        np.nextafter(1.0, 0.0),
+        2.0**-1022,  # smallest normal
+        1.7976931348623157e308,  # max finite
+        np.inf,
+        -np.inf,
+        np.pi,
+        123456789.123456789,
+        -3e-308,
+    ]
+)
+
+
+def test_bits_match_numpy_view():
+    got = np.asarray(jax.jit(ieee754.f64_to_bits)(NORMAL_EDGE_VALUES))
+    want = NORMAL_EDGE_VALUES.view(np.uint64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_roundtrip_exact():
+    bits = NORMAL_EDGE_VALUES.view(np.uint64)
+    back = np.asarray(jax.jit(ieee754.bits_to_f64)(bits))
+    np.testing.assert_array_equal(back.view(np.uint64), bits)
+
+
+def test_subnormals_flush_to_zero():
+    subs = np.array([5e-324, -2.5e-310, np.nextafter(2.0**-1022, 0.0)])
+    got = np.asarray(jax.jit(ieee754.f64_to_bits)(subs))
+    # sign preserved, magnitude flushed (DAZ) — documented contract
+    assert got[0] == 0
+    assert got[1] == np.uint64(1) << np.uint64(63)
+    back = np.asarray(jax.jit(ieee754.bits_to_f64)(subs.view(np.uint64)))
+    np.testing.assert_array_equal(np.abs(back), 0.0)
+
+
+def test_nan_canonicalized():
+    vals = np.array([np.nan, -np.nan])
+    got = np.asarray(jax.jit(ieee754.f64_to_bits)(vals))
+    assert (got == np.uint64(0x7FF8000000000000)).all()
+    back = np.asarray(jax.jit(ieee754.bits_to_f64)(got))
+    assert np.isnan(back).all()
+
+
+def test_random_roundtrip(rng):
+    exps = rng.integers(-1000, 1000, 10_000)
+    vals = np.ldexp(rng.standard_normal(10_000), exps)
+    vals = vals[np.isfinite(vals) & (np.abs(vals) >= 2.0**-1022)]
+    got = np.asarray(jax.jit(ieee754.f64_to_bits)(vals))
+    np.testing.assert_array_equal(got, vals.view(np.uint64))
+    back = np.asarray(jax.jit(ieee754.bits_to_f64)(got))
+    np.testing.assert_array_equal(back, vals)
+
+
+def test_dispatch_helpers_cpu_exact():
+    vals = np.array([1.1, 5e-324, np.pi])  # bitcast path: subnormals exact too
+    bits = np.asarray(jax.jit(ieee754.float_to_bits)(vals))
+    np.testing.assert_array_equal(bits, vals.view(np.uint64))
+    back = np.asarray(jax.jit(ieee754.bits_to_float)(bits))
+    np.testing.assert_array_equal(back, vals)
