@@ -340,9 +340,15 @@ class Table:
                 arr = np.asarray(values)
                 if arr.dtype == object:
                     mask = np.array([v is not None for v in values])
-                    filled = np.array(
-                        [v if v is not None else 0 for v in values]
-                    )
+                    present = [v for v in values if v is not None]
+                    if present and all(isinstance(v, bool) for v in present):
+                        filled = np.array(
+                            [bool(v) for v in values], dtype=np.bool_
+                        )
+                    else:
+                        filled = np.array(
+                            [v if v is not None else 0 for v in values]
+                        )
                     cols.append(Column.from_numpy(filled, mask, want))
                 else:
                     cols.append(Column.from_numpy(arr, dtype=want))

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dt
-from .column import Column, Table
+from .column import Column, Table, encode_storage
 
 try:  # pyarrow is optional at runtime; gate cleanly (environment contract).
     import pyarrow as pa
@@ -141,8 +141,6 @@ def column_from_arrow(arr, pad_width: Optional[int] = None) -> Column:
         host = np.asarray(arr.fill_null(filler))
         if host.dtype.kind in "Mm":
             host = host.view(np.dtype(f"i{host.dtype.itemsize}"))
-
-    from .column import encode_storage
 
     return Column(
         data=encode_storage(host, dtype),

@@ -1,0 +1,64 @@
+"""Columnar operator library — the capability surface of the cudf pin.
+
+Each module is the TPU-native equivalent of a cudf kernel family the
+reference artifact ships (SURVEY.md §2.3 table): ops lower to XLA where
+jnp can express them and to Pallas kernels (kernels/) where it can't.
+Data-dependent result sizes (filter/join/groupby) come in two flavors,
+mirroring the reference's two-phase 2GB batching discipline
+(row_conversion.cu:505-511):
+
+* eager APIs that host-sync the exact size (the cudf/JNI call model), and
+* ``*_capped`` jittable variants with caller-fixed capacity + a device
+  row count, for whole-query fusion under jit/shard_map.
+"""
+
+from . import compute, keys
+from .binaryop import binary_op, add, sub, mul, div, eq, ne, lt, le, gt, ge
+from .unaryop import unary_op, is_null, is_not_null
+from .cast import cast
+from .reductions import reduce as reduce_column
+from .filter import filter_table, filter_table_capped
+from .gather import gather_table, gather_column
+from .sort import sort_table, argsort_table, SortKey
+from .hashing import murmur3_column, murmur3_table
+from .groupby import groupby_aggregate, GroupbyAgg
+from .join import inner_join, left_join, semi_join, anti_join
+from .partition import hash_partition, round_robin_partition
+
+__all__ = [
+    "compute",
+    "keys",
+    "binary_op",
+    "add",
+    "sub",
+    "mul",
+    "div",
+    "eq",
+    "ne",
+    "lt",
+    "le",
+    "gt",
+    "ge",
+    "unary_op",
+    "is_null",
+    "is_not_null",
+    "cast",
+    "reduce_column",
+    "filter_table",
+    "filter_table_capped",
+    "gather_table",
+    "gather_column",
+    "sort_table",
+    "argsort_table",
+    "SortKey",
+    "murmur3_column",
+    "murmur3_table",
+    "groupby_aggregate",
+    "GroupbyAgg",
+    "inner_join",
+    "left_join",
+    "semi_join",
+    "anti_join",
+    "hash_partition",
+    "round_robin_partition",
+]

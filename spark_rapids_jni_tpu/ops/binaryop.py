@@ -1,0 +1,242 @@
+"""Null-aware binary operators (the cudf ``binaryop`` family).
+
+Semantics follow Spark SQL's non-ANSI mode, which is what the RAPIDS
+Accelerator implements on GPU:
+* any null operand -> null result (plus ``null_safe_eq``, Spark's <=>),
+* integer/decimal division or modulo by zero -> null,
+* float division by zero -> IEEE inf/NaN,
+* decimal add/sub rescale to the finer scale; decimal mul adds scales;
+  decimal div rescales the dividend first (cudf's fixed-point behavior).
+
+Everything is jit-traceable; FLOAT64 goes through the compute view
+(ops/compute.py) so storage stays bit-exact.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column
+from . import compute
+
+_CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "null_safe_eq"}
+_LOGICAL_OPS = {"and", "or"}
+_ARITH_OPS = {
+    "add",
+    "sub",
+    "mul",
+    "div",
+    "true_div",
+    "floor_div",
+    "mod",
+    "pow",
+    "bitand",
+    "bitor",
+    "bitxor",
+    "shiftleft",
+    "shiftright",
+}
+
+
+def _promote(a: Column, b: Column) -> dt.DType:
+    if a.dtype.is_decimal or b.dtype.is_decimal:
+        if a.dtype.is_decimal and b.dtype.is_decimal:
+            wid = max(a.dtype.itemsize, b.dtype.itemsize)
+            scale = min(a.dtype.scale, b.dtype.scale)
+            return dt.DType(
+                dt.TypeId.DECIMAL64 if wid >= 8 else dt.TypeId.DECIMAL32, scale
+            )
+        raise TypeError("decimal/non-decimal binary ops require explicit cast")
+    return dt.common_numeric_dtype(a.dtype, b.dtype)
+
+
+def _rescale_decimal(vals: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
+    if from_scale == to_scale:
+        return vals
+    if to_scale < from_scale:
+        return vals * (10 ** (from_scale - to_scale))
+    return vals // (10 ** (to_scale - from_scale))
+
+
+def binary_op(op: str, a: Column, b: Column) -> Column:
+    """Elementwise ``a <op> b`` with Spark null semantics."""
+    if a.dtype.is_string or b.dtype.is_string:
+        from . import strings
+
+        return strings.binary_op(op, a, b)
+
+    valid = compute.merge_validity(a, b)
+
+    if op in _LOGICAL_OPS:
+        return _logical(op, a, b)
+
+    av, bv = compute.values(a), compute.values(b)
+
+    if op in _CMP_OPS:
+        if a.dtype.is_decimal or b.dtype.is_decimal:
+            scale = min(a.dtype.scale, b.dtype.scale)
+            av = _rescale_decimal(av.astype(jnp.int64), a.dtype.scale, scale)
+            bv = _rescale_decimal(bv.astype(jnp.int64), b.dtype.scale, scale)
+        out = {
+            "eq": lambda: av == bv,
+            "ne": lambda: av != bv,
+            "lt": lambda: av < bv,
+            "le": lambda: av <= bv,
+            "gt": lambda: av > bv,
+            "ge": lambda: av >= bv,
+            "null_safe_eq": lambda: av == bv,
+        }[op]()
+        if op == "null_safe_eq":
+            # Spark's <=>: null <=> null is True, null <=> x is False.
+            va, vb = compute.valid_mask(a), compute.valid_mask(b)
+            out = jnp.where(
+                va & vb, out, jnp.logical_and(~va, ~vb)
+            )
+            return Column(out, dt.BOOL8, None)
+        return Column(out, dt.BOOL8, valid)
+
+    if op not in _ARITH_OPS:
+        raise ValueError(f"unknown binary op {op!r}")
+
+    out_dtype = _promote(a, b)
+
+    if out_dtype.is_decimal:
+        av = _rescale_decimal(av.astype(jnp.int64), a.dtype.scale, out_dtype.scale)
+        bv = _rescale_decimal(bv.astype(jnp.int64), b.dtype.scale, out_dtype.scale)
+        if op == "add":
+            res = av + bv
+        elif op == "sub":
+            res = av - bv
+        elif op == "mul":
+            # product of unscaled values carries scale(a)+scale(b); bring it
+            # back to the output scale (cudf fixed_point multiply).
+            res = _rescale_decimal(
+                compute.values(a).astype(jnp.int64)
+                * compute.values(b).astype(jnp.int64),
+                a.dtype.scale + b.dtype.scale,
+                out_dtype.scale,
+            )
+        elif op in ("div", "true_div"):
+            zero = bv == 0
+            safe_b = jnp.where(zero, 1, bv)
+            res = av // safe_b
+            valid = (
+                ~zero if valid is None else jnp.logical_and(valid, ~zero)
+            )
+        else:
+            raise TypeError(f"decimal op {op!r} not supported")
+        return compute.from_values(res, out_dtype, valid)
+
+    want = np.dtype(out_dtype.device_dtype)
+    av = av.astype(want)
+    bv = bv.astype(want)
+    is_float = out_dtype.is_floating
+
+    if op == "add":
+        res = av + bv
+    elif op == "sub":
+        res = av - bv
+    elif op == "mul":
+        res = av * bv
+    elif op in ("div", "true_div"):
+        if is_float:
+            res = av / bv  # IEEE inf/NaN on zero divide
+        else:
+            zero = bv == 0
+            res = jnp.where(zero, 0, av // jnp.where(zero, 1, bv))
+            valid = ~zero if valid is None else jnp.logical_and(valid, ~zero)
+    elif op == "floor_div":
+        if is_float:
+            res = jnp.floor(av / bv)
+        else:
+            zero = bv == 0
+            res = jnp.where(zero, 0, av // jnp.where(zero, 1, bv))
+            valid = ~zero if valid is None else jnp.logical_and(valid, ~zero)
+    elif op == "mod":
+        if is_float:
+            res = jnp.mod(av, bv)
+        else:
+            zero = bv == 0
+            res = jnp.where(zero, 0, av % jnp.where(zero, 1, bv))
+            valid = ~zero if valid is None else jnp.logical_and(valid, ~zero)
+    elif op == "pow":
+        res = jnp.power(av, bv)
+    elif op == "bitand":
+        res = av & bv
+    elif op == "bitor":
+        res = av | bv
+    elif op == "bitxor":
+        res = av ^ bv
+    elif op == "shiftleft":
+        res = av << bv
+    elif op == "shiftright":
+        res = av >> bv
+    else:  # pragma: no cover
+        raise AssertionError(op)
+
+    return compute.from_values(res, out_dtype, valid)
+
+
+def _logical(op: str, a: Column, b: Column) -> Column:
+    """Spark three-valued logic for AND/OR."""
+    if not (a.dtype.is_boolean and b.dtype.is_boolean):
+        raise TypeError("logical ops require BOOL8 columns")
+    av, bv = a.data, b.data
+    va, vb = compute.valid_mask(a), compute.valid_mask(b)
+    ta = av & va  # definitely true
+    tb = bv & vb
+    fa = (~av) & va  # definitely false
+    fb = (~bv) & vb
+    if op == "and":
+        out = ta & tb
+        known = (fa | fb) | (va & vb)  # false wins over null
+    else:
+        out = ta | tb
+        known = (ta | tb) | (va & vb)  # true wins over null
+    return Column(out, dt.BOOL8, None if (a.validity is None and b.validity is None) else known)
+
+
+# Convenience wrappers
+def add(a, b):
+    return binary_op("add", a, b)
+
+
+def sub(a, b):
+    return binary_op("sub", a, b)
+
+
+def mul(a, b):
+    return binary_op("mul", a, b)
+
+
+def div(a, b):
+    return binary_op("div", a, b)
+
+
+def eq(a, b):
+    return binary_op("eq", a, b)
+
+
+def ne(a, b):
+    return binary_op("ne", a, b)
+
+
+def lt(a, b):
+    return binary_op("lt", a, b)
+
+
+def le(a, b):
+    return binary_op("le", a, b)
+
+
+def gt(a, b):
+    return binary_op("gt", a, b)
+
+
+def ge(a, b):
+    return binary_op("ge", a, b)

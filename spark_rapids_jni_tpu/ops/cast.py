@@ -1,0 +1,53 @@
+"""Type casts (cudf ``cast``): numeric <-> numeric, bool, decimal, temporal."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column
+from . import compute
+
+
+def cast(col: Column, to: dt.DType) -> Column:
+    """Spark CAST semantics (non-ANSI: overflow wraps, no exceptions)."""
+    if col.dtype == to:
+        return col
+    if col.dtype.is_string or to.is_string:
+        from . import strings
+
+        return strings.cast(col, to)
+
+    vals = compute.values(col)
+
+    if col.dtype.is_decimal and to.is_decimal:
+        res = _rescale(vals.astype(jnp.int64), col.dtype.scale, to.scale)
+        return compute.from_values(res, to, col.validity)
+    if col.dtype.is_decimal:
+        # decimal -> numeric: real value = unscaled * 10^scale
+        scaled = vals.astype(jnp.float64) * (10.0 ** col.dtype.scale)
+        if to.is_floating:
+            return compute.from_values(scaled, to, col.validity)
+        return compute.from_values(
+            _rescale(vals.astype(jnp.int64), col.dtype.scale, 0), to, col.validity
+        )
+    if to.is_decimal:
+        if col.dtype.is_floating:
+            unscaled = jnp.rint(vals * (10.0 ** -to.scale)).astype(jnp.int64)
+        else:
+            unscaled = _rescale(vals.astype(jnp.int64), 0, to.scale)
+        return compute.from_values(unscaled, to, col.validity)
+
+    if to.is_boolean:
+        return Column(vals != 0, dt.BOOL8, col.validity)
+
+    return compute.from_values(vals, to, col.validity)
+
+
+def _rescale(vals, from_scale: int, to_scale: int):
+    if from_scale == to_scale:
+        return vals
+    if to_scale < from_scale:
+        return vals * (10 ** (from_scale - to_scale))
+    return vals // (10 ** (to_scale - from_scale))

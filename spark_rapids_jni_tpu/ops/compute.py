@@ -1,0 +1,57 @@
+"""Compute-representation helpers: storage buffers <-> arithmetic values.
+
+The storage layer keeps FLOAT64 as uint64 bit patterns (DType.storage_dtype).
+Ops call ``values()`` to get an arithmetic view (decode on TPU, bitcast on
+CPU) and ``from_values()`` to build result columns, re-encoding doubles.
+Everything here is jit-traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column
+from ..utils import ieee754
+
+
+def values(col: Column) -> jax.Array:
+    """The arithmetic view of a column's data (FLOAT64 bits -> f64)."""
+    if col.dtype.id == dt.TypeId.FLOAT64:
+        return ieee754.bits_to_float(col.data)
+    return col.data
+
+
+def encode_values(vals: jax.Array, dtype: dt.DType) -> jax.Array:
+    """Arithmetic values -> storage buffer for ``dtype``."""
+    if dtype.id == dt.TypeId.FLOAT64:
+        return ieee754.float_to_bits(vals.astype(jnp.float64))
+    return vals.astype(dtype.storage_dtype)
+
+
+def from_values(
+    vals: jax.Array, dtype: dt.DType, validity: Optional[jax.Array]
+) -> Column:
+    return Column(encode_values(vals, dtype), dtype, validity)
+
+
+def valid_mask(col: Column) -> jax.Array:
+    """(n,) bool validity, materialized (all-True when validity is None)."""
+    if col.validity is None:
+        return jnp.ones(col.data.shape[:1], dtype=jnp.bool_)
+    return col.validity
+
+
+def merge_validity(*cols: Column) -> Optional[jax.Array]:
+    """AND of the validities present (null-propagation); None if all absent."""
+    masks = [c.validity for c in cols if c.validity is not None]
+    if not masks:
+        return None
+    out = masks[0]
+    for m in masks[1:]:
+        out = jnp.logical_and(out, m)
+    return out

@@ -1,0 +1,44 @@
+"""Gather / take (cudf ``gather``): row selection by index, the workhorse
+behind sort, join and filter materialization."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column, Table
+
+
+def gather_column(
+    col: Column,
+    indices: jax.Array,
+    index_valid: Optional[jax.Array] = None,
+) -> Column:
+    """col[indices]; rows where ``index_valid`` is False become null
+    (the out-of-bounds-policy=NULLIFY mode of cudf gather, which is how
+    left joins materialize their non-matching rows)."""
+    data = jnp.take(col.data, indices, axis=0, mode="clip")
+    lengths = (
+        None
+        if col.lengths is None
+        else jnp.take(col.lengths, indices, mode="clip")
+    )
+    valid = None
+    if col.validity is not None:
+        valid = jnp.take(col.validity, indices, mode="clip")
+    if index_valid is not None:
+        valid = index_valid if valid is None else jnp.logical_and(valid, index_valid)
+    return Column(data, col.dtype, valid, lengths)
+
+
+def gather_table(
+    table: Table,
+    indices: jax.Array,
+    index_valid: Optional[jax.Array] = None,
+) -> Table:
+    return Table(
+        [gather_column(c, indices, index_valid) for c in table.columns],
+        table.names,
+    )

@@ -1,0 +1,185 @@
+"""Group-by aggregation (cudf ``groupby``), sort-based.
+
+TPU has no device-wide atomic hash-table idiom (SURVEY.md §7 hard part 1),
+so aggregation is sort-based: normalize keys (ops/keys.py) -> stable
+lexsort -> segment boundaries -> XLA segment reductions (which lower to
+sorted scatter-adds, efficient on TPU). Null keys form their own group,
+like Spark/cudf.
+
+Two forms (see ops/__init__ docstring): ``groupby_aggregate`` host-syncs
+the group count; ``groupby_aggregate_capped`` is fully jittable with
+``num_segments`` as the static capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column, Table
+from . import compute
+from . import keys as keys_mod
+from .gather import gather_table
+
+_AGG_OPS = {"sum", "count", "min", "max", "mean"}
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupbyAgg:
+    """One aggregation: (value column, op, output name)."""
+
+    column: Union[int, str]
+    op: str
+    name: Optional[str] = None
+
+    def __post_init__(self):
+        if self.op not in _AGG_OPS:
+            raise ValueError(f"unknown aggregation {self.op!r}")
+
+
+def _segment_ids(key_cols: Sequence[Column]):
+    """(perm, seg_ids, num_groups_device): stable sort + boundary scan."""
+    words: list[jax.Array] = []
+    for c in key_cols:
+        if c.validity is not None:
+            # null key rows group together: validity is a key word and null
+            # payloads must not split the group
+            words.append(c.validity.astype(jnp.uint64))
+            words.extend(
+                jnp.where(c.validity, w, jnp.uint64(0))
+                for w in keys_mod.column_order_keys(c)
+            )
+        else:
+            words.extend(keys_mod.column_order_keys(c))
+    perm = jnp.lexsort(words[::-1])
+    sorted_words = [w[perm] for w in words]
+    boundary = jnp.zeros(perm.shape, dtype=jnp.bool_).at[0].set(True)
+    for w in sorted_words:
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), w[1:] != w[:-1]]
+        )
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    return perm, seg, seg[-1] + 1
+
+
+def _aggregate_segment(
+    col: Column, op: str, perm, seg, num_segments: int
+) -> Column:
+    vals = compute.values(col)[perm]
+    valid = compute.valid_mask(col)[perm]
+    n_valid = jax.ops.segment_sum(
+        valid.astype(jnp.int64), seg, num_segments=num_segments
+    )
+    has = n_valid > 0
+
+    if op == "count":
+        return Column(n_valid, dt.INT64, None)
+
+    if op in ("sum", "mean"):
+        acc_dtype = jnp.float64 if col.dtype.is_floating else jnp.int64
+        total = jax.ops.segment_sum(
+            jnp.where(valid, vals, 0).astype(acc_dtype),
+            seg,
+            num_segments=num_segments,
+        )
+        if op == "mean":
+            mean = total.astype(jnp.float64) / jnp.maximum(n_valid, 1)
+            if col.dtype.is_decimal:
+                mean = mean * (10.0 ** col.dtype.scale)
+            return compute.from_values(mean, dt.FLOAT64, has)
+        if col.dtype.is_floating:
+            return compute.from_values(total, dt.FLOAT64, has)
+        if col.dtype.is_decimal:
+            return compute.from_values(
+                total, dt.DType(dt.TypeId.DECIMAL64, col.dtype.scale), has
+            )
+        return compute.from_values(total, dt.INT64, has)
+
+    # min / max via masked sentinels
+    if col.dtype.is_floating:
+        sentinel = np.inf if op == "min" else -np.inf
+    elif col.dtype.is_boolean:
+        sentinel = op == "min"
+    else:
+        info = np.iinfo(np.dtype(col.dtype.storage_dtype))
+        sentinel = info.max if op == "min" else info.min
+    masked = jnp.where(valid, vals, jnp.asarray(sentinel, vals.dtype))
+    fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+    out = fn(masked, seg, num_segments=num_segments)
+    return compute.from_values(out, col.dtype, has)
+
+
+def groupby_aggregate_capped(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+    num_segments: int,
+) -> tuple[Table, jax.Array]:
+    """Jittable groupby: (padded result of ``num_segments`` rows, count).
+
+    Padding rows have null keys/values (validity False past the count).
+    """
+    key_cols = [table.column(c) for c in by]
+    perm, seg, num_groups = _segment_ids(key_cols)
+
+    # representative (first) sorted row of each segment -> key values
+    n = table.row_count
+    first_pos = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg, num_segments=num_segments
+    )
+    in_range = jnp.arange(num_segments, dtype=jnp.int32) < num_groups
+    first_rows = perm[jnp.clip(first_pos, 0, n - 1)]
+
+    out_cols: list[Column] = []
+    out_names: list[str] = []
+    for i, c in enumerate(by):
+        col = table.column(c)
+        k = gather_table(Table([col]), first_rows).columns[0]
+        valid = jnp.logical_and(
+            compute.valid_mask(k), in_range
+        )
+        out_cols.append(Column(k.data, k.dtype, valid, k.lengths))
+        out_names.append(
+            c if isinstance(c, str) else (table.names[c] if table.names else f"key{i}")
+        )
+
+    for agg in aggs:
+        col = table.column(agg.column)
+        r = _aggregate_segment(col, agg.op, perm, seg, num_segments)
+        valid = jnp.logical_and(compute.valid_mask(r), in_range)
+        out_cols.append(Column(r.data, r.dtype, valid, r.lengths))
+        base = (
+            agg.column
+            if isinstance(agg.column, str)
+            else (table.names[agg.column] if table.names else f"c{agg.column}")
+        )
+        out_names.append(agg.name or f"{agg.op}_{base}")
+
+    return Table(out_cols, out_names), num_groups
+
+
+def groupby_aggregate(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+) -> Table:
+    """Eager groupby with exact output size (one host sync)."""
+    padded, num_groups = groupby_aggregate_capped(
+        table, by, aggs, num_segments=max(table.row_count, 1)
+    )
+    g = int(num_groups)
+    cols = [
+        Column(
+            c.data[:g],
+            c.dtype,
+            None if c.validity is None else c.validity[:g],
+            None if c.lengths is None else c.lengths[:g],
+        )
+        for c in padded.columns
+    ]
+    return Table(cols, padded.names)

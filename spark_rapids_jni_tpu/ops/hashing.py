@@ -1,0 +1,156 @@
+"""Spark-compatible Murmur3 hashing (vectorized uint32 arithmetic).
+
+Bit-for-bit the algorithm of Spark's ``Murmur3Hash`` expression /
+``Murmur3_x86_32.hashInt/hashLong/hashUnsafeBytes`` with seed 42 — the
+hash the RAPIDS Accelerator uses for ``HashPartitioning``, so partition
+placement matches a CPU/GPU Spark cluster exactly:
+
+* int-family (incl. bool, dates) widen to int32 and use hashInt,
+* longs/timestamps/decimal64 use hashLong, decimal32 hashes its unscaled
+  int via hashLong like Spark's Decimal (precision<=18) path,
+* float/double hash their IEEE bits (with -0.0 normalized to 0.0),
+* strings hash 4-byte little-endian blocks then each trailing byte
+  sign-extended individually (Spark's nonstandard tail),
+* null values leave the running hash unchanged,
+* multi-column hashing chains: h = hash(col_i, seed=h).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column, Table
+from . import compute
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+_M5 = jnp.uint32(0xE6546B64)
+
+DEFAULT_SEED = 42
+
+
+def _rotl(x, r):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return h1 * jnp.uint32(5) + _M5
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length)
+    h1 = h1 ^ (h1 >> jnp.uint32(16))
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> jnp.uint32(13))
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> jnp.uint32(16))
+
+
+def _hash_int(v_i32: jax.Array, seed: jax.Array) -> jax.Array:
+    return _fmix(_mix_h1(seed, _mix_k1(v_i32.astype(jnp.uint32))), 4)
+
+
+def _hash_long(v_u64: jax.Array, seed: jax.Array) -> jax.Array:
+    low = v_u64.astype(jnp.uint32)
+    high = (v_u64 >> jnp.uint64(32)).astype(jnp.uint32)
+    h1 = _mix_h1(seed, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, 8)
+
+
+def _hash_string(col: Column, seed: jax.Array) -> jax.Array:
+    mat = col.data  # (n, pad) uint8
+    lens = col.lengths.astype(jnp.int32)
+    n, pad = mat.shape
+    h1 = seed
+    # 4-byte little-endian blocks, processed while fully inside the length
+    for w in range(pad // 4 + (1 if pad % 4 else 0)):
+        word = jnp.zeros((n,), dtype=jnp.uint32)
+        for b in range(4):
+            i = w * 4 + b
+            byte = (
+                mat[:, i].astype(jnp.uint32)
+                if i < pad
+                else jnp.zeros((n,), jnp.uint32)
+            )
+            word = word | (byte << jnp.uint32(8 * b))
+        in_block = lens >= (w + 1) * 4
+        h1 = jnp.where(in_block, _mix_h1(h1, _mix_k1(word)), h1)
+    # Spark's tail: each remaining byte sign-extended to int, full mix each
+    for i in range(pad):
+        is_tail = (i >= (lens // 4) * 4) & (i < lens)
+        byte_signed = mat[:, i].astype(jnp.int8).astype(jnp.int32)
+        h1 = jnp.where(
+            is_tail, _mix_h1(h1, _mix_k1(byte_signed.astype(jnp.uint32))), h1
+        )
+    return _fmix(h1, lens.astype(jnp.uint32))
+
+
+def _column_hash(col: Column, seed: jax.Array) -> jax.Array:
+    """Running hash update for one column (nulls leave seed unchanged)."""
+    d = col.dtype
+    if d.is_string:
+        h = _hash_string(col, seed)
+    elif d.id in (
+        dt.TypeId.INT8,
+        dt.TypeId.INT16,
+        dt.TypeId.INT32,
+        dt.TypeId.UINT8,
+        dt.TypeId.UINT16,
+        dt.TypeId.UINT32,
+        dt.TypeId.TIMESTAMP_DAYS,
+        dt.TypeId.DURATION_DAYS,
+        dt.TypeId.DICTIONARY32,
+    ):
+        h = _hash_int(col.data.astype(jnp.int32), seed)
+    elif d.is_boolean:
+        h = _hash_int(col.data.astype(jnp.int32), seed)
+    elif d.id == dt.TypeId.FLOAT32:
+        bits = jax.lax.bitcast_convert_type(
+            jnp.where(col.data == 0, jnp.float32(0), col.data), jnp.uint32
+        )
+        h = _hash_int(bits, seed)
+    elif d.id == dt.TypeId.FLOAT64:
+        # storage is already IEEE bits; normalize -0.0 like Spark
+        neg_zero = jnp.uint64(0x8000000000000000)
+        bits = jnp.where(col.data == neg_zero, jnp.uint64(0), col.data)
+        h = _hash_long(bits, seed)
+    else:
+        # int64-family: longs, 64-bit timestamps/durations, decimals
+        # (Spark hashes the unscaled long for precision <= 18)
+        h = _hash_long(col.data.astype(jnp.int64).astype(jnp.uint64), seed)
+    if col.validity is not None:
+        h = jnp.where(col.validity, h, seed)
+    return h
+
+
+def murmur3_column(col: Column, seed: int = DEFAULT_SEED) -> Column:
+    """Per-row Spark murmur3 of one column -> INT32 column (never null)."""
+    seed_v = jnp.full(col.data.shape[:1], seed, dtype=jnp.uint32)
+    return Column(_column_hash(col, seed_v).astype(jnp.int32), dt.INT32, None)
+
+
+def murmur3_table(
+    table: Table, columns=None, seed: int = DEFAULT_SEED
+) -> Column:
+    """Spark multi-column hash: h chains through columns left to right."""
+    cols = (
+        [table.column(c) for c in columns]
+        if columns is not None
+        else list(table.columns)
+    )
+    h = jnp.full((table.row_count,), seed, dtype=jnp.uint32)
+    for c in cols:
+        h = _column_hash(c, h)
+    return Column(h.astype(jnp.int32), dt.INT32, None)

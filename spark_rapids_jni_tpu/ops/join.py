@@ -1,0 +1,241 @@
+"""Equi-joins (cudf ``inner_join``/``left_join``/semi/anti), sort-merge.
+
+Design (SURVEY.md §7 hard parts 1 & 5): no device hash tables — the build
+side is sorted once by normalized keys (ops/keys.py) and the probe side
+binary-searches lower/upper bounds lexicographically over the u64 key
+words (log2(m) rounds of gathers, fully vectorized over probe rows).
+Output cardinality is data-dependent, so materialization is two-phase:
+count matches on device, size the output (host sync in the eager API, a
+static capacity in the ``*_capped`` jittable variants), then expand with
+``jnp.repeat(..., total_repeat_length=...)`` — the XLA-static equivalent
+of the reference's two-phase batching (row_conversion.cu:505-511).
+
+Nulls: null join keys never match (Spark inner-join semantics); left joins
+still emit their left rows with a null right side.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column, Table
+from . import compute
+from . import keys as keys_mod
+from .gather import gather_table
+
+
+def _key_words(cols: Sequence[Column]) -> tuple[list[jax.Array], jax.Array]:
+    """(order-key words with null payloads zeroed, all-valid mask)."""
+    words: list[jax.Array] = []
+    n = cols[0].data.shape[0]
+    valid = jnp.ones((n,), dtype=jnp.bool_)
+    for c in cols:
+        if c.validity is not None:
+            valid = valid & c.validity
+    for c in cols:
+        for w in keys_mod.column_order_keys(c):
+            words.append(jnp.where(valid, w, jnp.uint64(0)))
+    return words, valid
+
+
+def _lex_searchsorted(
+    sorted_words: list[jax.Array], query_words: list[jax.Array], side: str
+) -> jax.Array:
+    """Vectorized multi-word binary search (lower/upper bound)."""
+    m = sorted_words[0].shape[0]
+    nq = query_words[0].shape[0]
+    lo = jnp.zeros((nq,), dtype=jnp.int32)
+    hi = jnp.full((nq,), m, dtype=jnp.int32)
+    steps = max(1, int(np.ceil(np.log2(m + 1)))) if m > 0 else 1
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        safe_mid = jnp.clip(mid, 0, max(m - 1, 0))
+        # go_right: sorted[mid] < q (lower bound) or <= q (upper bound)
+        lt = jnp.zeros((nq,), dtype=jnp.bool_)
+        eq = jnp.ones((nq,), dtype=jnp.bool_)
+        for sw, qw in zip(sorted_words, query_words):
+            sv = sw[safe_mid]
+            lt = lt | (eq & (sv < qw))
+            eq = eq & (sv == qw)
+        go_right = lt | eq if side == "right" else lt
+        active = lo < hi
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def _match_ranges(
+    left: Table,
+    right: Table,
+    left_on: Sequence[Union[int, str]],
+    right_on: Sequence[Union[int, str]],
+):
+    """Per-left-row [lo, hi) match range into the sorted right side."""
+    lcols = [left.column(c) for c in left_on]
+    rcols = [right.column(c) for c in right_on]
+    lwords, lvalid = _key_words(lcols)
+    rwords, rvalid = _key_words(rcols)
+
+    # sort right by (valid, words) so invalid rows sink to the front and
+    # can never fall inside a valid query's range
+    rsort_words = [rvalid.astype(jnp.uint64)] + rwords
+    perm_r = jnp.lexsort(rsort_words[::-1])
+    sorted_words = [w[perm_r] for w in rsort_words]
+    # query with valid=1 so the search space is the valid suffix; invalid
+    # left rows get their counts zeroed below regardless
+    qwords = [jnp.ones_like(lvalid, dtype=jnp.uint64)] + lwords
+
+    lo = _lex_searchsorted(sorted_words, qwords, "left")
+    hi = _lex_searchsorted(sorted_words, qwords, "right")
+    counts = jnp.where(lvalid, hi - lo, 0)
+    return perm_r, lo, counts, lvalid
+
+
+def _expand(
+    perm_r, lo, counts, total: int, left_outer: bool
+):
+    """Materialize (left_idx, right_idx, right_valid) pair arrays."""
+    n_left = counts.shape[0]
+    emit = jnp.maximum(counts, 1) if left_outer else counts
+    start = jnp.cumsum(emit) - emit
+    left_idx = jnp.repeat(
+        jnp.arange(n_left, dtype=jnp.int32), emit, total_repeat_length=total
+    )
+    k = jnp.arange(total, dtype=jnp.int32) - start[left_idx]
+    matched = k < counts[left_idx]
+    r_sorted_pos = jnp.clip(lo[left_idx] + k, 0, max(perm_r.shape[0] - 1, 0))
+    right_idx = perm_r[r_sorted_pos]
+    # pairs beyond the emitted total (possible when total is a capacity)
+    in_range = jnp.arange(total, dtype=jnp.int32) < jnp.sum(emit)
+    return left_idx, right_idx, matched & in_range, in_range
+
+
+def _join_output(
+    left: Table,
+    right: Table,
+    right_on: Sequence[Union[int, str]],
+    left_idx,
+    right_idx,
+    matched,
+    row_valid,
+) -> Table:
+    """left columns + right columns (minus its join keys, like Spark USING)."""
+    drop = set()
+    for c in right_on:
+        if isinstance(c, str):
+            if right.names is not None:
+                drop.add(right.names.index(c))
+        else:
+            drop.add(c)
+    lcols = gather_table(left, left_idx, None).columns
+    out_cols = list(lcols)
+    out_names = list(left.names) if left.names else [f"l{i}" for i in range(left.num_columns)]
+    for j, c in enumerate(right.columns):
+        if j in drop:
+            continue
+        g = gather_table(Table([c]), right_idx, matched).columns[0]
+        out_cols.append(g)
+        out_names.append(
+            right.names[j] if right.names else f"r{j}"
+        )
+    return Table(out_cols, out_names)
+
+
+def inner_join_capped(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    capacity: int,
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+) -> tuple[Table, jax.Array]:
+    """Jittable inner join with static output capacity; returns (padded
+    table, device match count). Pairs past the count are padding."""
+    right_on = right_on or on
+    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    left_idx, right_idx, matched, in_range = _expand(
+        perm_r, lo, counts, capacity, left_outer=False
+    )
+    out = _join_output(left, right, right_on, left_idx, right_idx, matched, in_range)
+    # null out padding rows entirely
+    cols = [
+        Column(
+            c.data,
+            c.dtype,
+            in_range if c.validity is None else jnp.logical_and(c.validity, in_range),
+            c.lengths,
+        )
+        for c in out.columns
+    ]
+    return Table(cols, out.names), jnp.sum(counts)
+
+
+def inner_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+) -> Table:
+    """Eager inner equi-join (host-syncs the match count)."""
+    right_on = right_on or on
+    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    total = int(jnp.sum(counts))
+    if total == 0:
+        left_idx = jnp.zeros((0,), jnp.int32)
+        right_idx = jnp.zeros((0,), jnp.int32)
+        return _join_output(
+            left, right, right_on, left_idx, right_idx,
+            jnp.zeros((0,), jnp.bool_), jnp.zeros((0,), jnp.bool_),
+        )
+    left_idx, right_idx, matched, _ = _expand(
+        perm_r, lo, counts, total, left_outer=False
+    )
+    return _join_output(left, right, right_on, left_idx, right_idx, None, None)
+
+
+def left_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+) -> Table:
+    """Eager left outer equi-join."""
+    right_on = right_on or on
+    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    total = int(jnp.sum(jnp.maximum(counts, 1)))
+    left_idx, right_idx, matched, _ = _expand(
+        perm_r, lo, counts, total, left_outer=True
+    )
+    return _join_output(left, right, right_on, left_idx, right_idx, matched, None)
+
+
+def _membership(left, right, on, right_on):
+    right_on = right_on or on
+    _, _, counts, _ = _match_ranges(left, right, on, right_on)
+    return counts > 0
+
+
+def semi_join(left, right, on, right_on=None) -> Table:
+    """Rows of ``left`` with at least one match (LEFT SEMI)."""
+    from .filter import filter_table
+    from .. import dtype as dt
+
+    has = _membership(left, right, on, right_on)
+    return filter_table(left, Column(has, dt.BOOL8, None))
+
+
+def anti_join(left, right, on, right_on=None) -> Table:
+    """Rows of ``left`` with no match (LEFT ANTI)."""
+    from .filter import filter_table
+    from .. import dtype as dt
+
+    has = _membership(left, right, on, right_on)
+    return filter_table(left, Column(jnp.logical_not(has), dt.BOOL8, None))

@@ -1,0 +1,65 @@
+"""Partitioning (cudf ``hash_partition``/``round_robin_partition``).
+
+This is the device half of shuffle exchange: assign each row a partition,
+reorder rows so partitions are contiguous, and report per-partition
+counts. The exchange itself (the UCX/NCCL shuffle manager the GPU stack
+gets from the spark-rapids plugin — absent in the reference repo, see
+SURVEY.md §2.5) lives in parallel/shuffle.py as ICI all-to-all collectives.
+
+``hash_partition`` uses Spark's ``Pmod(Murmur3Hash(keys), n)`` so rows
+land on the same partition ids a Spark cluster would compute.
+
+Everything here is static-shaped, hence fully jittable with no capacity
+tricks: reordering is a stable sort by partition id and counts are a
+``bincount``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtype as dt
+from ..column import Column, Table
+from .gather import gather_table
+from .hashing import murmur3_table
+
+
+def partition_ids_hash(
+    table: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    num_partitions: int,
+) -> jax.Array:
+    """Spark HashPartitioning ids: pmod(murmur3(keys), n) (non-negative)."""
+    h = murmur3_table(table, columns).data.astype(jnp.int32)
+    return jnp.mod(jnp.mod(h, num_partitions) + num_partitions, num_partitions)
+
+
+def _reorder_by_parts(
+    table: Table, part: jax.Array, num_partitions: int
+) -> tuple[Table, jax.Array]:
+    order = jnp.argsort(part, stable=True)
+    counts = jnp.bincount(part, length=num_partitions).astype(jnp.int32)
+    return gather_table(table, order.astype(jnp.int32)), counts
+
+
+def hash_partition(
+    table: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    num_partitions: int,
+) -> tuple[Table, jax.Array]:
+    """(rows reordered partition-contiguously, per-partition counts)."""
+    part = partition_ids_hash(table, columns, num_partitions)
+    return _reorder_by_parts(table, part, num_partitions)
+
+
+def round_robin_partition(
+    table: Table, num_partitions: int, start_partition: int = 0
+) -> tuple[Table, jax.Array]:
+    n = table.row_count
+    part = jnp.mod(
+        jnp.arange(n, dtype=jnp.int32) + start_partition, num_partitions
+    )
+    return _reorder_by_parts(table, part, num_partitions)

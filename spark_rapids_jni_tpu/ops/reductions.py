@@ -1,0 +1,78 @@
+"""Column reductions (cudf ``reduce``): null-skipping Spark aggregates.
+
+Results are returned as 1-element Columns (so null results — e.g. SUM over
+an all-null column — are representable, matching Spark).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column
+from . import compute
+
+_REDUCTIONS = {"sum", "min", "max", "mean", "count", "any", "all", "product"}
+
+
+def reduce(col: Column, op: str) -> Column:
+    """Null-skipping reduction to a 1-row column."""
+    if op not in _REDUCTIONS:
+        raise ValueError(f"unknown reduction {op!r}")
+    valid = compute.valid_mask(col)
+    n_valid = jnp.sum(valid)
+
+    if op == "count":
+        return Column(n_valid.astype(jnp.int64)[None], dt.INT64, None)
+
+    if op in ("any", "all"):
+        if not col.dtype.is_boolean:
+            raise TypeError(f"{op} requires BOOL8")
+        masked = col.data & valid
+        if op == "any":
+            out = jnp.any(masked)
+        else:
+            out = jnp.all(jnp.where(valid, col.data, True))
+        return Column(out[None], dt.BOOL8, (n_valid > 0)[None])
+
+    vals = compute.values(col)
+    has_result = (n_valid > 0)[None]
+
+    if op == "sum" or op == "mean":
+        acc_dtype = (
+            jnp.float64
+            if col.dtype.is_floating
+            else jnp.int64
+        )
+        total = jnp.sum(jnp.where(valid, vals, 0).astype(acc_dtype))
+        if op == "mean":
+            mean = total.astype(jnp.float64) / jnp.maximum(n_valid, 1)
+            if col.dtype.is_decimal:
+                mean = mean * (10.0 ** col.dtype.scale)
+            return compute.from_values(mean[None], dt.FLOAT64, has_result)
+        if col.dtype.is_floating:
+            return compute.from_values(total[None], dt.FLOAT64, has_result)
+        if col.dtype.is_decimal:
+            # Spark widens decimal SUM; keep scale, widen storage to 64-bit.
+            out_dt = dt.DType(dt.TypeId.DECIMAL64, col.dtype.scale)
+            return compute.from_values(total[None], out_dt, has_result)
+        return compute.from_values(total[None], dt.INT64, has_result)
+
+    if op == "product":
+        acc = jnp.where(valid, vals, 1)
+        total = jnp.prod(acc.astype(jnp.float64 if col.dtype.is_floating else jnp.int64))
+        out_dt = dt.FLOAT64 if col.dtype.is_floating else dt.INT64
+        return compute.from_values(total[None], out_dt, has_result)
+
+    # min / max with +-inf / int extremes as masked sentinels
+    if col.dtype.is_floating:
+        sentinel = jnp.inf if op == "min" else -jnp.inf
+    elif col.dtype.is_boolean:
+        sentinel = op == "min"
+    else:
+        info = np.iinfo(np.dtype(col.dtype.storage_dtype))
+        sentinel = info.max if op == "min" else info.min
+    masked = jnp.where(valid, vals, jnp.asarray(sentinel, vals.dtype))
+    out = jnp.min(masked) if op == "min" else jnp.max(masked)
+    return compute.from_values(out[None], col.dtype, has_result)

@@ -1,0 +1,80 @@
+"""Sort / order-by (cudf ``sorted_order`` + ``gather``).
+
+Comparator dispatch is replaced by key normalization (ops/keys.py): every
+key column becomes u64 order keys, descending inverts the key, and null
+ordering is an extra leading key word per column — then one stable
+``jnp.lexsort`` does the rest (XLA's sort is bitonic on TPU, an efficient
+fit; no per-type comparators anywhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column, Table
+from . import keys as keys_mod
+from .gather import gather_table
+
+_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    """One ORDER BY term: column (by name/index), direction, null placement.
+
+    ``nulls_first=None`` picks Spark's default: nulls first when ascending,
+    nulls last when descending.
+    """
+
+    column: Union[int, str]
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+    @property
+    def resolved_nulls_first(self) -> bool:
+        if self.nulls_first is None:
+            return self.ascending
+        return self.nulls_first
+
+
+def _key_words(col: Column, key: SortKey) -> list[jax.Array]:
+    words = keys_mod.column_order_keys(col)
+    if not key.ascending:
+        words = [~w for w in words]
+    if col.validity is not None:
+        # Leading null-placement word: 0 sorts before 1, so nulls get 0 when
+        # they go first and 1 when they go last.
+        if key.resolved_nulls_first:
+            null_word = jnp.where(col.validity, jnp.uint64(1), jnp.uint64(0))
+        else:
+            null_word = jnp.where(col.validity, jnp.uint64(0), jnp.uint64(1))
+        words = [null_word] + words
+    return words
+
+
+def argsort_table(
+    table: Table, sort_keys: Sequence[Union[SortKey, str, int]]
+) -> jax.Array:
+    """Stable row permutation ordering ``table`` by ``sort_keys``."""
+    sort_keys = [
+        k if isinstance(k, SortKey) else SortKey(k) for k in sort_keys
+    ]
+    words: list[jax.Array] = []
+    for k in sort_keys:
+        words.extend(_key_words(table.column(k.column), k))
+    # lexsort: last key is primary -> reverse
+    return jnp.lexsort(words[::-1])
+
+
+def sort_table(
+    table: Table,
+    sort_keys: Sequence[Union[SortKey, str, int]],
+    payload: Optional[Table] = None,
+) -> Table:
+    """ORDER BY: returns the table (or ``payload``) reordered."""
+    perm = argsort_table(table, sort_keys)
+    return gather_table(payload if payload is not None else table, perm)

@@ -1,0 +1,203 @@
+"""String operations over the padded byte-matrix layout.
+
+cudf strings are (offsets, chars) variable-width columns; under XLA's
+static-shape regime strings live as an (n, pad) uint8 matrix + lengths
+(SURVEY.md §7 hard part 2 — padding instead of offsets). All ops below are
+plain vectorized byte arithmetic, so they fuse like any other elementwise
+op; pad width is a compile-time constant per column.
+
+ASCII-oriented where case matters (upper/lower), byte-exact elsewhere —
+matching Spark's behavior for ASCII data; full UTF-8 case mapping is a
+later phase.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column
+from . import compute
+from . import keys as keys_mod
+
+
+def _require_string(col: Column):
+    if not col.dtype.is_string:
+        raise TypeError("expected a STRING column")
+
+
+def length(col: Column) -> Column:
+    """Byte length (Spark ``length`` counts chars; equal for ASCII)."""
+    _require_string(col)
+    return Column(col.lengths.astype(jnp.int32), dt.INT32, col.validity)
+
+
+def _case_map(col: Column, to_upper: bool) -> Column:
+    _require_string(col)
+    mat = col.data
+    if to_upper:
+        shift = ((mat >= ord("a")) & (mat <= ord("z"))).astype(jnp.uint8) * 32
+        out = mat - shift
+    else:
+        shift = ((mat >= ord("A")) & (mat <= ord("Z"))).astype(jnp.uint8) * 32
+        out = mat + shift
+    return Column(out, dt.STRING, col.validity, col.lengths)
+
+
+def upper(col: Column) -> Column:
+    return _case_map(col, True)
+
+
+def lower(col: Column) -> Column:
+    return _case_map(col, False)
+
+
+def _literal_bytes(pat: str | bytes) -> np.ndarray:
+    if isinstance(pat, str):
+        pat = pat.encode("utf-8", "surrogateescape")
+    return np.frombuffer(pat, dtype=np.uint8)
+
+
+def contains(col: Column, pattern: str | bytes) -> Column:
+    """Literal substring search (Spark ``contains``), via a sliding
+    window compare — static pad width makes this a fixed unrolled scan."""
+    _require_string(col)
+    pat = _literal_bytes(pattern)
+    m = len(pat)
+    n, pad = col.data.shape
+    if m == 0:
+        return Column(jnp.ones((n,), jnp.bool_), dt.BOOL8, col.validity)
+    if m > pad:
+        return Column(jnp.zeros((n,), jnp.bool_), dt.BOOL8, col.validity)
+    mat = col.data
+    patv = jnp.asarray(pat)
+    found = jnp.zeros((n,), dtype=jnp.bool_)
+    for start in range(pad - m + 1):
+        window_eq = jnp.all(mat[:, start : start + m] == patv[None, :], axis=1)
+        in_len = col.lengths >= start + m
+        found = found | (window_eq & in_len)
+    return Column(found, dt.BOOL8, col.validity)
+
+
+def starts_with(col: Column, pattern: str | bytes) -> Column:
+    _require_string(col)
+    pat = _literal_bytes(pattern)
+    m = len(pat)
+    n, pad = col.data.shape
+    if m == 0:
+        return Column(jnp.ones((n,), jnp.bool_), dt.BOOL8, col.validity)
+    if m > pad:
+        return Column(jnp.zeros((n,), jnp.bool_), dt.BOOL8, col.validity)
+    ok = jnp.all(col.data[:, :m] == jnp.asarray(pat)[None, :], axis=1) & (
+        col.lengths >= m
+    )
+    return Column(ok, dt.BOOL8, col.validity)
+
+
+def ends_with(col: Column, pattern: str | bytes) -> Column:
+    _require_string(col)
+    pat = _literal_bytes(pattern)
+    m = len(pat)
+    n, pad = col.data.shape
+    if m == 0:
+        return Column(jnp.ones((n,), jnp.bool_), dt.BOOL8, col.validity)
+    if m > pad:
+        return Column(jnp.zeros((n,), jnp.bool_), dt.BOOL8, col.validity)
+    # gather the tail window [len-m, len) per row
+    starts = jnp.clip(col.lengths - m, 0, pad - m)
+    idx = starts[:, None] + jnp.arange(m)[None, :]
+    tail = jnp.take_along_axis(col.data, idx, axis=1)
+    ok = jnp.all(tail == jnp.asarray(pat)[None, :], axis=1) & (col.lengths >= m)
+    return Column(ok, dt.BOOL8, col.validity)
+
+
+def substring(col: Column, start: int, slice_len: int) -> Column:
+    """0-based substring with fixed start/length (Spark ``substring``)."""
+    _require_string(col)
+    n, pad = col.data.shape
+    out_pad = max(min(slice_len, pad), 1)
+    shifted = jnp.roll(col.data, -start, axis=1)
+    out = shifted[:, :out_pad]
+    # zero bytes past the new length
+    new_len = jnp.clip(col.lengths - start, 0, slice_len)
+    mask = jnp.arange(out_pad)[None, :] < new_len[:, None]
+    out = jnp.where(mask, out, 0).astype(jnp.uint8)
+    return Column(out, dt.STRING, col.validity, new_len.astype(jnp.int32))
+
+
+def concat(a: Column, b: Column) -> Column:
+    """Rowwise concatenation (Spark ``concat``: null if either null)."""
+    _require_string(a)
+    _require_string(b)
+    n, pad_a = a.data.shape
+    _, pad_b = b.data.shape
+    out_pad = pad_a + pad_b
+    out = jnp.zeros((n, out_pad), dtype=jnp.uint8)
+    out = out.at[:, :pad_a].set(a.data)
+    # place b at offset len(a) via gather: out[i, j] = b[i, j - len_a[i]]
+    j = jnp.arange(out_pad)[None, :]
+    src = j - a.lengths[:, None]
+    valid_src = (src >= 0) & (src < pad_b)
+    b_g = jnp.take_along_axis(
+        b.data, jnp.clip(src, 0, pad_b - 1), axis=1
+    )
+    out = jnp.where(valid_src & (j >= a.lengths[:, None]), b_g, out).astype(
+        jnp.uint8
+    )
+    new_len = a.lengths + b.lengths
+    # zero past length (b's pad garbage)
+    out = jnp.where(j < new_len[:, None], out, 0).astype(jnp.uint8)
+    return Column(out, dt.STRING, compute.merge_validity(a, b), new_len)
+
+
+def repad(col: Column, pad: int) -> Column:
+    """Return the column with a different pad width (>= max length)."""
+    _require_string(col)
+    n, old = col.data.shape
+    if pad == old:
+        return col
+    if pad > old:
+        out = jnp.zeros((n, pad), dtype=jnp.uint8).at[:, :old].set(col.data)
+    else:
+        out = jnp.where(
+            jnp.arange(pad)[None, :] < col.lengths[:, None], col.data[:, :pad], 0
+        ).astype(jnp.uint8)
+    return Column(out, dt.STRING, col.validity, col.lengths)
+
+
+def binary_op(op: str, a: Column, b: Column) -> Column:
+    """String comparisons dispatch through order keys (memcmp order)."""
+    _require_string(a)
+    _require_string(b)
+    common = max(a.data.shape[1], b.data.shape[1])
+    a = repad(a, common)
+    b = repad(b, common)
+    aw = keys_mod.column_order_keys(a)
+    bw = keys_mod.column_order_keys(b)
+    eq_w = jnp.ones((a.data.shape[0],), dtype=jnp.bool_)
+    lt_w = jnp.zeros((a.data.shape[0],), dtype=jnp.bool_)
+    for x, y in zip(aw, bw):
+        lt_w = lt_w | (eq_w & (x < y))
+        eq_w = eq_w & (x == y)
+    valid = compute.merge_validity(a, b)
+    table = {
+        "eq": eq_w,
+        "ne": ~eq_w,
+        "lt": lt_w,
+        "le": lt_w | eq_w,
+        "gt": ~(lt_w | eq_w),
+        "ge": ~lt_w,
+    }
+    if op == "add":  # Spark || / concat
+        return concat(a, b)
+    if op not in table:
+        raise TypeError(f"binary op {op!r} not supported for strings")
+    return Column(table[op], dt.BOOL8, valid)
+
+
+def cast(col: Column, to: dt.DType) -> Column:
+    raise NotImplementedError(
+        "string casts land with the format/parse phase"
+    )

@@ -194,7 +194,8 @@ def _unpack_validity_bytes(vb: jax.Array, num_cols: int) -> jax.Array:
     n = vb.shape[0]
     weights = jnp.asarray(_BIT_WEIGHTS)
     bits = (vb[:, :, None] & weights[None, None, :]) != 0
-    return bits.reshape(n, -1)[:, :num_cols]
+    # explicit dims: reshape(n, -1) is uninferable for zero-row batches
+    return bits.reshape(n, vb.shape[1] * 8)[:, :num_cols]
 
 
 def _pack_batch(columns: Sequence[Column], layout: RowLayout) -> jax.Array:
@@ -318,16 +319,24 @@ def from_rows(
         layout = want
 
     parts = [_unpack_batch_jit(p.data, layout) for p in packed]
+    # Preserve the validity=None invariant for null-free columns so
+    # downstream ops keep their no-nulls fast path. One batched (num_cols,)
+    # reduction + a single host transfer, not a sync per column.
+    all_valid = np.asarray(
+        jnp.all(
+            jnp.concatenate([p[1] for p in parts], axis=0)
+            if len(parts) > 1
+            else parts[0][1],
+            axis=0,
+        )
+    )
     columns = []
     for i, d in enumerate(layout.dtypes):
         data = jnp.concatenate([p[0][i] for p in parts]) if len(parts) > 1 else parts[0][0][i]
         valid = jnp.concatenate([p[1][:, i] for p in parts]) if len(parts) > 1 else parts[0][1][:, i]
-        # Preserve the validity=None invariant for null-free columns so
-        # downstream ops keep their no-nulls fast path (one fused device
-        # reduction; from_rows is an eager API, the sync is fine here).
-        if bool(jnp.all(valid)):
-            valid = None
-        columns.append(Column(data=data, dtype=d, validity=valid))
+        columns.append(
+            Column(data=data, dtype=d, validity=None if all_valid[i] else valid)
+        )
     return Table(columns, names)
 
 

@@ -1,0 +1,528 @@
+"""Phase-2 tests: the columnar op library vs independent oracles."""
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu as srt
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import ops
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops import strings as str_ops
+from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+from spark_rapids_jni_tpu.ops.sort import SortKey
+
+
+# --------------------------------------------------------------------------
+# Independent Spark Murmur3_x86_32 oracle (pure python, 32-bit masked)
+# --------------------------------------------------------------------------
+M = 0xFFFFFFFF
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (32 - r))) & M
+
+
+def _mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & M
+    k1 = _rotl(k1, 15)
+    return (k1 * 0x1B873593) & M
+
+
+def _mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = _rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & M
+
+
+def _fmix(h1, n):
+    h1 ^= n
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & M
+    h1 ^= h1 >> 16
+    return h1
+
+
+def spark_hash_int(v, seed=42):
+    return _to_i32(_fmix(_mix_h1(seed & M, _mix_k1(v & M)), 4))
+
+
+def spark_hash_long(v, seed=42):
+    low = v & M
+    high = (v >> 32) & M
+    h1 = _mix_h1(seed & M, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _to_i32(_fmix(h1, 8))
+
+
+def spark_hash_bytes(data: bytes, seed=42):
+    h1 = seed & M
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        word = int.from_bytes(data[i * 4 : i * 4 + 4], "little")
+        h1 = _mix_h1(h1, _mix_k1(word))
+    for i in range(nblocks * 4, len(data)):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # sign-extended byte
+        h1 = _mix_h1(h1, _mix_k1(b & M))
+    return _to_i32(_fmix(h1, len(data)))
+
+
+def _to_i32(v):
+    v &= M
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+class TestMurmur3:
+    def test_int_longs(self, rng):
+        ints = rng.integers(-(2**31), 2**31, 50, dtype=np.int32)
+        got = ops.murmur3_column(Column.from_numpy(ints)).to_pylist()
+        want = [spark_hash_int(int(v)) for v in ints]
+        assert got == want
+
+        longs = rng.integers(-(2**62), 2**62, 50, dtype=np.int64)
+        got = ops.murmur3_column(Column.from_numpy(longs)).to_pylist()
+        want = [spark_hash_long(int(v)) for v in longs]
+        assert got == want
+
+    def test_doubles_floats(self, rng):
+        d = rng.standard_normal(20)
+        d[0] = -0.0  # Spark normalizes to +0.0
+        got = ops.murmur3_column(Column.from_numpy(d)).to_pylist()
+        want = [
+            spark_hash_long(
+                int(np.float64(0.0 if v == 0 else v).view(np.int64))
+            )
+            for v in d
+        ]
+        assert got == want
+
+        f = rng.standard_normal(20).astype(np.float32)
+        got = ops.murmur3_column(Column.from_numpy(f)).to_pylist()
+        want = [spark_hash_int(int(np.float32(v).view(np.int32))) for v in f]
+        assert got == want
+
+    def test_strings(self):
+        vals = ["", "a", "ab", "abc", "abcd", "abcde", "sparkly-tpu", "\xe9\xfc"]
+        col = Column.from_strings(vals)
+        got = ops.murmur3_column(col).to_pylist()
+        want = [
+            spark_hash_bytes(v.encode("utf-8", "surrogateescape")) for v in vals
+        ]
+        assert got == want
+
+    def test_null_passthrough_and_chain(self):
+        t = Table.from_pydict({"a": [1, None, 3], "b": [10, 20, 30]})
+        got = ops.murmur3_table(t).to_pylist()
+        want = []
+        for a, b in [(1, 10), (None, 20), (3, 30)]:
+            h = 42
+            if a is not None:
+                h = spark_hash_long(a, h) & M
+            h = spark_hash_long(b, h)
+            want.append(h)
+        assert got == want
+
+
+class TestBinaryOps:
+    def test_arith_nulls(self):
+        a = Table.from_pydict({"x": [1, None, 3, 4]})["x"]
+        b = Table.from_pydict({"x": [10, 20, None, 40]})["x"]
+        assert ops.add(a, b).to_pylist() == [11, None, None, 44]
+        assert ops.mul(a, b).to_pylist() == [10, None, None, 160]
+
+    def test_int_div_by_zero_is_null(self):
+        a = Column.from_numpy(np.array([10, 7, 5], dtype=np.int64))
+        b = Column.from_numpy(np.array([2, 0, 0], dtype=np.int64))
+        assert ops.div(a, b).to_pylist() == [5, None, None]
+
+    def test_float_div_by_zero_is_inf(self):
+        a = Column.from_numpy(np.array([1.0, -1.0]))
+        b = Column.from_numpy(np.array([0.0, 0.0]))
+        assert ops.div(a, b).to_pylist() == [np.inf, -np.inf]
+
+    def test_float64_storage_roundtrip_through_op(self):
+        a = Column.from_numpy(np.array([1.1, 2.2]))
+        out = ops.add(a, a)
+        np.testing.assert_allclose(out.to_numpy(), [2.2, 4.4])
+        assert out.dtype == dt.FLOAT64
+        assert out.data.dtype == np.uint64  # bit-pattern storage preserved
+
+    def test_comparisons(self):
+        a = Table.from_pydict({"x": [1, None, 3]})["x"]
+        b = Table.from_pydict({"x": [2, 2, 2]})["x"]
+        assert ops.lt(a, b).to_pylist() == [True, None, False]
+        assert ops.binary_op("null_safe_eq", a, a).to_pylist() == [
+            True,
+            True,
+            True,
+        ]
+        n1 = Table.from_pydict({"x": [None, 1]})["x"]
+        n2 = Table.from_pydict({"x": [None, None]})["x"]
+        assert ops.binary_op("null_safe_eq", n1, n2).to_pylist() == [
+            True,
+            False,
+        ]
+
+    def test_three_valued_logic(self):
+        tv = Table.from_pydict({"x": [True, False, None] * 3})["x"]
+        other = Table.from_pydict(
+            {"x": [True, True, True, False, False, False, None, None, None]}
+        )["x"]
+        # Spark: F AND NULL = F, T OR NULL = T
+        assert ops.binary_op("and", tv, other).to_pylist() == [
+            True, False, None, False, False, False, None, False, None,
+        ]
+        assert ops.binary_op("or", tv, other).to_pylist() == [
+            True, True, True, True, False, None, True, None, None,
+        ]
+
+    def test_decimal_add_rescale(self):
+        a = Column.from_numpy(
+            np.array([1234, 500], dtype=np.int32), dtype=dt.decimal32(-3)
+        )  # 1.234, 0.500
+        b = Column.from_numpy(
+            np.array([11, 22], dtype=np.int32), dtype=dt.decimal32(-1)
+        )  # 1.1, 2.2
+        out = ops.add(a, b)
+        assert out.dtype.scale == -3
+        assert out.to_pylist() == [2334, 2700]  # 2.334, 2.700
+
+    def test_decimal_mul(self):
+        a = Column.from_numpy(
+            np.array([150], dtype=np.int32), dtype=dt.decimal32(-2)
+        )  # 1.50
+        out = ops.mul(a, a)  # 2.25 at scale -2 -> 225... at combined scale -4 rescaled to -2
+        assert out.dtype.scale == -2
+        assert out.to_pylist() == [225]
+
+
+class TestUnaryCast:
+    def test_unary(self):
+        a = Column.from_numpy(np.array([-1.5, 4.0, None or 9.0]))
+        assert ops.unary_op("abs", a).to_pylist() == [1.5, 4.0, 9.0]
+        assert ops.unary_op("sqrt", a).to_pylist()[1] == 2.0
+        b = Table.from_pydict({"x": [1, None]})["x"]
+        assert ops.is_null(b).to_pylist() == [False, True]
+        assert ops.is_not_null(b).to_pylist() == [True, False]
+
+    def test_cast(self):
+        a = Column.from_numpy(np.array([1.9, -2.9]))
+        assert ops.cast(a, dt.INT32).to_pylist() == [1, -2]
+        b = Column.from_numpy(np.array([0, 3], dtype=np.int64))
+        assert ops.cast(b, dt.BOOL8).to_pylist() == [False, True]
+        d = Column.from_numpy(
+            np.array([1234], dtype=np.int32), dtype=dt.decimal32(-3)
+        )
+        assert ops.cast(d, dt.FLOAT64).to_pylist() == [pytest.approx(1.234)]
+        assert ops.cast(d, dt.decimal64(-1)).to_pylist() == [12]  # 1.2
+
+
+class TestReductions:
+    def test_basic(self, rng):
+        vals = rng.integers(-100, 100, 1000, dtype=np.int64)
+        valid = rng.random(1000) > 0.2
+        col = Column.from_numpy(vals, valid)
+        assert ops.reduce_column(col, "sum").to_pylist() == [
+            int(vals[valid].sum())
+        ]
+        assert ops.reduce_column(col, "min").to_pylist() == [
+            int(vals[valid].min())
+        ]
+        assert ops.reduce_column(col, "max").to_pylist() == [
+            int(vals[valid].max())
+        ]
+        assert ops.reduce_column(col, "count").to_pylist() == [
+            int(valid.sum())
+        ]
+        assert ops.reduce_column(col, "mean").to_pylist() == [
+            pytest.approx(vals[valid].mean())
+        ]
+
+    def test_all_null_sum_is_null(self):
+        col = Table.from_pydict({"x": [None, None]}, dtypes={"x": dt.INT64})
+        # object-list with all None: force int64 dtype
+        c = Column.from_numpy(
+            np.array([0, 0], dtype=np.int64), np.array([False, False])
+        )
+        assert ops.reduce_column(c, "sum").to_pylist() == [None]
+        assert ops.reduce_column(c, "count").to_pylist() == [0]
+
+
+class TestFilterGatherSort:
+    def test_filter(self, rng):
+        n = 500
+        t = Table.from_pydict(
+            {
+                "k": rng.integers(0, 10, n, dtype=np.int64),
+                "v": rng.standard_normal(n),
+            }
+        )
+        mask = ops.gt(t["k"], Column.from_numpy(np.full(n, 5, dtype=np.int64)))
+        out = ops.filter_table(t, mask)
+        kk = np.asarray(t["k"].data)
+        assert out.row_count == int((kk > 5).sum())
+        np.testing.assert_array_equal(
+            np.asarray(out["k"].data), kk[kk > 5]
+        )
+
+    def test_filter_capped(self, rng):
+        import jax
+
+        t = Table.from_pydict({"k": np.arange(100, dtype=np.int64)})
+        mask = Column(t["k"].data % 2 == 0, dt.BOOL8, None)
+        f = jax.jit(
+            lambda tbl, m: ops.filter_table_capped(tbl, m, capacity=64)
+        )
+        out, count = f(t, mask)
+        assert int(count) == 50
+        np.testing.assert_array_equal(
+            np.asarray(out["k"].data)[:50], np.arange(0, 100, 2)
+        )
+
+    def test_sort_multi_key_nulls(self):
+        t = Table.from_pydict(
+            {
+                "a": [2, 1, None, 1, 2],
+                "b": [1.0, 9.0, 5.0, 7.0, None],
+            }
+        )
+        out = ops.sort_table(
+            t, [SortKey("a"), SortKey("b", ascending=False)]
+        )
+        # default: asc nulls first for a; desc nulls last for b
+        assert out["a"].to_pylist() == [None, 1, 1, 2, 2]
+        assert out["b"].to_pylist() == [5.0, 9.0, 7.0, 1.0, None]
+
+    def test_sort_float_total_order(self):
+        vals = np.array([1.5, -2.0, np.nan, np.inf, -np.inf, 0.0, -0.0])
+        t = Table([Column.from_numpy(vals)])
+        out = ops.sort_table(t, [SortKey(0)])
+        got = np.asarray(out[0].to_numpy())
+        # NaN last (Spark order); -0.0 before 0.0
+        assert np.isnan(got[-1])
+        np.testing.assert_array_equal(
+            got[:-1], np.array([-np.inf, -2.0, -0.0, 0.0, 1.5, np.inf])
+        )
+        assert np.signbit(got[2])
+
+    def test_sort_strings(self):
+        t = Table([Column.from_strings(["pear", "apple", "fig", None, "app"])])
+        out = ops.sort_table(t, [SortKey(0, nulls_first=False)])
+        assert out[0].to_pylist() == ["app", "apple", "fig", "pear", None]
+
+
+class TestGroupby:
+    def test_sum_count_vs_pandas(self, rng):
+        pd = pytest.importorskip("pandas")
+        n = 2000
+        k = rng.integers(0, 50, n, dtype=np.int64)
+        v = rng.standard_normal(n)
+        vvalid = rng.random(n) > 0.1
+        t = Table(
+            [Column.from_numpy(k), Column.from_numpy(v, vvalid)], ["k", "v"]
+        )
+        out = ops.groupby_aggregate(
+            t,
+            ["k"],
+            [
+                GroupbyAgg("v", "sum"),
+                GroupbyAgg("v", "count"),
+                GroupbyAgg("v", "min"),
+                GroupbyAgg("v", "max"),
+                GroupbyAgg("v", "mean"),
+            ],
+        )
+        df = pd.DataFrame({"k": k, "v": np.where(vvalid, v, np.nan)})
+        want = df.groupby("k")["v"].agg(["sum", "count", "min", "max", "mean"])
+        got_k = out["k"].to_pylist()
+        assert got_k == sorted(set(k.tolist()))
+        np.testing.assert_allclose(
+            np.asarray(out["sum_v"].to_numpy()), want["sum"].values, rtol=1e-12
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["count_v"].data), want["count"].values
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["mean_v"].to_numpy()), want["mean"].values, rtol=1e-12
+        )
+
+    def test_null_key_group(self):
+        t = Table.from_pydict({"k": [1, None, 1, None], "v": [1, 2, 3, 4]})
+        out = ops.groupby_aggregate(t, ["k"], [GroupbyAgg("v", "sum")])
+        d = dict(zip(out["k"].to_pylist(), out["sum_v"].to_pylist()))
+        assert d == {None: 6, 1: 4}
+
+    def test_multi_key(self):
+        t = Table.from_pydict(
+            {
+                "a": [1, 1, 2, 2, 1],
+                "b": ["x", "y", "x", "x", "x"],
+                "v": [10, 20, 30, 40, 50],
+            }
+        )
+        out = ops.groupby_aggregate(t, ["a", "b"], [GroupbyAgg("v", "sum")])
+        got = {
+            (a, b): s
+            for a, b, s in zip(
+                out["a"].to_pylist(),
+                out["b"].to_pylist(),
+                out["sum_v"].to_pylist(),
+            )
+        }
+        assert got == {(1, "x"): 60, (1, "y"): 20, (2, "x"): 70}
+
+
+class TestJoin:
+    def test_inner_vs_pandas(self, rng):
+        pd = pytest.importorskip("pandas")
+        nl, nr = 300, 200
+        lk = rng.integers(0, 40, nl, dtype=np.int64)
+        rk = rng.integers(0, 40, nr, dtype=np.int64)
+        lv = rng.standard_normal(nl)
+        rv = rng.standard_normal(nr)
+        left = Table(
+            [Column.from_numpy(lk), Column.from_numpy(lv)], ["k", "lv"]
+        )
+        right = Table(
+            [Column.from_numpy(rk), Column.from_numpy(rv)], ["k", "rv"]
+        )
+        out = ops.inner_join(left, right, ["k"])
+        want = pd.merge(
+            pd.DataFrame({"k": lk, "lv": lv}),
+            pd.DataFrame({"k": rk, "rv": rv}),
+            on="k",
+        )
+        assert out.row_count == len(want)
+        got = sorted(
+            zip(
+                out["k"].to_pylist(),
+                out["lv"].to_pylist(),
+                out["rv"].to_pylist(),
+            )
+        )
+        expect = sorted(
+            zip(want["k"].tolist(), want["lv"].tolist(), want["rv"].tolist())
+        )
+        for g, e in zip(got, expect):
+            assert g[0] == e[0]
+            assert g[1] == pytest.approx(e[1])
+            assert g[2] == pytest.approx(e[2])
+
+    def test_nulls_never_match(self):
+        left = Table.from_pydict({"k": [1, None, 3]})
+        right = Table.from_pydict({"k": [1, None, 1]})
+        out = ops.inner_join(left, right, ["k"])
+        assert out["k"].to_pylist() == [1, 1]
+
+    def test_left_join(self):
+        left = Table.from_pydict({"k": [1, 2, None], "lv": [10, 20, 30]})
+        right = Table.from_pydict({"k": [1, 1], "rv": [100, 200]})
+        out = ops.left_join(left, right, ["k"])
+        rows = sorted(
+            zip(
+                out["k"].to_pylist(),
+                out["lv"].to_pylist(),
+                out["rv"].to_pylist(),
+            ),
+            key=lambda r: (r[0] is None, r),
+        )
+        assert rows == [
+            (1, 10, 100),
+            (1, 10, 200),
+            (2, 20, None),
+            (None, 30, None),
+        ]
+
+    def test_semi_anti(self):
+        left = Table.from_pydict({"k": [1, 2, 3, None]})
+        right = Table.from_pydict({"k": [2, 3]})
+        assert ops.semi_join(left, right, ["k"])["k"].to_pylist() == [2, 3]
+        assert ops.anti_join(left, right, ["k"])["k"].to_pylist() == [1, None]
+
+    def test_string_key_join(self):
+        left = Table.from_pydict({"k": ["apple", "fig", "pear"], "v": [1, 2, 3]})
+        right = Table.from_pydict({"k": ["fig", "apple"], "w": [10, 20]})
+        out = ops.inner_join(left, right, ["k"])
+        got = sorted(zip(out["k"].to_pylist(), out["v"].to_pylist(), out["w"].to_pylist()))
+        assert got == [("apple", 1, 20), ("fig", 2, 10)]
+
+    def test_capped_jit(self, rng):
+        import jax
+
+        left = Table.from_pydict({"k": [1, 2, 2, 5], "v": [1, 2, 3, 4]})
+        right = Table.from_pydict({"k": [2, 2, 5], "w": [7, 8, 9]})
+        from spark_rapids_jni_tpu.ops.join import inner_join_capped
+
+        f = jax.jit(
+            lambda l, r: inner_join_capped(l, r, ["k"], capacity=16)
+        )
+        out, count = f(left, right)
+        assert int(count) == 5
+        rows = sorted(
+            (k, v, w)
+            for k, v, w, ok in zip(
+                out["k"].to_pylist(),
+                out["v"].to_pylist(),
+                out["w"].to_pylist(),
+                range(16),
+            )
+            if k is not None
+        )
+        assert rows == [(2, 2, 7), (2, 2, 8), (2, 3, 7), (2, 3, 8), (5, 4, 9)]
+
+
+class TestPartition:
+    def test_hash_partition_counts(self, rng):
+        n = 1000
+        t = Table.from_pydict(
+            {"k": rng.integers(0, 1000, n, dtype=np.int64)}
+        )
+        out, counts = ops.hash_partition(t, ["k"], 8)
+        assert int(np.asarray(counts).sum()) == n
+        # partition ids must match Spark's pmod(murmur3) exactly
+        part = np.array(
+            [spark_hash_long(int(v)) % 8 for v in np.asarray(t["k"].data)]
+        )
+        part = (part + 8) % 8
+        want = np.bincount(part, minlength=8)
+        np.testing.assert_array_equal(np.asarray(counts), want)
+
+    def test_round_robin(self):
+        t = Table.from_pydict({"k": np.arange(10, dtype=np.int64)})
+        out, counts = ops.round_robin_partition(t, 3)
+        np.testing.assert_array_equal(np.asarray(counts), [4, 3, 3])
+
+
+class TestStrings:
+    def test_basics(self):
+        c = Column.from_strings(["Hello", "WORLD", None, "tpu123"])
+        assert str_ops.length(c).to_pylist() == [5, 5, None, 6]
+        assert str_ops.upper(c).to_pylist() == ["HELLO", "WORLD", None, "TPU123"]
+        assert str_ops.lower(c).to_pylist() == ["hello", "world", None, "tpu123"]
+
+    def test_contains_startswith_endswith(self):
+        c = Column.from_strings(["spark", "rapids", "sparkly", "park", None])
+        assert str_ops.contains(c, "ark").to_pylist() == [
+            True, False, True, True, None,
+        ]
+        assert str_ops.starts_with(c, "spark").to_pylist() == [
+            True, False, True, False, None,
+        ]
+        assert str_ops.ends_with(c, "rk").to_pylist() == [
+            True, False, False, True, None,
+        ]
+
+    def test_substring_concat(self):
+        a = Column.from_strings(["hello", "ab"])
+        assert str_ops.substring(a, 1, 3).to_pylist() == ["ell", "b"]
+        b = Column.from_strings(["-x", "-yz"])
+        assert str_ops.concat(a, b).to_pylist() == ["hello-x", "ab-yz"]
+
+    def test_compare(self):
+        a = Column.from_strings(["apple", "fig", "zz"])
+        b = Column.from_strings(["apricot", "fig", "aa"])
+        assert ops.binary_op("lt", a, b).to_pylist() == [True, False, False]
+        assert ops.binary_op("eq", a, b).to_pylist() == [False, True, False]

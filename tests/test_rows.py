@@ -188,3 +188,9 @@ class TestRoundTrip:
         assert lay.row_size == 8  # 1 data + 1 validity -> pad to 8
         back = rows.from_rows(rows.to_rows(t))
         assert back[0].to_pylist() == [1, 0, 255]
+
+    def test_empty_table_round_trip(self):
+        t = Table([Column.from_numpy(np.array([], dtype=np.int64))])
+        back = rows.from_rows(rows.to_rows(t))
+        assert back.row_count == 0
+        assert back[0].dtype == dt.INT64
