@@ -1,0 +1,95 @@
+"""Benchmark: hash groupby-sum, 1M int64 rows (BASELINE.json config 1).
+
+Measures the device groupby (sort-based, jitted, capped variant — no host
+syncs inside the timed region) against the CPU Arrow reference
+(pyarrow.Table.group_by), the baseline named in BASELINE.json. Prints one
+JSON line:
+  {"metric": ..., "value": rows/sec on device, "unit": "rows/s",
+   "vs_baseline": device_throughput / arrow_throughput}
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import spark_rapids_jni_tpu as srt
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import (
+        GroupbyAgg,
+        groupby_aggregate_capped,
+    )
+
+    n = 1_000_000
+    n_keys = 10_000
+    rng = np.random.default_rng(42)
+    k = rng.integers(0, n_keys, n, dtype=np.int64)
+    v = rng.integers(-1000, 1000, n, dtype=np.int64)
+
+    table = Table(
+        [Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"]
+    )
+    # materialize on device before timing
+    jax.block_until_ready(table.columns[0].data)
+
+    step = jax.jit(
+        lambda t: groupby_aggregate_capped(
+            t,
+            ["k"],
+            [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")],
+            num_segments=n_keys,
+        )
+    )
+    # warmup/compile
+    out = step(table)
+    jax.block_until_ready(out)
+
+    reps = 10
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = step(table)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    device_rows_per_s = n / best
+
+    # CPU Arrow baseline
+    try:
+        import pyarrow as pa
+
+        atbl = pa.table({"k": k, "v": v})
+        # warmup
+        atbl.group_by("k").aggregate([("v", "sum"), ("v", "count")])
+        abest = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            atbl.group_by("k").aggregate([("v", "sum"), ("v", "count")])
+            abest = min(abest, time.perf_counter() - t0)
+        arrow_rows_per_s = n / abest
+        vs = device_rows_per_s / arrow_rows_per_s
+    except ImportError:  # pragma: no cover
+        vs = float("nan")
+
+    # sanity: totals must agree
+    agg, ngroups = out
+    total = int(np.asarray(agg["sum_v"].data)[: int(ngroups)].sum())
+    assert total == int(v.sum()), "groupby-sum mismatch vs numpy"
+
+    print(
+        json.dumps(
+            {
+                "metric": "groupby_sum_1M_int64",
+                "value": round(device_rows_per_s, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

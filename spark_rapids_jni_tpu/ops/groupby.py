@@ -42,9 +42,20 @@ class GroupbyAgg:
             raise ValueError(f"unknown aggregation {self.op!r}")
 
 
-def _segment_ids(key_cols: Sequence[Column]):
-    """(perm, seg_ids, num_groups_device): stable sort + boundary scan."""
+def _segment_ids(
+    key_cols: Sequence[Column], row_valid: Optional[jax.Array] = None
+):
+    """(perm, seg_ids, num_groups_device): stable sort + boundary scan.
+
+    ``row_valid`` excludes rows entirely (shuffle-padding occupancy): the
+    leading occupancy word sorts them behind every real row, where their
+    garbage keys may split into any number of trailing segments; the group
+    count is therefore the highest segment id holding a valid row.
+    """
     words: list[jax.Array] = []
+    if row_valid is not None:
+        # invalid rows last: word 0 for valid, 1 for padding
+        words.append(jnp.where(row_valid, jnp.uint64(0), jnp.uint64(1)))
     for c in key_cols:
         if c.validity is not None:
             # null key rows group together: validity is a key word and null
@@ -64,14 +75,30 @@ def _segment_ids(key_cols: Sequence[Column]):
             [jnp.ones((1,), jnp.bool_), w[1:] != w[:-1]]
         )
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    return perm, seg, seg[-1] + 1
+    if row_valid is not None:
+        # Padding rows sort behind every real row (leading occupancy word)
+        # but can form any number of trailing garbage segments — the real
+        # group count is the highest segment id holding a valid row.
+        num_groups = jnp.max(
+            jnp.where(row_valid[perm], seg + 1, 0)
+        )
+    else:
+        num_groups = seg[-1] + 1
+    return perm, seg, num_groups
 
 
 def _aggregate_segment(
-    col: Column, op: str, perm, seg, num_segments: int
+    col: Column,
+    op: str,
+    perm,
+    seg,
+    num_segments: int,
+    row_valid: Optional[jax.Array] = None,
 ) -> Column:
     vals = compute.values(col)[perm]
     valid = compute.valid_mask(col)[perm]
+    if row_valid is not None:
+        valid = jnp.logical_and(valid, row_valid[perm])
     n_valid = jax.ops.segment_sum(
         valid.astype(jnp.int64), seg, num_segments=num_segments
     )
@@ -119,13 +146,15 @@ def groupby_aggregate_capped(
     by: Sequence[Union[int, str]],
     aggs: Sequence[GroupbyAgg],
     num_segments: int,
+    row_valid: Optional[jax.Array] = None,
 ) -> tuple[Table, jax.Array]:
     """Jittable groupby: (padded result of ``num_segments`` rows, count).
 
     Padding rows have null keys/values (validity False past the count).
+    ``row_valid`` excludes rows (e.g. shuffle-padding occupancy).
     """
     key_cols = [table.column(c) for c in by]
-    perm, seg, num_groups = _segment_ids(key_cols)
+    perm, seg, num_groups = _segment_ids(key_cols, row_valid)
 
     # representative (first) sorted row of each segment -> key values
     n = table.row_count
@@ -150,7 +179,7 @@ def groupby_aggregate_capped(
 
     for agg in aggs:
         col = table.column(agg.column)
-        r = _aggregate_segment(col, agg.op, perm, seg, num_segments)
+        r = _aggregate_segment(col, agg.op, perm, seg, num_segments, row_valid)
         valid = jnp.logical_and(compute.valid_mask(r), in_range)
         out_cols.append(Column(r.data, r.dtype, valid, r.lengths))
         base = (

@@ -78,12 +78,25 @@ def _match_ranges(
     right: Table,
     left_on: Sequence[Union[int, str]],
     right_on: Sequence[Union[int, str]],
+    left_valid: Optional[jax.Array] = None,
+    right_valid: Optional[jax.Array] = None,
 ):
-    """Per-left-row [lo, hi) match range into the sorted right side."""
+    """Per-left-row [lo, hi) match range into the sorted right side.
+
+    ``left_valid``/``right_valid`` exclude rows entirely (shuffle-padding
+    occupancy) — excluded rows behave like null keys and never match:
+    invalid left rows get their counts zeroed, and invalid right rows sort
+    ahead of every valid row on the leading validity word (0 < 1), outside
+    the range any valid query (probing with lead word 1) can reach.
+    """
     lcols = [left.column(c) for c in left_on]
     rcols = [right.column(c) for c in right_on]
     lwords, lvalid = _key_words(lcols)
     rwords, rvalid = _key_words(rcols)
+    if left_valid is not None:
+        lvalid = lvalid & left_valid
+    if right_valid is not None:
+        rvalid = rvalid & right_valid
 
     # sort right by (valid, words) so invalid rows sink to the front and
     # can never fall inside a valid query's range
@@ -156,11 +169,15 @@ def inner_join_capped(
     on: Sequence[Union[int, str]],
     capacity: int,
     right_on: Optional[Sequence[Union[int, str]]] = None,
+    left_valid: Optional[jax.Array] = None,
+    right_valid: Optional[jax.Array] = None,
 ) -> tuple[Table, jax.Array]:
     """Jittable inner join with static output capacity; returns (padded
     table, device match count). Pairs past the count are padding."""
     right_on = right_on or on
-    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    perm_r, lo, counts, _ = _match_ranges(
+        left, right, on, right_on, left_valid, right_valid
+    )
     left_idx, right_idx, matched, in_range = _expand(
         perm_r, lo, counts, capacity, left_outer=False
     )

@@ -1,0 +1,26 @@
+"""Multi-chip execution: mesh management and the shuffle-exchange backend.
+
+The reference repo ships only per-GPU kernels; partition exchange lives in
+the downstream spark-rapids plugin's UCX/NCCL shuffle manager (SURVEY.md
+§2.5). Here the exchange is a first-class component: Spark-compatible hash
+partitioning (ops/partition.py) + ``jax.lax.all_to_all`` over the mesh's
+ICI axis under ``shard_map``, with XLA inserting the collective schedule.
+"""
+
+from .mesh import make_mesh, shard_table, replicate_table, local_shards
+from .shuffle import exchange, shuffle_table
+from .distributed import (
+    distributed_groupby,
+    distributed_inner_join,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_table",
+    "replicate_table",
+    "local_shards",
+    "exchange",
+    "shuffle_table",
+    "distributed_groupby",
+    "distributed_inner_join",
+]

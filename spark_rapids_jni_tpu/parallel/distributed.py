@@ -1,0 +1,112 @@
+"""Distributed relational ops: shuffle + local capped ops under shard_map.
+
+The multi-chip join/aggregation path the GPU stack assembles from
+GpuShuffleExchangeExec + per-GPU cudf kernels, here as single jittable
+SPMD computations: hash-exchange co-partitions rows over ICI, then each
+chip runs the local sort-based op on its partition with padding rows
+masked by occupancy. Results stay device-resident and sharded (each chip
+owns its key range by hash), exactly how a Spark stage chain consumes
+them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..column import Table
+from ..ops.groupby import GroupbyAgg, groupby_aggregate_capped
+from ..ops.join import inner_join_capped
+from .mesh import SHUFFLE_AXIS, shard_table
+from .shuffle import exchange_by_hash
+
+
+def distributed_groupby(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+    groups_per_device: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+):
+    """Shuffle-then-aggregate GROUP BY over the mesh.
+
+    Returns (sharded padded result table, per-device group counts (P,),
+    per-device shuffle overflow (P,)). Groups are complete: each key lives
+    on exactly one device, by Spark hash partitioning.
+    """
+    num = int(mesh.shape[axis])
+    per_dev = table.row_count // num
+    cap = capacity or max(2 * per_dev // num, 16)
+    seg_cap = groups_per_device or num * cap
+    sharded = shard_table(table, mesh, axis)
+
+    def body(local: Table):
+        shuffled, occ, overflow = exchange_by_hash(local, by, num, cap, axis)
+        agg, ngroups = groupby_aggregate_capped(
+            shuffled, by, aggs, num_segments=seg_cap, row_valid=occ
+        )
+        return agg, ngroups[None], overflow[None]
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return fn(sharded)
+
+
+def distributed_inner_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+    out_capacity: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+):
+    """Shuffle-shuffle hash-partitioned inner join over the mesh.
+
+    Both sides are hash-exchanged on the join keys (co-partitioning), then
+    each chip joins its partitions locally. Returns (sharded padded join
+    output, per-device match counts, left/right shuffle overflows).
+    """
+    num = int(mesh.shape[axis])
+    lcap = capacity or max(2 * (left.row_count // num) // num, 16)
+    rcap = capacity or max(2 * (right.row_count // num) // num, 16)
+    ocap = out_capacity or 4 * max(lcap, rcap) * num
+    lsh = shard_table(left, mesh, axis)
+    rsh = shard_table(right, mesh, axis)
+
+    def body(l_local: Table, r_local: Table):
+        ls, locc, lov = exchange_by_hash(l_local, on, num, lcap, axis)
+        rs, rocc, rov = exchange_by_hash(r_local, on, num, rcap, axis)
+        out, count = inner_join_capped(
+            ls,
+            rs,
+            on,
+            capacity=ocap,
+            left_valid=locc,
+            right_valid=rocc,
+        )
+        return out, count[None], lov[None], rov[None]
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return fn(lsh, rsh)

@@ -1,0 +1,71 @@
+"""Device-mesh helpers: row-sharded tables over a 1-D (or the flattened
+ICI) mesh — the unit of shuffle parallelism, one shard per chip.
+
+On a v5e-8 pod slice this is an 8-way axis over ICI; across pods a second
+DCN axis can be added (mesh shape (pods, chips_per_pod)) and the exchange
+keeps partition-heavy traffic on the inner (ICI) axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..column import Column, Table
+
+SHUFFLE_AXIS = "shuffle"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, axis: str = SHUFFLE_AXIS
+) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def _row_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_table(table: Table, mesh: Mesh, axis: str = SHUFFLE_AXIS) -> Table:
+    """Row-shard every buffer across the mesh (dim 0 split, rest replicated).
+
+    Row count must divide evenly by the axis size (pad upstream if not —
+    the IO layer produces evenly-split batches).
+    """
+    n = table.row_count
+    size = mesh.shape[axis]
+    if n % size:
+        raise ValueError(
+            f"row count {n} not divisible by mesh axis size {size}"
+        )
+    sharding = _row_sharding(mesh, axis)
+
+    def put(x):
+        if x is None:
+            return None
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, table)
+
+
+def replicate_table(table: Table, mesh: Mesh) -> Table:
+    """Fully replicate a (small, e.g. dimension) table on every device."""
+    return jax.tree_util.tree_map(
+        lambda x: None
+        if x is None
+        else jax.device_put(x, NamedSharding(mesh, P())),
+        table,
+    )
+
+
+def local_shards(table: Table) -> int:
+    """Number of addressable shards of the first buffer (introspection)."""
+    return len(table.columns[0].data.addressable_shards)

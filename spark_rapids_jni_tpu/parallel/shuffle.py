@@ -1,0 +1,135 @@
+"""Shuffle exchange: the ICI all-to-all replacement for the RAPIDS
+UCX/NCCL shuffle manager (SURVEY.md §2.5, §5.8).
+
+``exchange`` is called *inside* ``shard_map``: each device buckets its
+local rows by Spark-compatible partition id (pmod(murmur3)), packs them
+into fixed-capacity per-destination send buffers, and one
+``jax.lax.all_to_all`` moves every bucket to its owner over ICI. Fixed
+capacity keeps shapes static for XLA (the shuffle-side instance of the
+two-phase discipline); received padding is tracked with an occupancy mask
+that downstream capped ops treat as absent rows.
+
+``shuffle_table`` is the host-level wrapper: shard -> shard_map(exchange)
+-> globally sharded padded table + occupancy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..column import Column, Table
+from ..ops.partition import partition_ids_hash
+from .mesh import SHUFFLE_AXIS, shard_table
+
+
+def exchange(
+    local: Table,
+    dest: jax.Array,
+    num_partitions: int,
+    capacity: int,
+    axis: str = SHUFFLE_AXIS,
+    row_valid: Optional[jax.Array] = None,
+):
+    """All-to-all one device's rows to their destination partitions.
+
+    Must run inside ``shard_map`` over ``axis`` (axis size ==
+    ``num_partitions``). Returns (received table padded to
+    ``num_partitions * capacity`` rows, occupancy mask, overflow counts):
+    rows beyond ``capacity`` per (src, dst) pair are DROPPED — callers
+    size ``capacity`` from the partitioning stats and must check
+    ``overflow`` (max per-dest count) when in doubt.
+    """
+    n = local.row_count
+    ok = (
+        row_valid
+        if row_valid is not None
+        else jnp.ones((n,), dtype=jnp.bool_)
+    )
+    # invalid rows -> bucket num_partitions (beyond every real partition)
+    dest = jnp.where(ok, dest, num_partitions).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True).astype(jnp.int32)
+    counts = jnp.bincount(dest, length=num_partitions + 1)[
+        :num_partitions
+    ].astype(jnp.int32)
+    start = jnp.cumsum(counts) - counts
+
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    flat_idx = jnp.clip(start[:, None] + j[None, :], 0, max(n - 1, 0))
+    idx = order[flat_idx]  # (P, cap) source row per slot
+    slot_valid = j[None, :] < jnp.minimum(counts[:, None], capacity)
+
+    def pack(x):
+        if x is None:
+            return None
+        return x[idx]  # (P, cap, ...)
+
+    send = jax.tree_util.tree_map(pack, local)
+    recv = jax.tree_util.tree_map(
+        lambda x: None
+        if x is None
+        else jax.lax.all_to_all(x, axis, 0, 0),
+        send,
+    )
+    recv_valid = jax.lax.all_to_all(slot_valid, axis, 0, 0)
+
+    def flatten(x):
+        if x is None:
+            return None
+        return x.reshape((num_partitions * capacity,) + x.shape[2:])
+
+    out = jax.tree_util.tree_map(flatten, recv)
+    occupancy = recv_valid.reshape((num_partitions * capacity,))
+    overflow = jnp.max(counts) - capacity  # > 0 => rows were dropped
+    return out, occupancy, overflow
+
+
+def exchange_by_hash(
+    local: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    num_partitions: int,
+    capacity: int,
+    axis: str = SHUFFLE_AXIS,
+    row_valid: Optional[jax.Array] = None,
+):
+    """exchange() keyed by Spark hash partitioning of ``columns``."""
+    dest = partition_ids_hash(local, columns, num_partitions)
+    return exchange(local, dest, num_partitions, capacity, axis, row_valid)
+
+
+def shuffle_table(
+    table: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+):
+    """Host-level shuffle: row-shard ``table`` and hash-exchange it.
+
+    Returns (globally sharded padded table, occupancy column, overflow).
+    ``capacity`` defaults to 2x the perfectly-balanced per-pair share.
+    """
+    num = int(mesh.shape[axis])
+    sharded = shard_table(table, mesh, axis)
+    per_dev = table.row_count // num
+    if capacity is None:
+        capacity = max(2 * per_dev // num, 16)
+
+    def run(local):
+        out, occ, overflow = exchange_by_hash(
+            local, columns, num, capacity, axis
+        )
+        return out, occ, overflow[None]
+
+    fn = shard_map(
+        run, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False
+    )
+    return fn(sharded)
