@@ -30,11 +30,31 @@ from .column import Column, Table
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # Lazy subpackage access (keeps `import spark_rapids_jni_tpu` light and
+    # avoids import cycles: io/parallel/ops pull in the op library).
+    if name in (
+        "io",
+        "ops",
+        "parallel",
+        "utils",
+        "interop",
+        "rows",
+        "factories",
+    ):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "dtype",
     "DType",
     "TypeId",
     "Column",
     "Table",
+    "io",
     "__version__",
 ]

@@ -1,0 +1,49 @@
+"""Columnar file I/O: the TPU-native analog of cudf's io layer.
+
+The reference artifact ships compressed columnar file decode (Parquet/ORC
+et al.) via libcudf + nvcomp + optional GPUDirect Storage (SURVEY.md §2.3:
+nvcomp include CMakeLists.txt:91, USE_GDS pom.xml:84; parquet-avro +
+hadoop-common test deps pom.xml:112-123 feed the cudf Java I/O tests).
+
+TPU-first shape (SURVEY.md §7 Phase 4): *host* decode (Arrow readers —
+the nvcomp analog is Arrow's codec layer) feeding **async HBM uploads**,
+with two-level predicate pushdown:
+
+1. coarse: row-group/stripe pruning against file-footer statistics on the
+   host (no decode, no upload for pruned groups), and
+2. exact: residual predicate evaluated **on device** over the uploaded
+   batch with the columnar op library (filter.py), where the TPU is fast.
+
+Later rounds can move fixed-width/dictionary page decode itself into
+Pallas; the interface here (scan -> Table batches) is already shaped for
+that swap.
+"""
+
+from .predicates import Predicate, and_, or_, col  # noqa: F401
+from .parquet import (  # noqa: F401
+    read_parquet,
+    scan_parquet,
+    write_parquet,
+    parquet_metadata,
+)
+from .orc import read_orc, scan_orc, write_orc  # noqa: F401
+from .csv import read_csv, write_csv  # noqa: F401
+from .ipc import read_arrow_ipc, write_arrow_ipc  # noqa: F401
+
+__all__ = [
+    "Predicate",
+    "and_",
+    "or_",
+    "col",
+    "read_parquet",
+    "scan_parquet",
+    "write_parquet",
+    "parquet_metadata",
+    "read_orc",
+    "scan_orc",
+    "write_orc",
+    "read_csv",
+    "write_csv",
+    "read_arrow_ipc",
+    "write_arrow_ipc",
+]

@@ -1,0 +1,91 @@
+"""CSV read/write (host parse -> HBM upload).
+
+Parity with the CSV surface of the cudf Java API the reference ships
+(``Table.readCSV``/``writeCSVToFile`` in the vendored cudf test tree,
+SURVEY.md §2.3 relational-ops row). Parsing runs on host via Arrow's
+multithreaded CSV reader; typed columns then upload once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..column import Table
+from ..utils.tracing import trace_range
+from . import predicates as preds
+
+try:
+    import pyarrow as pa
+    import pyarrow.csv as pa_csv
+except ImportError:  # pragma: no cover
+    pa = pa_csv = None
+
+
+def _require():
+    if pa_csv is None:  # pragma: no cover
+        raise ImportError("pyarrow.csv not available")
+
+
+def read_csv(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    delimiter: str = ",",
+    header: bool = True,
+    column_names: Optional[Sequence[str]] = None,
+    dtypes: Optional[dict] = None,
+    pad_widths: Optional[dict] = None,
+) -> Table:
+    """CSV file -> device Table (optional projection + device filter)."""
+    _require()
+    from ..interop import table_from_arrow
+    from .parquet import _apply_exact_filter
+
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    read_opts = pa_csv.ReadOptions(
+        column_names=list(column_names) if column_names else None,
+        autogenerate_column_names=not header and column_names is None,
+        # with explicit names, the file's header line (if any) is data to
+        # pyarrow — skip it ourselves
+        skip_rows=1 if (header and column_names) else 0,
+    )
+    parse_opts = pa_csv.ParseOptions(delimiter=delimiter)
+    convert_opts = pa_csv.ConvertOptions(
+        column_types={k: v for k, v in (dtypes or {}).items()},
+        include_columns=None,  # project after read: predicate may need more
+    )
+    with trace_range("io.csv.parse"):
+        atbl = pa_csv.read_csv(
+            path,
+            read_options=read_opts,
+            parse_options=parse_opts,
+            convert_options=convert_opts,
+        )
+    want = list(columns) if columns is not None else atbl.column_names
+    read_cols = want
+    if predicate is not None:
+        extra = [c for c in sorted(predicate.columns()) if c not in want]
+        read_cols = want + extra
+    atbl = atbl.select(read_cols)
+    with trace_range("io.csv.upload"):
+        dev = table_from_arrow(atbl, pad_widths=pad_widths)
+    if predicate is not None:
+        with trace_range("io.csv.filter"):
+            dev = _apply_exact_filter(dev, predicate, want)
+    return dev
+
+
+def write_csv(table: Table, path, delimiter: str = ",", header: bool = True) -> None:
+    """Device Table -> CSV file."""
+    _require()
+    from ..interop import table_to_arrow
+
+    with trace_range("io.csv.write"):
+        atbl = table_to_arrow(table)
+        pa_csv.write_csv(
+            atbl,
+            path,
+            write_options=pa_csv.WriteOptions(
+                include_header=header, delimiter=delimiter
+            ),
+        )

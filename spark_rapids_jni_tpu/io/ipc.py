@@ -1,0 +1,53 @@
+"""Arrow IPC (Feather v2 / stream) read & write.
+
+Arrow is the wire format of the whole framework (SURVEY.md §2.3 "Arrow
+interop": the reference builds static Arrow into libcudf,
+CUDF_USE_ARROW_STATIC=ON at build-libcudf.xml:41). IPC files are the
+spill/exchange format between host processes — e.g. a Spark executor
+handing batches to the TPU runtime out-of-process.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..column import Table
+from ..utils.tracing import trace_range
+
+try:
+    import pyarrow as pa
+    import pyarrow.ipc as pa_ipc
+except ImportError:  # pragma: no cover
+    pa = pa_ipc = None
+
+
+def _require():
+    if pa_ipc is None:  # pragma: no cover
+        raise ImportError("pyarrow.ipc not available")
+
+
+def read_arrow_ipc(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    pad_widths: Optional[dict] = None,
+) -> Table:
+    _require()
+    from ..interop import table_from_arrow
+
+    with trace_range("io.ipc.read"):
+        with pa_ipc.open_file(path) as reader:
+            atbl = reader.read_all()
+    if columns is not None:
+        atbl = atbl.select(list(columns))
+    with trace_range("io.ipc.upload"):
+        return table_from_arrow(atbl, pad_widths=pad_widths)
+
+
+def write_arrow_ipc(table: Table, path) -> None:
+    _require()
+    from ..interop import table_to_arrow
+
+    with trace_range("io.ipc.write"):
+        atbl = table_to_arrow(table)
+        with pa_ipc.new_file(path, atbl.schema) as writer:
+            writer.write_table(atbl)

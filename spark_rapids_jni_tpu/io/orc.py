@@ -1,0 +1,99 @@
+"""ORC scan/write (host Arrow decode -> HBM; stripe-granular streaming).
+
+Capability parity with the ORC half of the reference's columnar I/O
+surface (SURVEY.md §2.3 "Compressed columnar file I/O"; the cudf Java test
+tree the reference runs covers ORC round trips). The host decoder
+(pyarrow.orc) does not expose per-stripe statistics to Python, so pruning
+is file-granular only; exact predicate filtering still runs on device,
+which keeps results identical to the Parquet path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+from ..column import Table
+from ..utils.tracing import trace_range
+from . import predicates as preds
+
+try:
+    import pyarrow as pa
+    import pyarrow.orc as pa_orc
+except ImportError:  # pragma: no cover
+    pa = pa_orc = None
+
+
+def _require():
+    if pa_orc is None:  # pragma: no cover
+        raise ImportError("pyarrow.orc not available")
+
+
+def _read_columns(predicate, columns, all_names):
+    want = list(columns) if columns is not None else list(all_names)
+    read_cols = want
+    if predicate is not None:
+        extra = [c for c in sorted(predicate.columns()) if c not in want]
+        read_cols = want + extra
+    return want, read_cols
+
+
+def scan_orc(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    pad_widths: Optional[dict] = None,
+    exact_filter: bool = True,
+) -> Iterator[Table]:
+    """Stream an ORC file stripe-by-stripe as device Tables."""
+    _require()
+    from ..interop import table_from_arrow
+    from .parquet import _apply_exact_filter
+
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    f = pa_orc.ORCFile(path)
+    want, read_cols = _read_columns(predicate, columns, f.schema.names)
+    for i in range(f.nstripes):
+        with trace_range("io.orc.decode"):
+            batch = f.read_stripe(i, columns=read_cols)
+            atbl = pa.Table.from_batches([batch])
+        with trace_range("io.orc.upload"):
+            dev = table_from_arrow(atbl, pad_widths=pad_widths)
+        if predicate is not None and exact_filter:
+            with trace_range("io.orc.filter"):
+                dev = _apply_exact_filter(dev, predicate, want)
+        yield dev
+
+
+def read_orc(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    pad_widths: Optional[dict] = None,
+    exact_filter: bool = True,
+) -> Table:
+    """Eager ORC read -> one device Table."""
+    _require()
+    from ..interop import table_from_arrow
+    from .parquet import _apply_exact_filter
+
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    f = pa_orc.ORCFile(path)
+    want, read_cols = _read_columns(predicate, columns, f.schema.names)
+    with trace_range("io.orc.decode"):
+        atbl = f.read(columns=read_cols)
+    with trace_range("io.orc.upload"):
+        dev = table_from_arrow(atbl, pad_widths=pad_widths)
+    if predicate is not None and exact_filter:
+        with trace_range("io.orc.filter"):
+            dev = _apply_exact_filter(dev, predicate, want)
+    return dev
+
+
+def write_orc(table: Table, path, compression: str = "zstd") -> None:
+    """Device Table -> ORC file."""
+    _require()
+    from ..interop import table_to_arrow
+
+    with trace_range("io.orc.write"):
+        atbl = table_to_arrow(table)
+        pa_orc.write_table(atbl, path, compression=compression)

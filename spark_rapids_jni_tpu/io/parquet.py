@@ -1,0 +1,214 @@
+"""Parquet scan/write: host decode -> async HBM upload -> device filter.
+
+The reference's Parquet path is libcudf's GPU decoder fed by nvcomp
+(SURVEY.md §2.3 row "Compressed columnar file I/O"); its pushdown happens
+inside cudf's reader. The TPU-native shape decodes on host (Arrow) and
+pushes three things down *before* any byte reaches HBM:
+
+1. column projection (only requested + predicate columns are decoded),
+2. row-group pruning against footer min/max/null statistics
+   (predicates.Leaf.maybe_matches), and
+3. exact residual filtering on device over the uploaded batch
+   (predicates.Predicate.evaluate + ops.filter), where Spark's null
+   semantics are applied by the columnar op library.
+
+``scan_parquet`` streams row-group batches (the unit the reference's 2 GB
+batching discipline maps to, row_conversion.cu:505-511); ``read_parquet``
+is the eager single-table form.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence, Union
+
+from ..column import Table
+from ..utils.tracing import trace_range
+from . import predicates as preds
+from .predicates import ColumnStats, Predicate
+
+try:  # pyarrow is optional (environment contract — no new installs)
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+except ImportError:  # pragma: no cover
+    pa = pq = None
+
+
+def _require():
+    if pq is None:  # pragma: no cover
+        raise ImportError("pyarrow.parquet not available")
+
+
+def _normalize_paths(path) -> list:
+    if isinstance(path, (list, tuple)):
+        return list(path)
+    return [path]
+
+
+def _row_group_stats(meta, rg_index: int, names: Sequence[str]) -> dict:
+    """Footer statistics for one row group, keyed by column name."""
+    out = {}
+    rg = meta.row_group(rg_index)
+    want = set(names)
+    for ci in range(rg.num_columns):
+        colmeta = rg.column(ci)
+        name = colmeta.path_in_schema
+        if name not in want:
+            continue
+        st = colmeta.statistics
+        if st is None:
+            continue
+        try:
+            lo = st.min if st.has_min_max else None
+            hi = st.max if st.has_min_max else None
+        except (ValueError, TypeError):  # undecodable physical stats
+            lo = hi = None
+        out[name] = ColumnStats(
+            min=lo,
+            max=hi,
+            null_count=st.null_count if st.has_null_count else None,
+            num_values=colmeta.num_values,
+        )
+    return out
+
+
+def parquet_metadata(path) -> dict:
+    """Schema + per-row-group stats (host only, reads just the footer)."""
+    _require()
+    pf = pq.ParquetFile(path)
+    names = pf.schema_arrow.names
+    return {
+        "num_rows": pf.metadata.num_rows,
+        "num_row_groups": pf.metadata.num_row_groups,
+        "columns": names,
+        "row_groups": [
+            {
+                "num_rows": pf.metadata.row_group(i).num_rows,
+                "stats": _row_group_stats(pf.metadata, i, names),
+            }
+            for i in range(pf.metadata.num_row_groups)
+        ],
+    }
+
+
+def _apply_exact_filter(table: Table, predicate: Predicate, keep_names) -> Table:
+    from ..ops.filter import filter_table
+
+    mask = predicate.evaluate(table)
+    out = filter_table(table, mask)
+    if keep_names is not None and list(out.names) != list(keep_names):
+        out = out.select(list(keep_names))
+    return out
+
+
+def scan_parquet(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    pad_widths: Optional[dict] = None,
+    row_groups_per_batch: int = 1,
+    exact_filter: bool = True,
+) -> Iterator[Table]:
+    """Stream a Parquet file (or list of files) as device Table batches.
+
+    Each batch covers ``row_groups_per_batch`` surviving row groups.
+    ``filters`` is a Predicate (``col("x") > 3``) or pyarrow-style DNF
+    list of (name, op, value) tuples.
+    """
+    _require()
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    for p in _normalize_paths(path):
+        pf = pq.ParquetFile(p)
+        all_names = pf.schema_arrow.names
+        want = list(columns) if columns is not None else all_names
+        read_cols = want
+        if predicate is not None:
+            extra = [c for c in sorted(predicate.columns()) if c not in want]
+            read_cols = want + extra
+        stats_names = (
+            sorted(predicate.columns()) if predicate is not None else []
+        )
+
+        surviving = []
+        for rg in range(pf.metadata.num_row_groups):
+            if predicate is not None:
+                stats = _row_group_stats(pf.metadata, rg, stats_names)
+                if not predicate.maybe_matches(stats):
+                    continue
+            surviving.append(rg)
+
+        for i in range(0, len(surviving), max(row_groups_per_batch, 1)):
+            batch = surviving[i : i + max(row_groups_per_batch, 1)]
+            with trace_range("io.parquet.decode"):
+                atbl = pf.read_row_groups(batch, columns=read_cols)
+            with trace_range("io.parquet.upload"):
+                from ..interop import table_from_arrow
+
+                dev = table_from_arrow(atbl, pad_widths=pad_widths)
+            if predicate is not None and exact_filter:
+                with trace_range("io.parquet.filter"):
+                    dev = _apply_exact_filter(dev, predicate, want)
+            yield dev
+
+
+def read_parquet(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    pad_widths: Optional[dict] = None,
+    exact_filter: bool = True,
+) -> Table:
+    """Eager read: prune row groups, decode once, upload, filter on device."""
+    _require()
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    tables = []
+    for p in _normalize_paths(path):
+        pf = pq.ParquetFile(p)
+        all_names = pf.schema_arrow.names
+        want = list(columns) if columns is not None else all_names
+        read_cols = want
+        if predicate is not None:
+            extra = [c for c in sorted(predicate.columns()) if c not in want]
+            read_cols = want + extra
+            stats_names = sorted(predicate.columns())
+            surviving = [
+                rg
+                for rg in range(pf.metadata.num_row_groups)
+                if predicate.maybe_matches(
+                    _row_group_stats(pf.metadata, rg, stats_names)
+                )
+            ]
+        else:
+            surviving = list(range(pf.metadata.num_row_groups))
+        with trace_range("io.parquet.decode"):
+            atbl = pf.read_row_groups(surviving, columns=read_cols)
+        tables.append(atbl)
+
+    merged = tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+    with trace_range("io.parquet.upload"):
+        from ..interop import table_from_arrow
+
+        dev = table_from_arrow(merged, pad_widths=pad_widths)
+    if predicate is not None and exact_filter:
+        with trace_range("io.parquet.filter"):
+            want = list(columns) if columns is not None else None
+            dev = _apply_exact_filter(
+                dev, predicate, want if want is not None else dev.names
+            )
+    return dev
+
+
+def write_parquet(
+    table: Table,
+    path,
+    compression: str = "snappy",
+    row_group_size: Optional[int] = None,
+) -> None:
+    """Device Table -> Parquet file (host readback + Arrow writer)."""
+    _require()
+    from ..interop import table_to_arrow
+
+    with trace_range("io.parquet.write"):
+        atbl = table_to_arrow(table)
+        pq.write_table(
+            atbl, path, compression=compression, row_group_size=row_group_size
+        )

@@ -1,0 +1,272 @@
+"""Scan predicates: host-side stats pruning + device-side residual filter.
+
+A predicate is a DNF tree (OR of ANDs of leaf comparisons), the same shape
+pyarrow/Spark push down to Parquet readers. Two evaluators:
+
+* ``maybe_matches(stats)`` — conservative host check against per-row-group
+  (or per-stripe) min/max/null statistics: may a row in this group satisfy
+  the predicate? False ⇒ the group is skipped before decode (the pushdown
+  the reference gets from cudf's Parquet reader).
+* ``evaluate(table)`` — exact device evaluation producing a BOOL8 mask
+  Column via the binaryop library, with Spark null semantics (null
+  comparisons are null ⇒ row dropped by WHERE).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column, Table
+
+_LEAF_OPS = {"==", "!=", "<", "<=", ">", ">=", "in", "not in", "is_null", "is_not_null"}
+
+_BINOP_NAME = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnStats:
+    """Per-group statistics as found in a Parquet footer / ORC stripe."""
+
+    min: Any = None
+    max: Any = None
+    null_count: Optional[int] = None
+    num_values: Optional[int] = None
+
+    @property
+    def has_nulls(self) -> Optional[bool]:
+        if self.null_count is None:
+            return None
+        return self.null_count > 0
+
+    @property
+    def all_null(self) -> Optional[bool]:
+        if self.null_count is None or self.num_values is None:
+            return None
+        return self.null_count >= self.num_values
+
+
+class Predicate:
+    """Base class; build with ``col("x") > 3``, ``and_``/``or_``."""
+
+    def maybe_matches(self, stats: dict) -> bool:
+        raise NotImplementedError
+
+    def evaluate(self, table: Table) -> Column:
+        raise NotImplementedError
+
+    def columns(self) -> set:
+        raise NotImplementedError
+
+    def __and__(self, other: "Predicate") -> "Predicate":
+        return And([self, other])
+
+    def __or__(self, other: "Predicate") -> "Predicate":
+        return Or([self, other])
+
+
+def _literal_column(value, n: int, like: Column) -> Column:
+    """Broadcast a Python literal to an n-row column of a compatible dtype."""
+    if like.dtype.is_string:
+        if isinstance(value, str):
+            value = value.encode("utf-8", "surrogateescape")
+        return Column.from_strings([value] * n, pad_width=max(len(value), 1))
+    if like.dtype.is_decimal:
+        # Literal given in *scaled* units (a plain number): convert to the
+        # column's unscaled representation.
+        unscaled = int(round(float(value) * 10 ** (-like.dtype.scale)))
+        host = np.full((n,), unscaled, dtype=np.dtype(like.dtype.device_dtype))
+        return Column.from_numpy(host, dtype=like.dtype)
+    if like.dtype.is_timestamp or like.dtype.is_duration:
+        host = np.full(
+            (n,), int(value), dtype=np.dtype(f"i{like.dtype.itemsize}")
+        )
+        return Column.from_numpy(host, dtype=like.dtype)
+    host = np.full((n,), value, dtype=np.dtype(like.dtype.device_dtype))
+    return Column.from_numpy(host, dtype=like.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Leaf(Predicate):
+    name: str
+    op: str
+    value: Any = None
+
+    def __post_init__(self):
+        if self.op not in _LEAF_OPS:
+            raise ValueError(f"unknown predicate op {self.op!r}")
+
+    def columns(self) -> set:
+        return {self.name}
+
+    # -- host pruning ----------------------------------------------------
+    def maybe_matches(self, stats: dict) -> bool:
+        st = stats.get(self.name)
+        if st is None:
+            return True  # no stats -> cannot prune
+        if self.op == "is_null":
+            return st.has_nulls is not False
+        if self.op == "is_not_null":
+            return st.all_null is not True
+        lo, hi = st.min, st.max
+        if lo is None or hi is None:
+            return True
+        v = self.value
+        try:
+            if self.op == "==":
+                return lo <= v <= hi
+            if self.op == "!=":
+                return not (lo == v == hi)
+            if self.op == "<":
+                return lo < v
+            if self.op == "<=":
+                return lo <= v
+            if self.op == ">":
+                return hi > v
+            if self.op == ">=":
+                return hi >= v
+            if self.op == "in":
+                return any(lo <= x <= hi for x in v)
+            if self.op == "not in":
+                return not any(lo == x == hi for x in v)
+        except TypeError:
+            return True  # incomparable literal vs stats -> keep the group
+        return True
+
+    # -- device residual -------------------------------------------------
+    def evaluate(self, table: Table) -> Column:
+        from ..ops import binaryop, unaryop
+
+        c = table[self.name]
+        if self.op == "is_null":
+            return unaryop.is_null(c)
+        if self.op == "is_not_null":
+            return unaryop.is_not_null(c)
+        if self.op in ("in", "not in"):
+            acc = None
+            for v in self.value:
+                lit = _literal_column(v, c.row_count, c)
+                term = binaryop.binary_op("eq", c, lit)
+                acc = term if acc is None else binaryop.binary_op("or", acc, term)
+            if acc is None:  # empty IN list matches nothing
+                import jax.numpy as jnp
+
+                return Column(
+                    jnp.zeros((c.row_count,), dtype=jnp.bool_), dt.BOOL8, None
+                )
+            if self.op == "not in":
+                return unaryop.unary_op("not", acc)
+            return acc
+        lit = _literal_column(self.value, c.row_count, c)
+        return binaryop.binary_op(_BINOP_NAME[self.op], c, lit)
+
+
+@dataclasses.dataclass(frozen=True)
+class And(Predicate):
+    children: Sequence[Predicate]
+
+    def __post_init__(self):
+        object.__setattr__(self, "children", tuple(self.children))
+
+    def columns(self) -> set:
+        return set().union(*(c.columns() for c in self.children))
+
+    def maybe_matches(self, stats: dict) -> bool:
+        return all(c.maybe_matches(stats) for c in self.children)
+
+    def evaluate(self, table: Table) -> Column:
+        from ..ops import binaryop
+
+        out = self.children[0].evaluate(table)
+        for c in self.children[1:]:
+            out = binaryop.binary_op("and", out, c.evaluate(table))
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Or(Predicate):
+    children: Sequence[Predicate]
+
+    def __post_init__(self):
+        object.__setattr__(self, "children", tuple(self.children))
+
+    def columns(self) -> set:
+        return set().union(*(c.columns() for c in self.children))
+
+    def maybe_matches(self, stats: dict) -> bool:
+        return any(c.maybe_matches(stats) for c in self.children)
+
+    def evaluate(self, table: Table) -> Column:
+        from ..ops import binaryop
+
+        out = self.children[0].evaluate(table)
+        for c in self.children[1:]:
+            out = binaryop.binary_op("or", out, c.evaluate(table))
+        return out
+
+
+class _ColBuilder:
+    """``col("x") > 3`` sugar for building Leaf predicates."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __eq__(self, other):  # type: ignore[override]
+        return Leaf(self._name, "==", other)
+
+    def __ne__(self, other):  # type: ignore[override]
+        return Leaf(self._name, "!=", other)
+
+    def __lt__(self, other):
+        return Leaf(self._name, "<", other)
+
+    def __le__(self, other):
+        return Leaf(self._name, "<=", other)
+
+    def __gt__(self, other):
+        return Leaf(self._name, ">", other)
+
+    def __ge__(self, other):
+        return Leaf(self._name, ">=", other)
+
+    def isin(self, values):
+        return Leaf(self._name, "in", tuple(values))
+
+    def not_in(self, values):
+        return Leaf(self._name, "not in", tuple(values))
+
+    def is_null(self):
+        return Leaf(self._name, "is_null")
+
+    def is_not_null(self):
+        return Leaf(self._name, "is_not_null")
+
+    __hash__ = None  # builders are not hashable (== builds a Leaf)
+
+
+def col(name: str) -> _ColBuilder:
+    return _ColBuilder(name)
+
+
+def and_(*preds: Predicate) -> Predicate:
+    return And(preds) if len(preds) > 1 else preds[0]
+
+
+def or_(*preds: Predicate) -> Predicate:
+    return Or(preds) if len(preds) > 1 else preds[0]
+
+
+def from_dnf(filters) -> Predicate:
+    """pyarrow-style DNF list(s) of (col, op, value) -> Predicate tree."""
+    if isinstance(filters, Predicate):
+        return filters
+    if filters and isinstance(filters[0], tuple):
+        filters = [filters]
+    conjunctions = []
+    for conj in filters:
+        leaves = [Leaf(name, op, value) for (name, op, value) in conj]
+        conjunctions.append(And(leaves) if len(leaves) > 1 else leaves[0])
+    return Or(conjunctions) if len(conjunctions) > 1 else conjunctions[0]

@@ -25,9 +25,9 @@ from .. import dtype as dt
 from ..column import Column, Table
 from . import compute
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
-_M5 = jnp.uint32(0xE6546B64)
+_C1 = np.uint32(0xCC9E2D51)  # numpy scalar: no backend init at import
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(0xE6546B64)
 
 DEFAULT_SEED = 42
 
@@ -45,7 +45,7 @@ def _mix_k1(k1):
 def _mix_h1(h1, k1):
     h1 = h1 ^ k1
     h1 = _rotl(h1, 13)
-    return h1 * jnp.uint32(5) + _M5
+    return h1 * np.uint32(5) + _M5
 
 
 def _fmix(h1, length):

@@ -14,12 +14,13 @@ from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..column import Column, Table
 from . import keys as keys_mod
 from .gather import gather_table
 
-_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+_U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)  # numpy scalar: no backend init at import
 
 
 @dataclasses.dataclass(frozen=True)

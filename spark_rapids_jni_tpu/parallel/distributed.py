@@ -17,15 +17,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
-
 from ..column import Table
 from ..ops.groupby import GroupbyAgg, groupby_aggregate_capped
 from ..ops.join import inner_join_capped
-from .mesh import SHUFFLE_AXIS, shard_table
+from .mesh import SHUFFLE_AXIS, shard_map, shard_table
 from .shuffle import exchange_by_hash
 
 

@@ -14,6 +14,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
 from ..column import Column, Table
 
 SHUFFLE_AXIS = "shuffle"
@@ -29,10 +34,6 @@ def make_mesh(
     return Mesh(np.array(devs[:n]), (axis,))
 
 
-def _row_sharding(mesh: Mesh, axis: str) -> NamedSharding:
-    return NamedSharding(mesh, P(axis))
-
-
 def shard_table(table: Table, mesh: Mesh, axis: str = SHUFFLE_AXIS) -> Table:
     """Row-shard every buffer across the mesh (dim 0 split, rest replicated).
 
@@ -45,7 +46,6 @@ def shard_table(table: Table, mesh: Mesh, axis: str = SHUFFLE_AXIS) -> Table:
         raise ValueError(
             f"row count {n} not divisible by mesh axis size {size}"
         )
-    sharding = _row_sharding(mesh, axis)
 
     def put(x):
         if x is None:

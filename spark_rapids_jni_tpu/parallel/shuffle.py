@@ -21,14 +21,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
-
 from ..column import Column, Table
 from ..ops.partition import partition_ids_hash
-from .mesh import SHUFFLE_AXIS, shard_table
+from .mesh import SHUFFLE_AXIS, shard_map, shard_table
 
 
 def exchange(

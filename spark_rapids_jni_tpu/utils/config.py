@@ -1,0 +1,97 @@
+"""The flag plane: one place every runtime knob is declared.
+
+Mirrors the reference's config system (SURVEY.md §5.6): Maven ``-D``
+properties are the single source of truth with defaults in pom.xml:79-100,
+fanned out to Ant/CMake/sysprops and documented in CONTRIBUTING.md:57-70.
+Here the single plane is ``SPARK_RAPIDS_TPU_*`` environment variables with
+defaults declared below; Java callers set the same knobs as system
+properties which the JNI shim exports into the embedded runtime's
+environment (native/ runtime).
+
+Flags (reference analog in parens):
+
+* ``TRACE``            — profiler range annotations on/off
+                         (``ai.rapids.cudf.nvtx.enabled``, pom.xml:85,200).
+* ``REFCOUNT_DEBUG``   — buffer-registry leak tracking with provenance
+                         (``ai.rapids.refcount.debug``, pom.xml:86,199).
+* ``ALLOC_LOG_LEVEL``  — allocation logging verbosity
+                         (``RMM_LOGGING_LEVEL``, pom.xml:82).
+* ``DISABLE_X64``      — refuse 64-bit device types (debug aid; the x64
+                         guard in column.py raises when data would narrow).
+* ``TEST_PLATFORM``    — test-suite backend selection (cpu | axon/tpu);
+                         the "GPU required" gate of ci/premerge-build.sh:20
+                         inverted into an opt-in.
+* ``NATIVE_LIB``       — explicit path to libspark_rapids_tpu.so
+                         (NativeDepsLoader's resource-path override).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Optional
+
+_PREFIX = "SPARK_RAPIDS_TPU_"
+
+
+def _as_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return _PREFIX + self.name
+
+
+_FLAGS = {
+    f.name: f
+    for f in [
+        Flag("TRACE", False, _as_bool, "profiler trace annotations"),
+        Flag("REFCOUNT_DEBUG", False, _as_bool, "buffer leak tracking"),
+        Flag("ALLOC_LOG_LEVEL", "OFF", str.upper, "allocation log level"),
+        Flag("DISABLE_X64", False, _as_bool, "refuse 64-bit device types"),
+        Flag("TEST_PLATFORM", "cpu", str, "test backend (cpu|axon)"),
+        Flag("NATIVE_LIB", "", str, "explicit native library path"),
+    ]
+}
+
+# Test/runtime overrides set via set_flag (take precedence over env).
+_overrides: dict = {}
+
+
+def get_flag(name: str):
+    """Current value of a declared flag (override > env > default)."""
+    flag = _FLAGS[name]
+    if name in _overrides:
+        return _overrides[name]
+    raw = os.environ.get(flag.env_var)
+    if raw is None:
+        return flag.default
+    return flag.parse(raw)
+
+
+def set_flag(name: str, value) -> None:
+    if name not in _FLAGS:
+        raise KeyError(f"unknown flag {name!r}")
+    _overrides[name] = value
+
+
+def clear_flag(name: str) -> None:
+    _overrides.pop(name, None)
+
+
+def describe_flags() -> str:
+    """Human-readable flag table (the CONTRIBUTING.md:57-70 analog)."""
+    lines = []
+    for f in _FLAGS.values():
+        lines.append(
+            f"{f.env_var:<40} default={f.default!r:<10} {f.doc}"
+        )
+    return "\n".join(lines)

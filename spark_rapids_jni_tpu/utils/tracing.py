@@ -1,0 +1,70 @@
+"""Profiler range annotation — the NVTX analog (SURVEY.md §5.1).
+
+The reference toggles NVTX ranges from Java via the
+``ai.rapids.cudf.nvtx.enabled`` system property (pom.xml:85,200-201); the
+ranges show up in Nsight. The TPU equivalent is
+``jax.profiler.TraceAnnotation``, which lands named ranges in
+Perfetto/XProf traces captured with ``jax.profiler.trace``.
+
+Enabled via the ``SPARK_RAPIDS_TPU_TRACE`` flag (utils/config.py); when
+off, ``trace_range`` is a no-op with near-zero overhead, matching the
+reference's ship-it-disabled default.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+from . import config
+
+_local = threading.local()
+
+
+def tracing_enabled() -> bool:
+    return bool(config.get_flag("TRACE"))
+
+
+@contextlib.contextmanager
+def trace_range(name: str) -> Iterator[None]:
+    """Named range in the profiler timeline (no-op unless TRACE is on)."""
+    if not tracing_enabled():
+        yield
+        return
+    import jax.profiler
+
+    depth = getattr(_local, "depth", 0)
+    _local.depth = depth + 1
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        _local.depth = depth
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator form: wraps a function body in a trace_range."""
+
+    def wrap(fn):
+        import functools
+
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with trace_range(label):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+@contextlib.contextmanager
+def capture_trace(log_dir: str) -> Iterator[None]:
+    """Capture a full profiler trace (Perfetto) into ``log_dir``."""
+    import jax.profiler
+
+    with jax.profiler.trace(log_dir):
+        yield

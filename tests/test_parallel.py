@@ -60,12 +60,21 @@ class TestShuffle:
                          np.asarray(t["v"].to_numpy()).tolist()))
         dst = sorted(zip(got_k.tolist(), got_v.tolist()))
         assert src == dst
-        # placement: partition id must equal device index
-        part = np.asarray(
-            ops.partition.partition_ids_hash(t, ["k"], 8)
-            if hasattr(ops, "partition")
-            else None
-        )
+        # placement: every received row sits on the device its key hashes to
+        from spark_rapids_jni_tpu.ops.partition import partition_ids_hash
+
+        part_of_key = {
+            int(k): int(p)
+            for k, p in zip(
+                np.asarray(t["k"].data),
+                np.asarray(partition_ids_hash(t, ["k"], 8)),
+            )
+        }
+        occ_dev = occ_np.reshape(8, -1)
+        keys_dev = np.asarray(out["k"].data).reshape(8, -1)
+        for dev in range(8):
+            for k in keys_dev[dev][occ_dev[dev]]:
+                assert part_of_key[int(k)] == dev
 
     def test_placement_matches_spark_hash(self, mesh, rng):
         from spark_rapids_jni_tpu.ops.partition import partition_ids_hash
