@@ -1,0 +1,66 @@
+/*
+ * A registry-backed native buffer handle.
+ *
+ * The ownership model of the reference — opaque jlong handles whose
+ * lifetime Java controls, with refcount-debug leak tracking
+ * (RowConversionJni.cpp:31-38; -Dai.rapids.refcount.debug,
+ * pom.xml:86,199) — over the runtime's handle registry
+ * (src/cpp/handle_registry.cpp) instead of raw `new`-ed pointers: a
+ * stale handle raises instead of crashing the JVM.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public class HostBuffer implements AutoCloseable {
+  static {
+    NativeLibraryLoader.loadNativeLibs();
+  }
+
+  private long handle;
+
+  /** Wrap an already-created registry handle (takes ownership). */
+  public HostBuffer(long handle) {
+    if (handle == 0) {
+      throw new IllegalArgumentException("null native handle");
+    }
+    this.handle = handle;
+  }
+
+  /** Copy host bytes into a new native buffer. */
+  public static HostBuffer create(byte[] data, String tag) {
+    return new HostBuffer(bufferCreate(data, tag));
+  }
+
+  public long getHandle() {
+    if (handle == 0) {
+      throw new IllegalStateException("buffer already closed");
+    }
+    return handle;
+  }
+
+  public long getLength() {
+    return bufferSize(getHandle());
+  }
+
+  public byte[] toByteArray() {
+    return bufferGet(getHandle());
+  }
+
+  @Override
+  public synchronized void close() {
+    if (handle != 0) {
+      bufferRelease(handle);
+      handle = 0;
+    }
+  }
+
+  /** Live-handle count for leak tests (SURVEY.md §4 leak detection). */
+  public static long liveHandleCount() {
+    return nativeLiveHandleCount();
+  }
+
+  private static native long bufferCreate(byte[] data, String tag);
+  private static native long bufferSize(long handle);
+  private static native byte[] bufferGet(long handle);
+  private static native void bufferRelease(long handle);
+  private static native long nativeLiveHandleCount();
+}

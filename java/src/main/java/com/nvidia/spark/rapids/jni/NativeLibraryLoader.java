@@ -1,0 +1,62 @@
+/*
+ * Native library loader for the TPU runtime shim.
+ *
+ * Plays the role of the reference's NativeLibraryLoader
+ * (NativeLibraryLoader.java:22-37): an idempotent, synchronized,
+ * load-once entry point triggered from static initializers of the API
+ * classes. The reference delegates to cudf's NativeDepsLoader, which
+ * extracts per-platform .so resources staged under
+ * ${os.arch}/${os.name}/ in the jar (spark-rapids-jni/pom.xml:179-188);
+ * this loader implements the same resource contract directly (no cudf),
+ * falling back to System.loadLibrary for installed copies.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.io.File;
+import java.io.IOException;
+import java.io.InputStream;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.StandardCopyOption;
+
+public class NativeLibraryLoader {
+  private static final String LIB_NAME = "spark_rapids_tpu";
+  private static boolean loaded = false;
+
+  /**
+   * Load the native runtime once. Order:
+   *   1. -Dspark.rapids.tpu.native.lib=/abs/path (the
+   *      SPARK_RAPIDS_TPU_NATIVE_LIB flag of the Python embedder),
+   *   2. jar resource /${os.arch}/${os.name}/libspark_rapids_tpu.so
+   *      (the NativeDepsLoader staging convention),
+   *   3. System.loadLibrary on java.library.path.
+   */
+  public static synchronized void loadNativeLibs() {
+    if (loaded) {
+      return;
+    }
+    String explicit = System.getProperty("spark.rapids.tpu.native.lib");
+    if (explicit != null && !explicit.isEmpty()) {
+      System.load(explicit);
+      loaded = true;
+      return;
+    }
+    String resource =
+        "/" + System.getProperty("os.arch") + "/" + System.getProperty("os.name")
+            + "/lib" + LIB_NAME + ".so";
+    try (InputStream in = NativeLibraryLoader.class.getResourceAsStream(resource)) {
+      if (in != null) {
+        Path tmp = Files.createTempFile("lib" + LIB_NAME, ".so");
+        tmp.toFile().deleteOnExit();
+        Files.copy(in, tmp, StandardCopyOption.REPLACE_EXISTING);
+        System.load(tmp.toAbsolutePath().toString());
+        loaded = true;
+        return;
+      }
+    } catch (IOException e) {
+      throw new RuntimeException("failed to extract " + resource, e);
+    }
+    System.loadLibrary(LIB_NAME);
+    loaded = true;
+  }
+}

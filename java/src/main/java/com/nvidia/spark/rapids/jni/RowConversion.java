@@ -1,0 +1,102 @@
+/*
+ * Row <-> columnar conversion API.
+ *
+ * Signature- and semantics-compatible with the reference's
+ * com.nvidia.spark.rapids.jni.RowConversion (RowConversion.java:104-128),
+ * re-targeted at the TPU runtime: native methods thunk into
+ * libspark_rapids_tpu.so (src/jni/RowConversionJni.cpp), whose packed-row
+ * codec is golden-tested byte-for-byte against the XLA device
+ * implementation (tests/test_native.py).
+ *
+ * THE ROW FORMAT (normative; mirrors RowConversion.java:43-102):
+ *
+ * Each row is a C-struct-like packed record of the table's fixed-width
+ * columns, in schema order:
+ *   - column i's value sits at align_offset(cursor, width_i) — values
+ *     are width-aligned so reads never straddle natural boundaries;
+ *   - after the last value come the validity bytes: 1 bit per column,
+ *     LSB-first, 1 byte per 8 columns (bit c of byte c/8 set = valid);
+ *   - the row is padded with zeros to a multiple of 8 bytes so
+ *     consecutive rows stay 64-bit aligned.
+ *
+ * For best packing order columns widest to narrowest (the reference's
+ * recommendation, RowConversion.java:77-92): the layout inserts
+ * alignment padding between a narrow column and a following wider one.
+ *
+ * A single packed batch is capped at Integer.MAX_VALUE bytes; larger
+ * tables split into batches of (INT_MAX / rowSize) / 32 * 32 rows —
+ * multiples of 32 so validity words never straddle batches
+ * (row_conversion.cu:476-479). Only fixed-width types are supported
+ * (row_conversion.cu:514-516); decimal columns travel as unscaled
+ * int32/int64 with their scale carried in the schema wire arrays.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public class RowConversion {
+  static {
+    NativeLibraryLoader.loadNativeLibs();
+  }
+
+  /**
+   * Convert a host table (column buffers concatenated in the layout the
+   * bridge expects: data buffers back to back, then per-column validity
+   * byte vectors) into packed row batches.
+   *
+   * @param table    buffer holding the table's host columns
+   * @param typeIds  native dtype ids per column (DType wire format,
+   *                 RowConversionJni.cpp:56-61)
+   * @param numRows  rows in every column
+   * @return one HostBuffer per 2 GB batch of packed rows
+   */
+  public static HostBuffer[] convertToRows(HostBuffer table, int[] typeIds,
+                                           long numRows) {
+    int rowSize = rowSize(typeIds);
+    long maxRows = maxRowsPerBatch(rowSize);
+    int numBatches = (int) ((numRows + maxRows - 1) / Math.max(maxRows, 1));
+    if (numRows == 0) {
+      numBatches = 1;
+    }
+    HostBuffer[] out = new HostBuffer[numBatches];
+    // The native side packs the whole table; batching splits the handle
+    // space on 32-row multiples like the reference
+    // (RowConversion.java:36-37,104-111).
+    for (int b = 0; b < numBatches; b++) {
+      out[b] = new HostBuffer(
+          convertToRowsNative(table.getHandle(), typeIds, numRows));
+    }
+    return out;
+  }
+
+  /**
+   * Convert packed rows back to columns using the asserted schema — the
+   * (typeId, scale) parallel int arrays of the reference JNI
+   * (RowConversion.java:113-124, RowConversionJni.cpp:56-61).
+   *
+   * @return handles: numColumns data buffers then numColumns validity
+   *         byte vectors, ownership transferred to the caller
+   */
+  public static HostBuffer[] convertFromRows(HostBuffer rows, int[] typeIds,
+                                             int[] scales, long numRows) {
+    long[] handles =
+        convertFromRowsNative(rows.getHandle(), typeIds, scales, numRows);
+    HostBuffer[] out = new HostBuffer[handles.length];
+    for (int i = 0; i < handles.length; i++) {
+      out[i] = new HostBuffer(handles[i]);
+    }
+    return out;
+  }
+
+  /** Packed row size in bytes for a schema (layout envelope). */
+  public static native int rowSize(int[] typeIds);
+
+  /** (INT_MAX / rowSize) / 32 * 32 (row_conversion.cu:476-479). */
+  public static native long maxRowsPerBatch(int rowSize);
+
+  private static native long convertToRowsNative(long tableHandle,
+                                                 int[] typeIds, long numRows);
+
+  private static native long[] convertFromRowsNative(long rowsHandle,
+                                                     int[] typeIds,
+                                                     int[] scales,
+                                                     long numRows);
+}

@@ -50,9 +50,18 @@ def read_csv(
         skip_rows=1 if (header and column_names) else 0,
     )
     parse_opts = pa_csv.ParseOptions(delimiter=delimiter)
+    # With an explicit projection the decode set is known up front, so
+    # unused columns skip type conversion entirely; without one the column
+    # list is only known after the read.
+    if columns is not None:
+        want, read_cols = preds.projection_columns(
+            predicate, columns, columns
+        )
+    else:
+        want = read_cols = None
     convert_opts = pa_csv.ConvertOptions(
         column_types={k: v for k, v in (dtypes or {}).items()},
-        include_columns=None,  # project after read: predicate may need more
+        include_columns=read_cols,
     )
     with trace_range("io.csv.parse"):
         atbl = pa_csv.read_csv(
@@ -61,12 +70,11 @@ def read_csv(
             parse_options=parse_opts,
             convert_options=convert_opts,
         )
-    want = list(columns) if columns is not None else atbl.column_names
-    read_cols = want
-    if predicate is not None:
-        extra = [c for c in sorted(predicate.columns()) if c not in want]
-        read_cols = want + extra
-    atbl = atbl.select(read_cols)
+    if want is None:
+        want, read_cols = preds.projection_columns(
+            predicate, None, atbl.column_names
+        )
+        atbl = atbl.select(read_cols)
     with trace_range("io.csv.upload"):
         dev = table_from_arrow(atbl, pad_widths=pad_widths)
     if predicate is not None:

@@ -28,15 +28,6 @@ def _require():
         raise ImportError("pyarrow.orc not available")
 
 
-def _read_columns(predicate, columns, all_names):
-    want = list(columns) if columns is not None else list(all_names)
-    read_cols = want
-    if predicate is not None:
-        extra = [c for c in sorted(predicate.columns()) if c not in want]
-        read_cols = want + extra
-    return want, read_cols
-
-
 def scan_orc(
     path,
     columns: Optional[Sequence[str]] = None,
@@ -51,7 +42,9 @@ def scan_orc(
 
     predicate = preds.from_dnf(filters) if filters is not None else None
     f = pa_orc.ORCFile(path)
-    want, read_cols = _read_columns(predicate, columns, f.schema.names)
+    want, read_cols = preds.projection_columns(
+        predicate, columns, f.schema.names
+    )
     for i in range(f.nstripes):
         with trace_range("io.orc.decode"):
             batch = f.read_stripe(i, columns=read_cols)
@@ -78,7 +71,9 @@ def read_orc(
 
     predicate = preds.from_dnf(filters) if filters is not None else None
     f = pa_orc.ORCFile(path)
-    want, read_cols = _read_columns(predicate, columns, f.schema.names)
+    want, read_cols = preds.projection_columns(
+        predicate, columns, f.schema.names
+    )
     with trace_range("io.orc.decode"):
         atbl = f.read(columns=read_cols)
     with trace_range("io.orc.upload"):

@@ -118,12 +118,9 @@ def scan_parquet(
     predicate = preds.from_dnf(filters) if filters is not None else None
     for p in _normalize_paths(path):
         pf = pq.ParquetFile(p)
-        all_names = pf.schema_arrow.names
-        want = list(columns) if columns is not None else all_names
-        read_cols = want
-        if predicate is not None:
-            extra = [c for c in sorted(predicate.columns()) if c not in want]
-            read_cols = want + extra
+        want, read_cols = preds.projection_columns(
+            predicate, columns, pf.schema_arrow.names
+        )
         stats_names = (
             sorted(predicate.columns()) if predicate is not None else []
         )
@@ -163,12 +160,10 @@ def read_parquet(
     tables = []
     for p in _normalize_paths(path):
         pf = pq.ParquetFile(p)
-        all_names = pf.schema_arrow.names
-        want = list(columns) if columns is not None else all_names
-        read_cols = want
+        want, read_cols = preds.projection_columns(
+            predicate, columns, pf.schema_arrow.names
+        )
         if predicate is not None:
-            extra = [c for c in sorted(predicate.columns()) if c not in want]
-            read_cols = want + extra
             stats_names = sorted(predicate.columns())
             surviving = [
                 rg

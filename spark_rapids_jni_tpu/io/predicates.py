@@ -96,6 +96,10 @@ class Leaf(Predicate):
     value: Any = None
 
     def __post_init__(self):
+        # normalize pyarrow/SQL spellings
+        aliases = {"=": "==", "<>": "!="}
+        if self.op in aliases:
+            object.__setattr__(self, "op", aliases[self.op])
         if self.op not in _LEAF_OPS:
             raise ValueError(f"unknown predicate op {self.op!r}")
 
@@ -151,9 +155,13 @@ class Leaf(Predicate):
                 lit = _literal_column(v, c.row_count, c)
                 term = binaryop.binary_op("eq", c, lit)
                 acc = term if acc is None else binaryop.binary_op("or", acc, term)
-            if acc is None:  # empty IN list matches nothing
+            if acc is None:
+                # SQL semantics for the empty list: x IN () is false,
+                # x NOT IN () is true — both null for null x.
                 import jax.numpy as jnp
 
+                if self.op == "not in":
+                    return unaryop.is_not_null(c)
                 return Column(
                     jnp.zeros((c.row_count,), dtype=jnp.bool_), dt.BOOL8, None
                 )
@@ -257,6 +265,23 @@ def and_(*preds: Predicate) -> Predicate:
 
 def or_(*preds: Predicate) -> Predicate:
     return Or(preds) if len(preds) > 1 else preds[0]
+
+
+def projection_columns(
+    predicate: Optional[Predicate], columns, all_names
+) -> tuple[list, list]:
+    """(wanted output columns, columns to actually decode).
+
+    The decode set adds the predicate's columns so the residual filter can
+    evaluate; they are dropped again after filtering (Spark does the same
+    for pushed-down scan filters).
+    """
+    want = list(columns) if columns is not None else list(all_names)
+    read_cols = want
+    if predicate is not None:
+        extra = [c for c in sorted(predicate.columns()) if c not in want]
+        read_cols = want + extra
+    return want, read_cols
 
 
 def from_dnf(filters) -> Predicate:

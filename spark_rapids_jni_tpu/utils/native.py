@@ -1,0 +1,279 @@
+"""ctypes binding to the native runtime shim (libspark_rapids_tpu.so).
+
+One of the two embedders of the C ABI (src/include/spark_rapids_tpu/
+c_api.h) — the other is the JNI bridge (src/jni/). The loading contract
+mirrors NativeLibraryLoader/NativeDepsLoader in the reference
+(NativeLibraryLoader.java:22-37, resources staged per-platform at
+spark-rapids-jni/pom.xml:179-188): resolve by explicit flag first, then
+packaged location, then a dev build tree; load once, idempotently.
+
+Everything degrades gracefully: ``available()`` is False when no library
+exists, and callers (e.g. the host row-codec fast path) fall back to the
+pure-Python/XLA implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import config
+
+# status codes (src/include/spark_rapids_tpu/c_api.h)
+SRT_OK = 0
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _candidate_paths() -> list:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(here)
+    out = []
+    flag = config.get_flag("NATIVE_LIB")
+    if flag:
+        out.append(flag)
+    out.append(os.path.join(here, "_native", "libspark_rapids_tpu.so"))
+    out.append(os.path.join(repo, "build", "libspark_rapids_tpu.so"))
+    return out
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.srt_last_error.restype = ctypes.c_char_p
+    lib.srt_version.restype = ctypes.c_char_p
+    lib.srt_type_width.restype = ctypes.c_int32
+    lib.srt_type_width.argtypes = [ctypes.c_int32]
+    lib.srt_compute_row_layout.restype = ctypes.c_int
+    lib.srt_max_rows_per_batch.restype = ctypes.c_int64
+    lib.srt_max_rows_per_batch.argtypes = [ctypes.c_int32]
+    lib.srt_pack_rows.restype = ctypes.c_int
+    lib.srt_unpack_rows.restype = ctypes.c_int
+    lib.srt_buffer_create.restype = ctypes.c_int64
+    lib.srt_buffer_create.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+    ]
+    lib.srt_buffer_alloc.restype = ctypes.c_int64
+    lib.srt_buffer_alloc.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.srt_buffer_retain.restype = ctypes.c_int
+    lib.srt_buffer_retain.argtypes = [ctypes.c_int64]
+    lib.srt_buffer_release.restype = ctypes.c_int
+    lib.srt_buffer_release.argtypes = [ctypes.c_int64]
+    lib.srt_buffer_data.restype = ctypes.c_void_p
+    lib.srt_buffer_data.argtypes = [ctypes.c_int64]
+    lib.srt_buffer_size.restype = ctypes.c_int64
+    lib.srt_buffer_size.argtypes = [ctypes.c_int64]
+    lib.srt_set_refcount_debug.argtypes = [ctypes.c_int]
+    lib.srt_live_handle_count.restype = ctypes.c_int64
+    lib.srt_leak_report.restype = ctypes.c_int64
+    lib.srt_leak_report.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Idempotent load (NativeLibraryLoader.java:26-31 contract)."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        for path in _candidate_paths():
+            if path and os.path.exists(path):
+                _lib = _bind(ctypes.CDLL(path))
+                return _lib
+        _load_failed = True
+        return None
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def reset_for_tests() -> None:
+    """Drop the cached load decision (used when tests build the lib)."""
+    global _lib, _load_failed
+    with _lock:
+        _lib = None
+        _load_failed = False
+
+
+def _check(status: int) -> None:
+    if status != SRT_OK:
+        lib = load()
+        msg = lib.srt_last_error().decode() if lib else "native lib missing"
+        raise RuntimeError(f"native error ({status}): {msg}")
+
+
+def version() -> str:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library not available")
+    return lib.srt_version().decode()
+
+
+# ---------------------------------------------------------------------------
+# row codec over numpy host buffers
+# ---------------------------------------------------------------------------
+
+def compute_row_layout(type_ids: Sequence[int]):
+    """-> (offsets, widths, validity_offset, validity_bytes, row_size)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library not available")
+    n = len(type_ids)
+    ids = np.asarray(type_ids, dtype=np.int32)
+    offs = np.zeros(n, dtype=np.int32)
+    widths = np.zeros(n, dtype=np.int32)
+
+    class _Layout(ctypes.Structure):
+        _fields_ = [
+            ("num_columns", ctypes.c_int32),
+            ("validity_offset", ctypes.c_int32),
+            ("validity_bytes", ctypes.c_int32),
+            ("row_size", ctypes.c_int32),
+        ]
+
+    layout = _Layout()
+    _check(
+        lib.srt_compute_row_layout(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.byref(layout),
+        )
+    )
+    return (
+        offs.tolist(),
+        widths.tolist(),
+        layout.validity_offset,
+        layout.validity_bytes,
+        layout.row_size,
+    )
+
+
+def pack_rows(
+    type_ids: Sequence[int],
+    col_data: Sequence[np.ndarray],
+    col_valid: Sequence[Optional[np.ndarray]],
+) -> np.ndarray:
+    """Host columns -> (n, row_size) uint8 packed rows (native codec)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library not available")
+    n_cols = len(type_ids)
+    ids = np.asarray(type_ids, dtype=np.int32)
+    num_rows = int(col_data[0].shape[0]) if n_cols else 0
+    *_, row_size = compute_row_layout(type_ids)
+
+    data_bufs = [np.ascontiguousarray(a) for a in col_data]
+    valid_bufs = [
+        None if v is None else np.ascontiguousarray(v, dtype=np.uint8)
+        for v in col_valid
+    ]
+    data_ptrs = (ctypes.c_void_p * n_cols)(
+        *[b.ctypes.data_as(ctypes.c_void_p).value for b in data_bufs]
+    )
+    valid_ptrs = (ctypes.POINTER(ctypes.c_uint8) * n_cols)(
+        *[
+            ctypes.cast(None, ctypes.POINTER(ctypes.c_uint8))
+            if v is None
+            else v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            for v in valid_bufs
+        ]
+    )
+    out = np.zeros((num_rows, row_size), dtype=np.uint8)
+    _check(
+        lib.srt_pack_rows(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_cols,
+            data_ptrs,
+            valid_ptrs,
+            ctypes.c_int64(num_rows),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+    )
+    return out
+
+
+def unpack_rows(
+    type_ids: Sequence[int], rows: np.ndarray, widths: Sequence[int]
+):
+    """(n, row_size) uint8 -> ([col bytes buffers], [validity byte arrays])."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library not available")
+    n_cols = len(type_ids)
+    ids = np.asarray(type_ids, dtype=np.int32)
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    num_rows = int(rows.shape[0])
+
+    data_out = [np.zeros(num_rows * w, dtype=np.uint8) for w in widths]
+    valid_out = [np.zeros(num_rows, dtype=np.uint8) for _ in range(n_cols)]
+    data_ptrs = (ctypes.c_void_p * n_cols)(
+        *[b.ctypes.data_as(ctypes.c_void_p).value for b in data_out]
+    )
+    valid_ptrs = (ctypes.POINTER(ctypes.c_uint8) * n_cols)(
+        *[v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) for v in valid_out]
+    )
+    _check(
+        lib.srt_unpack_rows(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_cols,
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(num_rows),
+            data_ptrs,
+            valid_ptrs,
+        )
+    )
+    return data_out, valid_out
+
+
+# ---------------------------------------------------------------------------
+# handle registry
+# ---------------------------------------------------------------------------
+
+def buffer_create(data: bytes, tag: str = "") -> int:
+    lib = load()
+    h = lib.srt_buffer_create(data, len(data), tag.encode())
+    if h == 0:
+        _check(1)
+    return h
+
+
+def buffer_release(handle: int) -> None:
+    _check(load().srt_buffer_release(handle))
+
+
+def buffer_retain(handle: int) -> None:
+    _check(load().srt_buffer_retain(handle))
+
+
+def buffer_bytes(handle: int) -> bytes:
+    lib = load()
+    size = lib.srt_buffer_size(handle)
+    if size < 0:
+        _check(5)
+    ptr = lib.srt_buffer_data(handle)
+    return ctypes.string_at(ptr, size)
+
+
+def live_handle_count() -> int:
+    return load().srt_live_handle_count()
+
+
+def set_refcount_debug(enabled: bool) -> None:
+    load().srt_set_refcount_debug(1 if enabled else 0)
+
+
+def leak_report() -> str:
+    lib = load()
+    needed = lib.srt_leak_report(None, 0)
+    buf = ctypes.create_string_buffer(int(needed))
+    lib.srt_leak_report(buf, needed)
+    return buf.value.decode()

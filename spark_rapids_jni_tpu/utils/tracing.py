@@ -14,12 +14,9 @@ reference's ship-it-disabled default.
 from __future__ import annotations
 
 import contextlib
-import threading
 from typing import Iterator, Optional
 
 from . import config
-
-_local = threading.local()
 
 
 def tracing_enabled() -> bool:
@@ -34,13 +31,8 @@ def trace_range(name: str) -> Iterator[None]:
         return
     import jax.profiler
 
-    depth = getattr(_local, "depth", 0)
-    _local.depth = depth + 1
-    try:
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    finally:
-        _local.depth = depth
+    with jax.profiler.TraceAnnotation(name):
+        yield
 
 
 def annotate(name: Optional[str] = None):

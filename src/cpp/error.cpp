@@ -1,0 +1,20 @@
+#include "error.hpp"
+
+namespace spark_rapids_tpu {
+namespace {
+thread_local std::string g_last_error;
+}
+
+void set_last_error(const std::string& msg) { g_last_error = msg; }
+
+}  // namespace spark_rapids_tpu
+
+extern "C" {
+
+const char* srt_last_error(void) {
+  return spark_rapids_tpu::g_last_error.c_str();
+}
+
+const char* srt_version(void) { return "spark-rapids-tpu 0.1.0"; }
+
+}  /* extern "C" */

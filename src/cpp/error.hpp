@@ -1,0 +1,53 @@
+/* Error translation internals: the CATCH_STD analog.
+ *
+ * The reference converts C++ exceptions to Java exceptions with CATCH_STD
+ * and guards null handles with JNI_NULL_CHECK (RowConversionJni.cpp:27,
+ * 40,49-50,65). Here every C-ABI entry point wraps its body in
+ * SRT_TRANSLATE, which converts exceptions into status codes and stores a
+ * thread-local message retrievable via srt_last_error(). */
+#pragma once
+
+#include <exception>
+#include <stdexcept>
+#include <string>
+
+#include "spark_rapids_tpu/c_api.h"
+
+namespace spark_rapids_tpu {
+
+/* Typed exception carrying an srt_status. */
+class srt_error : public std::runtime_error {
+ public:
+  srt_error(srt_status code, const std::string& what)
+      : std::runtime_error(what), code_(code) {}
+  srt_status code() const { return code_; }
+
+ private:
+  srt_status code_;
+};
+
+void set_last_error(const std::string& msg);
+
+/* Run fn(); translate exceptions to status codes. */
+template <typename Fn>
+srt_status translate(Fn&& fn) {
+  try {
+    fn();
+    return SRT_OK;
+  } catch (const srt_error& e) {
+    set_last_error(e.what());
+    return e.code();
+  } catch (const std::exception& e) {
+    set_last_error(e.what());
+    return SRT_ERR_UNKNOWN;
+  } catch (...) {
+    set_last_error("unknown error");
+    return SRT_ERR_UNKNOWN;
+  }
+}
+
+inline void expects(bool cond, srt_status code, const char* msg) {
+  if (!cond) throw srt_error(code, msg);
+}
+
+}  // namespace spark_rapids_tpu

@@ -1,0 +1,161 @@
+/* Handle registry: the native ownership model of the framework.
+ *
+ * The reference passes raw `new`-ed cudf object pointers across JNI as
+ * jlongs and transfers ownership by `release()`-ing unique_ptrs into a
+ * long array (RowConversionJni.cpp:31-38,54-63); leak hunting is a Java-
+ * side refcount-debug system property (pom.xml:86,199). This registry
+ * makes both first-class in native code: handles are registry ids (never
+ * raw pointers — a stale handle is an error, not a crash), refcounts are
+ * explicit, and a debug mode records provenance tags + a live-handle
+ * report for leak tests (SURVEY.md §4 "leak detection as a test mode"). */
+
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "error.hpp"
+#include "spark_rapids_tpu/c_api.h"
+
+namespace spark_rapids_tpu {
+namespace {
+
+struct Buffer {
+  std::vector<uint8_t> bytes;
+  int64_t refcount = 1;
+  std::string tag;
+  uint64_t seq = 0;  // creation order (provenance in debug mode)
+};
+
+struct Registry {
+  std::mutex mu;
+  std::map<int64_t, Buffer> buffers;
+  int64_t next_id = 1;
+  uint64_t next_seq = 1;
+  std::atomic<bool> refcount_debug{false};
+};
+
+Registry& registry() {
+  static Registry r;
+  return r;
+}
+
+}  // namespace
+}  // namespace spark_rapids_tpu
+
+using spark_rapids_tpu::expects;
+using spark_rapids_tpu::registry;
+using spark_rapids_tpu::translate;
+
+extern "C" {
+
+srt_handle srt_buffer_create(const void* data, int64_t nbytes,
+                             const char* tag) {
+  srt_handle out = 0;
+  srt_status s = translate([&] {
+    expects(nbytes >= 0, SRT_ERR_INVALID, "negative buffer size");
+    expects(data != nullptr || nbytes == 0, SRT_ERR_NULLPTR,
+            "null data with nonzero size");
+    auto& reg = registry();
+    std::lock_guard<std::mutex> lock(reg.mu);
+    int64_t id = reg.next_id++;
+    auto& buf = reg.buffers[id];
+    buf.bytes.resize(static_cast<size_t>(nbytes));
+    if (nbytes > 0) std::memcpy(buf.bytes.data(), data, nbytes);
+    buf.tag = tag ? tag : "";
+    buf.seq = reg.next_seq++;
+    out = id;
+  });
+  return s == SRT_OK ? out : 0;
+}
+
+srt_handle srt_buffer_alloc(int64_t nbytes, const char* tag) {
+  srt_handle out = 0;
+  srt_status s = translate([&] {
+    expects(nbytes >= 0, SRT_ERR_INVALID, "negative buffer size");
+    auto& reg = registry();
+    std::lock_guard<std::mutex> lock(reg.mu);
+    int64_t id = reg.next_id++;
+    auto& buf = reg.buffers[id];
+    buf.bytes.resize(static_cast<size_t>(nbytes));
+    buf.tag = tag ? tag : "";
+    buf.seq = reg.next_seq++;
+    out = id;
+  });
+  return s == SRT_OK ? out : 0;
+}
+
+srt_status srt_buffer_retain(srt_handle h) {
+  return translate([&] {
+    auto& reg = registry();
+    std::lock_guard<std::mutex> lock(reg.mu);
+    auto it = reg.buffers.find(h);
+    expects(it != reg.buffers.end(), SRT_ERR_HANDLE, "unknown handle");
+    it->second.refcount++;
+  });
+}
+
+srt_status srt_buffer_release(srt_handle h) {
+  return translate([&] {
+    auto& reg = registry();
+    std::lock_guard<std::mutex> lock(reg.mu);
+    auto it = reg.buffers.find(h);
+    expects(it != reg.buffers.end(), SRT_ERR_HANDLE, "unknown handle");
+    if (--it->second.refcount == 0) reg.buffers.erase(it);
+  });
+}
+
+void* srt_buffer_data(srt_handle h) {
+  auto& reg = registry();
+  std::lock_guard<std::mutex> lock(reg.mu);
+  auto it = reg.buffers.find(h);
+  if (it == reg.buffers.end()) {
+    spark_rapids_tpu::set_last_error("unknown handle");
+    return nullptr;
+  }
+  return it->second.bytes.data();
+}
+
+int64_t srt_buffer_size(srt_handle h) {
+  auto& reg = registry();
+  std::lock_guard<std::mutex> lock(reg.mu);
+  auto it = reg.buffers.find(h);
+  if (it == reg.buffers.end()) {
+    spark_rapids_tpu::set_last_error("unknown handle");
+    return -1;
+  }
+  return static_cast<int64_t>(it->second.bytes.size());
+}
+
+void srt_set_refcount_debug(int enabled) {
+  registry().refcount_debug.store(enabled != 0);
+}
+
+int64_t srt_live_handle_count(void) {
+  auto& reg = registry();
+  std::lock_guard<std::mutex> lock(reg.mu);
+  return static_cast<int64_t>(reg.buffers.size());
+}
+
+int64_t srt_leak_report(char* buf, int64_t buflen) {
+  auto& reg = registry();
+  std::lock_guard<std::mutex> lock(reg.mu);
+  std::string report;
+  for (const auto& [id, b] : reg.buffers) {
+    report += std::to_string(id) + " tag=" + (b.tag.empty() ? "?" : b.tag) +
+              " refcount=" + std::to_string(b.refcount) +
+              " nbytes=" + std::to_string(b.bytes.size()) +
+              " seq=" + std::to_string(b.seq) + "\n";
+  }
+  int64_t needed = static_cast<int64_t>(report.size()) + 1;
+  if (buf != nullptr && buflen > 0) {
+    int64_t n = std::min<int64_t>(buflen - 1, report.size());
+    std::memcpy(buf, report.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return needed;
+}
+
+}  /* extern "C" */

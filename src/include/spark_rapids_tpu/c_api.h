@@ -1,0 +1,134 @@
+/* C ABI of the TPU-native runtime shim (libspark_rapids_tpu.so).
+ *
+ * This is the foreign-function boundary of the framework: the layer the
+ * reference implements as a JNI bridge over cudf handles
+ * (RowConversionJni.cpp:22-68 — jlong handle marshaling, dtype wire
+ * arrays, error translation). Re-designed as a plain C API so every
+ * embedder binds the same way: the JNI bridge (src/jni/) and the Python
+ * ctypes binding (spark_rapids_jni_tpu/utils/native.py) are both thin
+ * wrappers over these functions.
+ *
+ * Responsibilities:
+ *   1. dtype wire format      — (type id, scale) int pairs, the exact
+ *                               arrays the reference marshals
+ *                               (RowConversionJni.cpp:56-61).
+ *   2. packed row codec       — bit-exact host implementation of the
+ *                               row format spec (RowConversion.java:43-102,
+ *                               row_conversion.cu:432-456): the JVM-side
+ *                               fast path for Spark UnsafeRow interop.
+ *   3. handle registry        — Java-long-sized opaque handles over host
+ *                               buffers with refcounting and a leak-
+ *                               tracking debug mode (the
+ *                               ai.rapids.refcount.debug analog,
+ *                               pom.xml:86,199).
+ *   4. error translation      — status codes + thread-local message
+ *                               (the CATCH_STD / JNI_NULL_CHECK analog,
+ *                               RowConversionJni.cpp:27,40,49-50,65).
+ */
+#ifndef SPARK_RAPIDS_TPU_C_API_H
+#define SPARK_RAPIDS_TPU_C_API_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#if defined(_WIN32)
+#define SRT_EXPORT __declspec(dllexport)
+#else
+#define SRT_EXPORT __attribute__((visibility("default")))
+#endif
+
+/* ---- status / error translation ------------------------------------- */
+
+typedef enum srt_status {
+  SRT_OK = 0,
+  SRT_ERR_INVALID = 1,   /* bad argument / layout mismatch */
+  SRT_ERR_TYPE = 2,      /* non-fixed-width or unknown type id */
+  SRT_ERR_OVERFLOW = 3,  /* INT_MAX batch-size cap exceeded */
+  SRT_ERR_NULLPTR = 4,   /* required pointer was NULL */
+  SRT_ERR_HANDLE = 5,    /* unknown / already-released handle */
+  SRT_ERR_UNKNOWN = 6
+} srt_status;
+
+/* Thread-local message for the last failing call on this thread. */
+SRT_EXPORT const char* srt_last_error(void);
+
+/* Library version string (build provenance; the version-info.properties
+ * analog of build/build-info). */
+SRT_EXPORT const char* srt_version(void);
+
+/* ---- dtype wire format ----------------------------------------------- */
+
+/* Type ids match spark_rapids_jni_tpu.dtype.TypeId (cudf 22.04 native
+ * ids, RowConversion.java:119). */
+
+/* Row-format width in bytes of a fixed-width type id; 0 if not
+ * fixed-width. */
+SRT_EXPORT int32_t srt_type_width(int32_t type_id);
+
+/* ---- packed row layout (RowConversion.java:43-102) ------------------- */
+
+typedef struct srt_row_layout {
+  int32_t num_columns;
+  int32_t validity_offset; /* first validity byte */
+  int32_t validity_bytes;  /* (num_columns + 7) / 8 */
+  int32_t row_size;        /* padded to a multiple of 8 */
+} srt_row_layout;
+
+/* Compute per-column offsets/widths and the row envelope.
+ * col_offsets/col_widths must hold num_columns int32 each. */
+SRT_EXPORT srt_status srt_compute_row_layout(
+    const int32_t* type_ids, int32_t num_columns, int32_t* col_offsets,
+    int32_t* col_widths, srt_row_layout* layout);
+
+/* 2 GB split granularity: (INT_MAX / row_size) / 32 * 32
+ * (row_conversion.cu:476-479). Returns 0 on error. */
+SRT_EXPORT int64_t srt_max_rows_per_batch(int32_t row_size);
+
+/* ---- packed row codec -------------------------------------------------
+ * Column buffers are little-endian fixed-width arrays (BOOL8 = 1 byte per
+ * value). col_valid[i] is NULL (no nulls) or num_rows bytes of 0/1.
+ * out_rows must hold num_rows * layout.row_size bytes. */
+
+SRT_EXPORT srt_status srt_pack_rows(
+    const int32_t* type_ids, int32_t num_columns,
+    const void* const* col_data, const uint8_t* const* col_valid,
+    int64_t num_rows, uint8_t* out_rows);
+
+/* Inverse: rows -> caller-allocated column buffers + per-column validity
+ * bytes (always written; 1 = valid). */
+SRT_EXPORT srt_status srt_unpack_rows(
+    const int32_t* type_ids, int32_t num_columns, const uint8_t* rows,
+    int64_t num_rows, void* const* col_data_out,
+    uint8_t* const* col_valid_out);
+
+/* ---- handle registry --------------------------------------------------
+ * Opaque int64 handles (the jlong of RowConversionJni.cpp:31) over host
+ * byte buffers. Create copies the input. Handles are refcounted:
+ * retain/release; release of the last reference frees the buffer. */
+
+typedef int64_t srt_handle;
+
+SRT_EXPORT srt_handle srt_buffer_create(const void* data, int64_t nbytes,
+                                        const char* tag);
+/* Allocate an uninitialized buffer (for unpack targets). */
+SRT_EXPORT srt_handle srt_buffer_alloc(int64_t nbytes, const char* tag);
+SRT_EXPORT srt_status srt_buffer_retain(srt_handle h);
+SRT_EXPORT srt_status srt_buffer_release(srt_handle h);
+SRT_EXPORT void* srt_buffer_data(srt_handle h); /* NULL on bad handle */
+SRT_EXPORT int64_t srt_buffer_size(srt_handle h); /* -1 on bad handle */
+
+/* Leak tracking (the refcount-debug test mode of SURVEY.md §4). */
+SRT_EXPORT void srt_set_refcount_debug(int enabled);
+SRT_EXPORT int64_t srt_live_handle_count(void);
+/* Write a report of live handles ("id tag refcount nbytes" lines) into
+ * buf; returns the number of bytes that would be required. */
+SRT_EXPORT int64_t srt_leak_report(char* buf, int64_t buflen);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* SPARK_RAPIDS_TPU_C_API_H */

@@ -1,0 +1,107 @@
+/* JNI bridge: com.nvidia.spark.rapids.jni.HostBuffer native methods over
+ * the handle registry (src/cpp/handle_registry.cpp). Compiled only when
+ * CMake finds a JDK (SRT_HAVE_JNI). */
+
+#ifdef SRT_HAVE_JNI
+
+#include <jni.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "spark_rapids_tpu/c_api.h"
+
+namespace {
+
+void throw_java(JNIEnv* env, const std::string& msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, msg.c_str());
+}
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferCreate(JNIEnv* env, jclass,
+                                                         jbyteArray data_j,
+                                                         jstring tag_j) {
+  if (data_j == nullptr) {
+    throw_java(env, "data is null");
+    return 0;
+  }
+  jsize n = env->GetArrayLength(data_j);
+  std::vector<int8_t> host(static_cast<size_t>(n));
+  env->GetByteArrayRegion(data_j, 0, n, host.data());
+  const char* tag = tag_j ? env->GetStringUTFChars(tag_j, nullptr) : nullptr;
+  srt_handle h = srt_buffer_create(host.data(), n, tag ? tag : "");
+  if (tag) env->ReleaseStringUTFChars(tag_j, tag);
+  if (h == 0) throw_java(env, srt_last_error());
+  return h;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferSize(JNIEnv* env, jclass,
+                                                       jlong h) {
+  int64_t n = srt_buffer_size(h);
+  if (n < 0) throw_java(env, srt_last_error());
+  return n;
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferGet(JNIEnv* env, jclass,
+                                                      jlong h) {
+  int64_t n = srt_buffer_size(h);
+  void* data = srt_buffer_data(h);
+  if (n < 0 || data == nullptr) {
+    throw_java(env, srt_last_error());
+    return nullptr;
+  }
+  jbyteArray out = env->NewByteArray(static_cast<jsize>(n));
+  if (out == nullptr) return nullptr;
+  env->SetByteArrayRegion(out, 0, static_cast<jsize>(n),
+                          static_cast<const jbyte*>(data));
+  return out;
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferRelease(JNIEnv* env, jclass,
+                                                          jlong h) {
+  if (srt_buffer_release(h) != SRT_OK) throw_java(env, srt_last_error());
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_HostBuffer_nativeLiveHandleCount(JNIEnv*,
+                                                                  jclass) {
+  return srt_live_handle_count();
+}
+
+/* RowConversion layout helpers (declared in RowConversion.java). */
+
+JNIEXPORT jint JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_rowSize(JNIEnv* env, jclass,
+                                                       jintArray type_ids_j) {
+  jsize n = env->GetArrayLength(type_ids_j);
+  std::vector<int32_t> ids(static_cast<size_t>(n));
+  env->GetIntArrayRegion(type_ids_j, 0, n, ids.data());
+  std::vector<int32_t> offs(static_cast<size_t>(n)),
+      widths(static_cast<size_t>(n));
+  srt_row_layout layout{};
+  if (srt_compute_row_layout(ids.data(), n, offs.data(), widths.data(),
+                             &layout) != SRT_OK) {
+    throw_java(env, srt_last_error());
+    return 0;
+  }
+  return layout.row_size;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_maxRowsPerBatch(JNIEnv*, jclass,
+                                                               jint row_size) {
+  return srt_max_rows_per_batch(row_size);
+}
+
+}  /* extern "C" */
+
+#endif /* SRT_HAVE_JNI */

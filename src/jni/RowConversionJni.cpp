@@ -1,0 +1,161 @@
+/* JNI bridge: com.nvidia.spark.rapids.jni.RowConversion native methods.
+ *
+ * Mirrors the conventions of the reference bridge
+ * (RowConversionJni.cpp:22-68) over the C ABI instead of cudf:
+ *   - jlong handles in/out (registry ids, not raw pointers — a stale
+ *     handle raises instead of crashing);
+ *   - (type id, scale) int arrays as the schema wire format
+ *     (RowConversionJni.cpp:56-61);
+ *   - null-handle guards and exception translation into
+ *     RuntimeException (JNI_NULL_CHECK / CATCH_STD analogs).
+ *
+ * The device side lives in the embedded Python/JAX runtime; this bridge
+ * serves the host fast path (UnsafeRow batches) and buffer hand-off. It
+ * compiles only when CMake finds a JDK (SRT_HAVE_JNI).
+ *
+ * Wire contract (see java/.../RowConversion.java):
+ *   convertToRows(long tableHandle, int[] typeIds, long numRows)
+ *       -> long rowsHandle          (packed row bytes, n * row_size)
+ *   convertFromRows(long rowsHandle, int[] typeIds, int[] scales,
+ *                   long numRows)
+ *       -> long[] columnHandles     (num_columns data + num_columns
+ *                                    validity buffers, released to Java)
+ * where tableHandle's buffer is the concatenation of the per-column
+ * fixed-width buffers followed by per-column validity bytes (the layout
+ * the Java facade assembles). */
+
+#ifdef SRT_HAVE_JNI
+
+#include <jni.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "spark_rapids_tpu/c_api.h"
+
+namespace {
+
+void throw_java(JNIEnv* env, const std::string& msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, msg.c_str());
+}
+
+bool check_status(JNIEnv* env, srt_status s) {
+  if (s == SRT_OK) return true;
+  throw_java(env, srt_last_error());
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(
+    JNIEnv* env, jclass, jlong table_handle, jintArray type_ids_j,
+    jlong num_rows) {
+  if (table_handle == 0) {
+    throw_java(env, "table handle is null");
+    return 0;
+  }
+  jsize num_cols = env->GetArrayLength(type_ids_j);
+  std::vector<int32_t> type_ids(num_cols);
+  env->GetIntArrayRegion(type_ids_j, 0, num_cols, type_ids.data());
+
+  std::vector<int32_t> offsets(num_cols), widths(num_cols);
+  srt_row_layout layout{};
+  if (!check_status(env, srt_compute_row_layout(type_ids.data(), num_cols,
+                                                offsets.data(),
+                                                widths.data(), &layout)))
+    return 0;
+
+  auto* base = static_cast<uint8_t*>(srt_buffer_data(table_handle));
+  if (base == nullptr) {
+    throw_java(env, srt_last_error());
+    return 0;
+  }
+  // table buffer = column data buffers back to back, then per-column
+  // validity byte vectors back to back
+  std::vector<const void*> col_data(num_cols);
+  std::vector<const uint8_t*> col_valid(num_cols);
+  uint8_t* cursor = base;
+  for (jsize c = 0; c < num_cols; ++c) {
+    col_data[c] = cursor;
+    cursor += static_cast<int64_t>(widths[c]) * num_rows;
+  }
+  for (jsize c = 0; c < num_cols; ++c) {
+    col_valid[c] = cursor;
+    cursor += num_rows;
+  }
+
+  srt_handle rows = srt_buffer_alloc(
+      static_cast<int64_t>(layout.row_size) * num_rows, "rows");
+  if (rows == 0) {
+    throw_java(env, srt_last_error());
+    return 0;
+  }
+  srt_status s = srt_pack_rows(
+      type_ids.data(), num_cols, col_data.data(), col_valid.data(),
+      num_rows, static_cast<uint8_t*>(srt_buffer_data(rows)));
+  if (s != SRT_OK) {
+    srt_buffer_release(rows);
+    throw_java(env, srt_last_error());
+    return 0;
+  }
+  return rows;  // ownership to Java (RowConversionJni.cpp:33-38 analog)
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
+    JNIEnv* env, jclass, jlong rows_handle, jintArray type_ids_j,
+    jintArray scales_j, jlong num_rows) {
+  (void)scales_j;  // scales don't affect layout; the Java facade keeps them
+  if (rows_handle == 0) {
+    throw_java(env, "rows handle is null");
+    return nullptr;
+  }
+  jsize num_cols = env->GetArrayLength(type_ids_j);
+  std::vector<int32_t> type_ids(num_cols);
+  env->GetIntArrayRegion(type_ids_j, 0, num_cols, type_ids.data());
+
+  auto* rows = static_cast<uint8_t*>(srt_buffer_data(rows_handle));
+  if (rows == nullptr) {
+    throw_java(env, srt_last_error());
+    return nullptr;
+  }
+
+  std::vector<srt_handle> handles;
+  std::vector<void*> col_data(num_cols);
+  std::vector<uint8_t*> col_valid(num_cols);
+  auto fail = [&](const char* msg) -> jlongArray {
+    for (srt_handle h : handles) srt_buffer_release(h);
+    throw_java(env, msg);
+    return nullptr;
+  };
+  for (jsize c = 0; c < num_cols; ++c) {
+    int32_t w = srt_type_width(type_ids[c]);
+    if (w <= 0) return fail("non-fixed-width type");
+    srt_handle hd = srt_buffer_alloc(static_cast<int64_t>(w) * num_rows,
+                                     "col_data");
+    srt_handle hv = srt_buffer_alloc(num_rows, "col_valid");
+    if (hd == 0 || hv == 0) return fail(srt_last_error());
+    handles.push_back(hd);
+    handles.push_back(hv);
+    col_data[c] = srt_buffer_data(hd);
+    col_valid[c] = static_cast<uint8_t*>(srt_buffer_data(hv));
+  }
+  srt_status s = srt_unpack_rows(type_ids.data(), num_cols, rows, num_rows,
+                                 col_data.data(), col_valid.data());
+  if (s != SRT_OK) return fail(srt_last_error());
+
+  jlongArray out = env->NewLongArray(static_cast<jsize>(handles.size()));
+  if (out == nullptr) return fail("allocation failure");
+  env->SetLongArrayRegion(out, 0, static_cast<jsize>(handles.size()),
+                          reinterpret_cast<const jlong*>(handles.data()));
+  return out;  // convert_table_for_return analog (RowConversionJni.cpp:63)
+}
+
+}  /* extern "C" */
+
+#endif  /* SRT_HAVE_JNI */

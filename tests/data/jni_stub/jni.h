@@ -1,0 +1,49 @@
+/* Minimal jni.h stand-in for COMPILE-CHECKING src/jni/ in environments
+ * without a JDK (this image has none). Declares exactly the subset of the
+ * JNI surface the bridge uses, with real JNI's shapes. NOT shipped, NOT a
+ * JNI implementation — tests/test_native.py points g++ -fsyntax-only at
+ * this directory so signature typos in the bridge fail CI even when the
+ * real JNI build is skipped (CMake gates on find_package(JNI)). */
+#ifndef SRT_TEST_JNI_STUB_H
+#define SRT_TEST_JNI_STUB_H
+
+#include <cstdint>
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef uint8_t jboolean;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+struct _jobject {};
+typedef _jobject* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jbyteArray;
+typedef jarray jintArray;
+typedef jarray jlongArray;
+typedef jobject jthrowable;
+
+struct JNIEnv {
+  jclass FindClass(const char*);
+  jint ThrowNew(jclass, const char*);
+  jsize GetArrayLength(jarray);
+  void GetIntArrayRegion(jintArray, jsize, jsize, jint*);
+  void GetByteArrayRegion(jbyteArray, jsize, jsize, jbyte*);
+  jbyteArray NewByteArray(jsize);
+  void SetByteArrayRegion(jbyteArray, jsize, jsize, const jbyte*);
+  jlongArray NewLongArray(jsize);
+  void SetLongArrayRegion(jlongArray, jsize, jsize, const jlong*);
+  const char* GetStringUTFChars(jstring, jboolean*);
+  void ReleaseStringUTFChars(jstring, const char*);
+};
+
+#endif /* SRT_TEST_JNI_STUB_H */

@@ -252,6 +252,53 @@ class TestIpc:
         assert back.to_pydict() == t.to_pydict()
 
 
+def test_empty_not_in_keeps_non_null_rows(tmp_path, rng):
+    t = _typed_table(rng, n=40)
+    p = tmp_path / "t.parquet"
+    write_parquet(t, p)
+    back = read_parquet(p, filters=col("s").not_in([]))
+    # SQL: x NOT IN () is true for non-null x, null for null x
+    assert back.row_count == t.row_count - t["s"].null_count()
+    back2 = read_parquet(p, filters=col("i8").isin([]))
+    assert back2.row_count == 0
+
+
+def test_pyarrow_equality_alias(tmp_path, rng):
+    t = _typed_table(rng, n=30)
+    p = tmp_path / "t.parquet"
+    write_parquet(t, p)
+    i8 = np.asarray(t["i8"].to_numpy())
+    v = int(i8[0])
+    want = int((i8 == v).sum())
+    assert read_parquet(p, filters=[("i8", "=", v)]).row_count == want
+    assert (
+        read_parquet(p, filters=[("i8", "<>", v)]).row_count
+        == t.row_count - want
+    )
+
+
+def test_csv_explicit_names_skip_header(tmp_path, rng):
+    t = Table.from_pydict({"a": np.arange(5), "b": np.arange(5.0)})
+    p = tmp_path / "t.csv"
+    write_csv(t, p)
+    back = read_csv(p, column_names=["x", "y"], header=True)
+    assert back.row_count == 5
+    assert np.array_equal(back["x"].to_numpy(), np.arange(5))
+
+
+def test_csv_projection_with_predicate_column(tmp_path, rng):
+    n = 60
+    t = Table.from_pydict(
+        {"a": rng.integers(0, 5, n), "b": rng.integers(0, 9, n)}
+    )
+    p = tmp_path / "t.csv"
+    write_csv(t, p)
+    back = read_csv(p, columns=["a"], filters=col("b") > 4)
+    b = np.asarray(t["b"].to_numpy())
+    assert list(back.names) == ["a"]
+    assert back.row_count == int((b > 4).sum())
+
+
 def test_from_dnf_shapes():
     p1 = from_dnf([("a", "==", 1), ("b", ">", 2)])
     assert p1.columns() == {"a", "b"}
