@@ -57,12 +57,19 @@ public class RowConversion {
       numBatches = 1;
     }
     HostBuffer[] out = new HostBuffer[numBatches];
-    // The native side packs the whole table; batching splits the handle
-    // space on 32-row multiples like the reference
-    // (RowConversion.java:36-37,104-111).
+    // Each batch packs its own disjoint [start, start+count) row range —
+    // maxRows is a multiple of 32 so validity words never straddle
+    // batches (RowConversion.java:36-37,104-111).
     for (int b = 0; b < numBatches; b++) {
+      long start = b * maxRows;
+      long count = Math.min(maxRows, numRows - start);
+      if (numRows == 0) {
+        start = 0;
+        count = 0;
+      }
       out[b] = new HostBuffer(
-          convertToRowsNative(table.getHandle(), typeIds, numRows));
+          convertToRowsNative(table.getHandle(), typeIds, numRows, start,
+                              count));
     }
     return out;
   }
@@ -93,7 +100,9 @@ public class RowConversion {
   public static native long maxRowsPerBatch(int rowSize);
 
   private static native long convertToRowsNative(long tableHandle,
-                                                 int[] typeIds, long numRows);
+                                                 int[] typeIds, long numRows,
+                                                 long startRow,
+                                                 long batchRows);
 
   private static native long[] convertFromRowsNative(long rowsHandle,
                                                      int[] typeIds,

@@ -225,19 +225,21 @@ def copy_if_else(lhs: Column, rhs: Column, mask: Column) -> Column:
 
 def pack_bitmask(valid: jax.Array) -> jax.Array:
     """(n,) bool -> ceil(n/8) uint8, LSB-first (Arrow/cudf bitmask_type
-    layout; the device-side analog of interop.pack_validity). Jittable —
-    this is the vectorized replacement for the reference's warp-ballot
-    word writes (row_conversion.cu:158-165)."""
+    layout; the device-side analog of interop.pack_validity). Jittable.
+
+    Delegates to the row codec's bit packer (rows._pack_validity_bytes) —
+    one normative implementation of the LSB-first layout, shared with the
+    packed-row validity tail."""
+    from . import rows
+
     n = valid.shape[0]
-    padded = jnp.zeros(((n + 7) // 8) * 8, dtype=jnp.uint8)
-    padded = padded.at[:n].set(valid.astype(jnp.uint8))
-    bits = padded.reshape(-1, 8)
-    weights = (np.uint8(1) << np.arange(8, dtype=np.uint8)).astype(np.uint8)
-    return (bits * weights[None, :]).sum(axis=1).astype(jnp.uint8)
+    # one "row" whose columns are the n bits
+    return rows._pack_validity_bytes(valid[None, :], n)[0]
 
 
 def unpack_bitmask(packed: jax.Array, n: int) -> jax.Array:
-    """ceil(n/8) uint8 LSB-first -> (n,) bool."""
-    shifts = np.arange(8, dtype=np.uint8)
-    bits = (packed[:, None] >> shifts[None, :]) & np.uint8(1)
-    return bits.reshape(-1)[:n].astype(jnp.bool_)
+    """ceil(n/8) uint8 LSB-first -> (n,) bool (inverse of pack_bitmask,
+    same shared core as the row codec)."""
+    from . import rows
+
+    return rows._unpack_validity_bytes(packed[None, :], n)[0]

@@ -1,0 +1,40 @@
+"""Hand-written Pallas TPU kernels for the framework's hot ops.
+
+The reference hand-writes CUDA for exactly one Spark-specific hot path —
+the row⇄columnar transpose (row_conversion.cu:48-304, shared-memory tiled,
+warp ballots) — and gets everything else from libcudf's kernels. Here the
+split is: XLA fusion covers most of the op library, and this package holds
+explicit Pallas kernels for the paths where controlling VMEM tiling and
+fusing multi-column passes matters:
+
+* ``row_transpose`` — packed-row assembly/disassembly tiles (the CUDA
+  kernel pair's TPU replacement; 48 KB shared memory -> VMEM blocks, warp
+  ballots -> vectorized bit-weight reductions).
+* ``hashing`` — fused multi-column Murmur3 table hashing in one VMEM pass.
+
+Every kernel has an ``interpret=`` escape hatch so the CPU test tier
+(tests/conftest.py) exercises the same code path the TPU runs.
+"""
+
+import jax
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a real TPU (including the axon
+    tunnel platform, whose platform string is not "tpu")."""
+    try:
+        d = jax.devices()[0]
+        return "tpu" in (d.platform + " " + d.device_kind).lower()
+    except Exception:
+        return False
+
+
+def default_interpret() -> bool:
+    """Pallas ``interpret=`` default: Mosaic on TPU, interpreter elsewhere
+    (the CPU test tier runs the same kernel code interpreted)."""
+    return not on_tpu()
+
+
+from . import hashing, row_transpose  # noqa: E402,F401
+
+__all__ = ["row_transpose", "hashing", "on_tpu", "default_interpret"]

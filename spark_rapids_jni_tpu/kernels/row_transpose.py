@@ -1,0 +1,241 @@
+"""Pallas TPU kernels for the packed-row transpose.
+
+The reference implements this pair as CUDA kernels staging through 48 KB
+of shared memory with warp ballots for validity (row_conversion.cu:48-171
+``copy_to_fixed_width_columns``, :173-304 ``copy_from_fixed_width_columns``).
+The TPU redesign:
+
+* Grid over row tiles; each grid step assembles/disassembles one
+  ``(TILE_ROWS, row_size)`` uint8 block entirely in VMEM — the VMEM block
+  is the 48 KB shared-memory stage, but sized by BlockSpec instead of a
+  hand-tuned ``<<<blocks, threads, shared>>>`` geometry
+  (row_conversion.cu:315-367 ``calc_fixed_width_kernel_dims``).
+* 64-bit word handling stays outside the kernel: columns arrive as
+  little-endian ``(n, width)`` uint8 matrices (bitcast is free/fused in
+  XLA), so the kernel body is pure uint8/int32 — no Mosaic i64 paths.
+* Validity bits: the CUDA side uses ``__ballot_sync`` + byte atomics
+  (row_conversion.cu:158-165, :255-272). Here each row's (num_cols,) 0/1
+  validity vector is packed LSB-first into bytes with a bit-weight
+  dot-product over 8-wide groups — one vectorized reduction, no atomics
+  (SURVEY.md §7 hard part 3).
+* Ragged edges: row counts are padded to the tile multiple by the caller
+  wrapper, never inside the kernel, so every grid step is full.
+
+Dispatch policy lives in ``rows.py``: XLA fusion is the default backend
+(it fuses the same assembly into one HBM-bound kernel); the Pallas pair is
+selected explicitly (``backend="pallas"``) or by the auto heuristic for
+large batches on TPU. Both produce bit-identical bytes — the golden
+round-trip test runs each against the other.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .. import dtype as dt
+from ..rows import RowLayout
+
+# Rows per grid step. Multiple of 32 (the reference's validity-word batch
+# alignment, row_conversion.cu:477-479) and of the int8 sublane tile (32).
+TILE_ROWS = 512
+
+# Typed zero for BlockSpec index maps: a bare python 0 traces as i64 under
+# jax_enable_x64 and Mosaic refuses the (i32, i64) index tuple.
+_Z = np.int32(0)
+
+
+def _pad_rows(arr: jax.Array, n_padded: int) -> jax.Array:
+    """Zero-pad axis 0 of ``arr`` to ``n_padded`` rows."""
+    pad = n_padded - arr.shape[0]
+    if pad == 0:
+        return arr
+    widths = ((0, pad),) + ((0, 0),) * (arr.ndim - 1)
+    return jnp.pad(arr, widths)
+
+
+def _pack_kernel(layout: RowLayout, *refs):
+    """One grid step: assemble (TILE_ROWS, row_size) packed bytes.
+
+    ``refs`` = per-column (TILE_ROWS, width) uint8 byte refs, then the
+    (TILE_ROWS, num_cols) uint8 validity ref, then the output ref.
+    """
+    *col_refs, valid_ref, out_ref = refs
+    num_cols = len(layout.dtypes)
+    parts = []
+    cursor = 0
+    for ref, off, w in zip(
+        col_refs, layout.column_offsets, layout.column_widths
+    ):
+        if off > cursor:  # alignment gap -> zero padding bytes
+            parts.append(
+                jnp.zeros((TILE_ROWS, off - cursor), dtype=jnp.uint8)
+            )
+        parts.append(ref[...])
+        cursor = off + w
+    if layout.validity_offset > cursor:
+        parts.append(
+            jnp.zeros(
+                (TILE_ROWS, layout.validity_offset - cursor), dtype=jnp.uint8
+            )
+        )
+    # Validity: (TILE, cols) 0/1 bytes -> LSB-first packed bytes via one
+    # matmul against an in-kernel bit-weight selection matrix — the MXU
+    # replacement for warp ballots/byte atomics (values <= 255, exact in
+    # f32). 3-D reductions are avoided: Mosaic rejects them.
+    vbytes = layout.validity_bytes
+    v = valid_ref[...]
+    if num_cols % 8:
+        # no jnp.pad here: its weak-int64 fill value hits an unsupported
+        # scalar i64->u8 convert in Mosaic; typed zeros lower cleanly
+        v = jnp.concatenate(
+            [
+                v,
+                jnp.zeros(
+                    (TILE_ROWS, vbytes * 8 - num_cols), dtype=jnp.uint8
+                ),
+            ],
+            axis=1,
+        )
+    # All literals below are typed scalars: with jax_enable_x64 on, a bare
+    # python int promotes int32 arrays through int64, and Mosaic's i64->i32
+    # array convert does not lower.
+    vf = v.astype(jnp.int32).astype(jnp.float32)
+    r = jax.lax.broadcasted_iota(jnp.int32, (vbytes * 8, vbytes), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (vbytes * 8, vbytes), 1)
+    weights = jnp.where(
+        r // jnp.int32(8) == c,
+        jnp.int32(1) << (r % jnp.int32(8)),
+        jnp.int32(0),
+    ).astype(jnp.float32)
+    packed = jnp.dot(vf, weights, preferred_element_type=jnp.float32)
+    parts.append(packed.astype(jnp.int32).astype(jnp.uint8))
+    tail = layout.row_size - (layout.validity_offset + vbytes)
+    if tail:  # 64-bit row padding (row_conversion.cu:454-455)
+        parts.append(jnp.zeros((TILE_ROWS, tail), dtype=jnp.uint8))
+    out_ref[...] = jnp.concatenate(parts, axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("layout", "interpret")
+)
+def pack_rows_pallas(
+    col_bytes: tuple[jax.Array, ...],
+    valid: jax.Array,
+    layout: RowLayout,
+    interpret: bool = False,
+) -> jax.Array:
+    """(n, w_i) uint8 byte matrices + (n, num_cols) 0/1 validity
+    -> (n, row_size) packed rows. ``n`` may be any size; tiles are padded
+    internally and the result sliced back.
+    """
+    n = valid.shape[0]
+    n_padded = max((n + TILE_ROWS - 1) // TILE_ROWS * TILE_ROWS, TILE_ROWS)
+    grid = n_padded // TILE_ROWS
+    col_bytes = tuple(_pad_rows(c, n_padded) for c in col_bytes)
+    valid = _pad_rows(valid, n_padded)
+
+    in_specs = [
+        pl.BlockSpec((TILE_ROWS, c.shape[1]), lambda i: (i, _Z))
+        for c in col_bytes
+    ]
+    in_specs.append(
+        pl.BlockSpec((TILE_ROWS, valid.shape[1]), lambda i: (i, _Z))
+    )
+    out = pl.pallas_call(
+        functools.partial(_pack_kernel, layout),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (TILE_ROWS, layout.row_size), lambda i: (i, _Z)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_padded, layout.row_size), jnp.uint8
+        ),
+        interpret=interpret,
+    )(*col_bytes, valid)
+    return out[:n]
+
+
+def _unpack_kernel(layout: RowLayout, rows_ref, *out_refs):
+    """One grid step: split a (TILE_ROWS, row_size) block into per-column
+    byte matrices + the (TILE_ROWS, num_cols) validity bytes."""
+    *col_refs, valid_ref = out_refs
+    tile = rows_ref[...]
+    for ref, off, w in zip(
+        col_refs, layout.column_offsets, layout.column_widths
+    ):
+        ref[...] = tile[:, off : off + w]
+    num_cols = len(layout.dtypes)
+    vbytes = layout.validity_bytes
+    # Bit unpack without 3-D shapes: replicate each validity byte across
+    # its 8 columns with a selection matmul, then shift/mask per column.
+    vb = tile[
+        :, layout.validity_offset : layout.validity_offset + vbytes
+    ]
+    # typed scalars throughout: see the weak-literal note in _pack_kernel
+    vf = (vb.astype(jnp.int32) & jnp.int32(255)).astype(jnp.float32)
+    r = jax.lax.broadcasted_iota(jnp.int32, (vbytes, vbytes * 8), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (vbytes, vbytes * 8), 1)
+    expand = jnp.where(
+        c // jnp.int32(8) == r, jnp.int32(1), jnp.int32(0)
+    ).astype(jnp.float32)
+    prod = jnp.dot(vf, expand, preferred_element_type=jnp.float32).astype(
+        jnp.int32
+    )
+    shifts = jax.lax.broadcasted_iota(
+        jnp.int32, (1, vbytes * 8), 1
+    ) % jnp.int32(8)
+    bits = (prod >> shifts) & jnp.int32(1)
+    valid_ref[...] = bits[:, :num_cols].astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "interpret"))
+def unpack_rows_pallas(
+    rows: jax.Array, layout: RowLayout, interpret: bool = False
+) -> tuple[list[jax.Array], jax.Array]:
+    """(n, row_size) packed rows -> ([(n, w_i) uint8 ...], (n, cols) 0/1)."""
+    n = rows.shape[0]
+    n_padded = max((n + TILE_ROWS - 1) // TILE_ROWS * TILE_ROWS, TILE_ROWS)
+    grid = n_padded // TILE_ROWS
+    rows = _pad_rows(rows, n_padded)
+    num_cols = len(layout.dtypes)
+
+    out_shapes = [
+        jax.ShapeDtypeStruct((n_padded, w), jnp.uint8)
+        for w in layout.column_widths
+    ]
+    out_shapes.append(jax.ShapeDtypeStruct((n_padded, num_cols), jnp.uint8))
+    out_specs = [
+        pl.BlockSpec((TILE_ROWS, w), lambda i: (i, _Z))
+        for w in layout.column_widths
+    ]
+    out_specs.append(pl.BlockSpec((TILE_ROWS, num_cols), lambda i: (i, _Z)))
+
+    outs = pl.pallas_call(
+        functools.partial(_unpack_kernel, layout),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((TILE_ROWS, layout.row_size), lambda i: (i, _Z))
+        ],
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(rows)
+    *cols, valid = outs
+    return [c[:n] for c in cols], valid[:n]
+
+
+def column_bytes_to_storage(raw: jax.Array, d: dt.DType) -> jax.Array:
+    """(n, width) little-endian bytes -> storage-dtype values (host of the
+    kernel boundary; mirrors rows._unpack_batch's bitcast step)."""
+    if d.is_boolean:
+        return raw[:, 0] != 0
+    target = np.dtype(d.storage_dtype)
+    if target.itemsize == 1:
+        return jax.lax.bitcast_convert_type(raw[:, 0], target)
+    return jax.lax.bitcast_convert_type(raw, target)

@@ -225,8 +225,32 @@ def _pack_batch(columns: Sequence[Column], layout: RowLayout) -> jax.Array:
 _pack_batch_jit = jax.jit(_pack_batch, static_argnames="layout")
 
 
+def _pack_batch_pallas(columns: Sequence[Column], layout: RowLayout):
+    from .kernels import row_transpose as rt
+
+    n = columns[0].data.shape[0]
+    col_bytes = tuple(_column_bytes(c) for c in columns)
+    valid = jnp.stack(
+        [
+            c.validity
+            if c.validity is not None
+            else jnp.ones((n,), dtype=jnp.bool_)
+            for c in columns
+        ],
+        axis=1,
+    ).astype(jnp.uint8)
+    from . import kernels
+
+    return rt.pack_rows_pallas(
+        col_bytes, valid, layout, interpret=kernels.default_interpret()
+    )
+
+
 def to_rows(
-    table: Table, split: bool = True, batch_rows: Optional[int] = None
+    table: Table,
+    split: bool = True,
+    batch_rows: Optional[int] = None,
+    backend: str = "xla",
 ) -> list[PackedRows]:
     """Columnar -> packed rows (``convert_to_rows``, row_conversion.cu:458-517).
 
@@ -234,7 +258,14 @@ def to_rows(
     ``ColumnVector[]`` return (RowConversion.java:104-111). ``batch_rows``
     overrides the INT_MAX-derived split size (testing / memory tuning); it
     is clamped to a multiple of 32 like the reference.
+
+    ``backend`` selects the device code path: ``"xla"`` (default — one
+    fused gather XLA compiles itself) or ``"pallas"`` (the explicit
+    VMEM-tiled kernel, kernels/row_transpose.py). Both emit identical
+    bytes; the round-trip tests cross-check them.
     """
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown backend {backend!r}")
     layout = compute_fixed_width_layout(table.dtypes())
     n = table.row_count
     if batch_rows is not None:
@@ -255,7 +286,12 @@ def to_rows(
             )
             for c in table.columns
         ]
-        out.append(PackedRows(_pack_batch_jit(cols, layout), layout))
+        data = (
+            _pack_batch_pallas(cols, layout)
+            if backend == "pallas"
+            else _pack_batch_jit(cols, layout)
+        )
+        out.append(PackedRows(data, layout))
         start = stop
         if start >= n:
             break
@@ -293,17 +329,34 @@ def _unpack_batch(
 _unpack_batch_jit = jax.jit(_unpack_batch, static_argnames="layout")
 
 
+def _unpack_batch_pallas(data: jax.Array, layout: RowLayout):
+    from . import kernels
+    from .kernels import row_transpose as rt
+
+    raw_cols, valid = rt.unpack_rows_pallas(
+        data, layout, interpret=kernels.default_interpret()
+    )
+    cols = [
+        rt.column_bytes_to_storage(raw, d)
+        for raw, d in zip(raw_cols, layout.dtypes)
+    ]
+    return cols, valid != 0
+
+
 def from_rows(
     packed: Sequence[PackedRows] | PackedRows,
     dtypes: Optional[Sequence[dt.DType]] = None,
     names: Optional[Sequence[str]] = None,
+    backend: str = "xla",
 ) -> Table:
     """Packed rows -> columnar (``convert_from_rows``, row_conversion.cu:519-575).
 
     ``dtypes`` is the schema the caller asserts — the (type id, scale) wire
     arrays of the reference JNI (RowConversionJni.cpp:56-61). Defaults to the
-    layout's recorded schema.
+    layout's recorded schema. ``backend`` as in :func:`to_rows`.
     """
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown backend {backend!r}")
     if isinstance(packed, PackedRows):
         packed = [packed]
     if not packed:
@@ -318,7 +371,10 @@ def from_rows(
             )
         layout = want
 
-    parts = [_unpack_batch_jit(p.data, layout) for p in packed]
+    unpack = (
+        _unpack_batch_pallas if backend == "pallas" else _unpack_batch_jit
+    )
+    parts = [unpack(p.data, layout) for p in packed]
     # Preserve the validity=None invariant for null-free columns so
     # downstream ops keep their no-nulls fast path. One batched (num_cols,)
     # reduction + a single host transfer, not a sync per column.

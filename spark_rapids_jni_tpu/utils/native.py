@@ -109,11 +109,17 @@ def _check(status: int) -> None:
         raise RuntimeError(f"native error ({status}): {msg}")
 
 
-def version() -> str:
+def _require() -> ctypes.CDLL:
+    """load() with the documented failure mode: RuntimeError (never
+    AttributeError on None) so callers can catch-and-fall-back."""
     lib = load()
     if lib is None:
         raise RuntimeError("native library not available")
-    return lib.srt_version().decode()
+    return lib
+
+
+def version() -> str:
+    return _require().srt_version().decode()
 
 
 # ---------------------------------------------------------------------------
@@ -239,7 +245,7 @@ def unpack_rows(
 # ---------------------------------------------------------------------------
 
 def buffer_create(data: bytes, tag: str = "") -> int:
-    lib = load()
+    lib = _require()
     h = lib.srt_buffer_create(data, len(data), tag.encode())
     if h == 0:
         _check(1)
@@ -247,32 +253,34 @@ def buffer_create(data: bytes, tag: str = "") -> int:
 
 
 def buffer_release(handle: int) -> None:
-    _check(load().srt_buffer_release(handle))
+    _check(_require().srt_buffer_release(handle))
 
 
 def buffer_retain(handle: int) -> None:
-    _check(load().srt_buffer_retain(handle))
+    _check(_require().srt_buffer_retain(handle))
 
 
 def buffer_bytes(handle: int) -> bytes:
-    lib = load()
+    lib = _require()
     size = lib.srt_buffer_size(handle)
     if size < 0:
         _check(5)
+    if size == 0:
+        return b""
     ptr = lib.srt_buffer_data(handle)
     return ctypes.string_at(ptr, size)
 
 
 def live_handle_count() -> int:
-    return load().srt_live_handle_count()
+    return _require().srt_live_handle_count()
 
 
 def set_refcount_debug(enabled: bool) -> None:
-    load().srt_set_refcount_debug(1 if enabled else 0)
+    _require().srt_set_refcount_debug(1 if enabled else 0)
 
 
 def leak_report() -> str:
-    lib = load()
+    lib = _require()
     needed = lib.srt_leak_report(None, 0)
     buf = ctypes.create_string_buffer(int(needed))
     lib.srt_leak_report(buf, needed)

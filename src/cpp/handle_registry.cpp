@@ -108,6 +108,10 @@ srt_status srt_buffer_release(srt_handle h) {
 }
 
 void* srt_buffer_data(srt_handle h) {
+  // Non-null sentinel for valid zero-length buffers: callers use nullptr
+  // to mean "bad handle", and vector<uint8_t>::data() may return nullptr
+  // when empty. Zero-byte reads/writes through this pointer are no-ops.
+  static uint8_t empty_sentinel = 0;
   auto& reg = registry();
   std::lock_guard<std::mutex> lock(reg.mu);
   auto it = reg.buffers.find(h);
@@ -115,6 +119,7 @@ void* srt_buffer_data(srt_handle h) {
     spark_rapids_tpu::set_last_error("unknown handle");
     return nullptr;
   }
+  if (it->second.bytes.empty()) return &empty_sentinel;
   return it->second.bytes.data();
 }
 

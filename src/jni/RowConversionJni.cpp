@@ -14,15 +14,21 @@
  * compiles only when CMake finds a JDK (SRT_HAVE_JNI).
  *
  * Wire contract (see java/.../RowConversion.java):
- *   convertToRows(long tableHandle, int[] typeIds, long numRows)
- *       -> long rowsHandle          (packed row bytes, n * row_size)
+ *   convertToRows(long tableHandle, int[] typeIds, long numRows,
+ *                 long startRow, long batchRows)
+ *       -> long rowsHandle          (packed bytes for rows
+ *                                    [startRow, startRow+batchRows),
+ *                                    batch_rows * row_size)
  *   convertFromRows(long rowsHandle, int[] typeIds, int[] scales,
  *                   long numRows)
- *       -> long[] columnHandles     (num_columns data + num_columns
- *                                    validity buffers, released to Java)
+ *       -> long[] columnHandles     (num_columns data buffers first,
+ *                                    then num_columns validity buffers,
+ *                                    released to Java)
  * where tableHandle's buffer is the concatenation of the per-column
  * fixed-width buffers followed by per-column validity bytes (the layout
- * the Java facade assembles). */
+ * the Java facade assembles). Buffer sizes are validated against the
+ * layout before any pointer walk — an undersized handle raises instead
+ * of reading past the registry allocation. */
 
 #ifdef SRT_HAVE_JNI
 
@@ -54,9 +60,13 @@ extern "C" {
 JNIEXPORT jlong JNICALL
 Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(
     JNIEnv* env, jclass, jlong table_handle, jintArray type_ids_j,
-    jlong num_rows) {
+    jlong num_rows, jlong start_row, jlong batch_rows) {
   if (table_handle == 0) {
     throw_java(env, "table handle is null");
+    return 0;
+  }
+  if (start_row < 0 || batch_rows < 0 || start_row + batch_rows > num_rows) {
+    throw_java(env, "batch range out of bounds");
     return 0;
   }
   jsize num_cols = env->GetArrayLength(type_ids_j);
@@ -75,29 +85,39 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(
     throw_java(env, srt_last_error());
     return 0;
   }
-  // table buffer = column data buffers back to back, then per-column
-  // validity byte vectors back to back
+  // Validate the handle's size against the layout before any pointer
+  // walk: data buffers back to back + per-column validity byte vectors.
+  int64_t data_bytes = 0;
+  for (jsize c = 0; c < num_cols; ++c) {
+    data_bytes += static_cast<int64_t>(widths[c]) * num_rows;
+  }
+  int64_t required = data_bytes + static_cast<int64_t>(num_cols) * num_rows;
+  if (srt_buffer_size(table_handle) < required) {
+    throw_java(env, "table buffer smaller than layout requires");
+    return 0;
+  }
+  // Column pointers offset to this batch's first row.
   std::vector<const void*> col_data(num_cols);
   std::vector<const uint8_t*> col_valid(num_cols);
   uint8_t* cursor = base;
   for (jsize c = 0; c < num_cols; ++c) {
-    col_data[c] = cursor;
+    col_data[c] = cursor + static_cast<int64_t>(widths[c]) * start_row;
     cursor += static_cast<int64_t>(widths[c]) * num_rows;
   }
   for (jsize c = 0; c < num_cols; ++c) {
-    col_valid[c] = cursor;
+    col_valid[c] = cursor + start_row;
     cursor += num_rows;
   }
 
   srt_handle rows = srt_buffer_alloc(
-      static_cast<int64_t>(layout.row_size) * num_rows, "rows");
+      static_cast<int64_t>(layout.row_size) * batch_rows, "rows");
   if (rows == 0) {
     throw_java(env, srt_last_error());
     return 0;
   }
   srt_status s = srt_pack_rows(
       type_ids.data(), num_cols, col_data.data(), col_valid.data(),
-      num_rows, static_cast<uint8_t*>(srt_buffer_data(rows)));
+      batch_rows, static_cast<uint8_t*>(srt_buffer_data(rows)));
   if (s != SRT_OK) {
     srt_buffer_release(rows);
     throw_java(env, srt_last_error());
@@ -124,12 +144,27 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
     throw_java(env, srt_last_error());
     return nullptr;
   }
+  // Size gate: the rows buffer must hold num_rows full layout rows.
+  std::vector<int32_t> offsets(num_cols), widths(num_cols);
+  srt_row_layout layout{};
+  if (!check_status(env, srt_compute_row_layout(type_ids.data(), num_cols,
+                                                offsets.data(),
+                                                widths.data(), &layout)))
+    return nullptr;
+  if (srt_buffer_size(rows_handle) <
+      static_cast<int64_t>(layout.row_size) * num_rows) {
+    throw_java(env, "rows buffer smaller than num_rows * row_size");
+    return nullptr;
+  }
 
-  std::vector<srt_handle> handles;
+  // Documented return order: num_cols data buffers first, then num_cols
+  // validity buffers (RowConversion.java javadoc).
+  std::vector<srt_handle> data_handles, valid_handles;
   std::vector<void*> col_data(num_cols);
   std::vector<uint8_t*> col_valid(num_cols);
   auto fail = [&](const char* msg) -> jlongArray {
-    for (srt_handle h : handles) srt_buffer_release(h);
+    for (srt_handle h : data_handles) srt_buffer_release(h);
+    for (srt_handle h : valid_handles) srt_buffer_release(h);
     throw_java(env, msg);
     return nullptr;
   };
@@ -139,9 +174,13 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
     srt_handle hd = srt_buffer_alloc(static_cast<int64_t>(w) * num_rows,
                                      "col_data");
     srt_handle hv = srt_buffer_alloc(num_rows, "col_valid");
-    if (hd == 0 || hv == 0) return fail(srt_last_error());
-    handles.push_back(hd);
-    handles.push_back(hv);
+    if (hd == 0 || hv == 0) {
+      if (hd != 0) srt_buffer_release(hd);
+      if (hv != 0) srt_buffer_release(hv);
+      return fail(srt_last_error());
+    }
+    data_handles.push_back(hd);
+    valid_handles.push_back(hv);
     col_data[c] = srt_buffer_data(hd);
     col_valid[c] = static_cast<uint8_t*>(srt_buffer_data(hv));
   }
@@ -149,6 +188,9 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
                                  col_data.data(), col_valid.data());
   if (s != SRT_OK) return fail(srt_last_error());
 
+  std::vector<srt_handle> handles;
+  handles.insert(handles.end(), data_handles.begin(), data_handles.end());
+  handles.insert(handles.end(), valid_handles.begin(), valid_handles.end());
   jlongArray out = env->NewLongArray(static_cast<jsize>(handles.size()));
   if (out == nullptr) return fail("allocation failure");
   env->SetLongArrayRegion(out, 0, static_cast<jsize>(handles.size()),
