@@ -1,0 +1,271 @@
+/*
+ * An owned, refcounted column.
+ *
+ * Plays the role of ai.rapids.cudf.ColumnVector (SURVEY.md L4;
+ * RowConversion.java:106-110 wraps released native pointers in it). The
+ * reference's ownership model — Java controls lifetime, refcount-debug
+ * mode catches leaks (pom.xml:86,199) — maps onto the runtime's handle
+ * registry: close() releases the registry buffers, incRefCount() layers
+ * a Java-side count on top, and HostBuffer.liveHandleCount() is the leak
+ * oracle the tests assert on (SURVEY.md §4).
+ *
+ * Buffers are little-endian fixed-width host arrays (BOOL8 = 1 byte),
+ * exactly what the C ABI's row codec consumes (c_api.h srt_pack_rows).
+ */
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.HostBuffer;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+public final class ColumnVector extends ColumnView implements AutoCloseable {
+  private int refCount = 1;
+
+  public ColumnVector(DType type, long rows, HostBuffer data, HostBuffer valid) {
+    super(type, rows, data, valid);
+  }
+
+  ColumnVector(DType type, long rows, HostBuffer data, HostBuffer valid,
+               int listElementSize) {
+    super(type, rows, data, valid, listElementSize);
+  }
+
+  public synchronized ColumnVector incRefCount() {
+    if (refCount <= 0) {
+      throw new IllegalStateException("column already closed");
+    }
+    refCount++;
+    return this;
+  }
+
+  @Override
+  public synchronized void close() {
+    refCount--;
+    if (refCount == 0) {
+      if (data != null) {
+        data.close();
+        data = null;
+      }
+      if (valid != null) {
+        valid.close();
+        valid = null;
+      }
+    }
+  }
+
+  /* ---- factories (the Table.TestBuilder substrate) ------------------- */
+
+  public static ColumnVector fromLongs(long... values) {
+    ByteBuffer bb = alloc(values.length * 8);
+    for (long v : values) {
+      bb.putLong(v);
+    }
+    return fixedWidth(DType.INT64, values.length, bb, null);
+  }
+
+  public static ColumnVector fromInts(int... values) {
+    ByteBuffer bb = alloc(values.length * 4);
+    for (int v : values) {
+      bb.putInt(v);
+    }
+    return fixedWidth(DType.INT32, values.length, bb, null);
+  }
+
+  public static ColumnVector fromDoubles(double... values) {
+    ByteBuffer bb = alloc(values.length * 8);
+    for (double v : values) {
+      bb.putDouble(v);
+    }
+    return fixedWidth(DType.FLOAT64, values.length, bb, null);
+  }
+
+  public static ColumnVector fromFloats(float... values) {
+    ByteBuffer bb = alloc(values.length * 4);
+    for (float v : values) {
+      bb.putFloat(v);
+    }
+    return fixedWidth(DType.FLOAT32, values.length, bb, null);
+  }
+
+  public static ColumnVector fromBooleans(boolean... values) {
+    ByteBuffer bb = alloc(values.length);
+    for (boolean v : values) {
+      bb.put((byte) (v ? 1 : 0));
+    }
+    return fixedWidth(DType.BOOL8, values.length, bb, null);
+  }
+
+  public static ColumnVector fromBytes(byte... values) {
+    ByteBuffer bb = alloc(values.length);
+    bb.put(values);
+    return fixedWidth(DType.INT8, values.length, bb, null);
+  }
+
+  public static ColumnVector fromShorts(short... values) {
+    ByteBuffer bb = alloc(values.length * 2);
+    for (short v : values) {
+      bb.putShort(v);
+    }
+    return fixedWidth(DType.INT16, values.length, bb, null);
+  }
+
+  /* Boxed variants: null entries become nulls in the column. */
+
+  public static ColumnVector fromBoxedLongs(Long... values) {
+    return fromBoxed(DType.INT64, values);
+  }
+
+  public static ColumnVector fromBoxedInts(Integer... values) {
+    return fromBoxed(DType.INT32, values);
+  }
+
+  public static ColumnVector fromBoxedDoubles(Double... values) {
+    return fromBoxed(DType.FLOAT64, values);
+  }
+
+  public static ColumnVector fromBoxedFloats(Float... values) {
+    return fromBoxed(DType.FLOAT32, values);
+  }
+
+  public static ColumnVector fromBoxedBooleans(Boolean... values) {
+    return fromBoxed(DType.BOOL8, values);
+  }
+
+  public static ColumnVector fromBoxedBytes(Byte... values) {
+    return fromBoxed(DType.INT8, values);
+  }
+
+  public static ColumnVector fromBoxedShorts(Short... values) {
+    return fromBoxed(DType.INT16, values);
+  }
+
+  /** DECIMAL32: unscaled int values; value = unscaled * 10^scale. */
+  public static ColumnVector decimalFromBoxedInts(int scale, Integer... unscaled) {
+    return fromBoxed(DType.create(DType.DTypeEnum.DECIMAL32, scale), unscaled);
+  }
+
+  /** DECIMAL64: unscaled long values. */
+  public static ColumnVector decimalFromBoxedLongs(int scale, Long... unscaled) {
+    return fromBoxed(DType.create(DType.DTypeEnum.DECIMAL64, scale), unscaled);
+  }
+
+  public static ColumnVector timestampMillisecondsFromBoxedLongs(Long... values) {
+    return fromBoxed(DType.TIMESTAMP_MILLISECONDS, values);
+  }
+
+  /** Wrap a packed row batch (rowSize bytes per row) as a LIST<INT8>
+   * column — the output shape of convertToRows (row_conversion.cu:405-406:
+   * sequence offsets over one INT8 child). Offsets stay implicit because
+   * every list element has the same fixed size. */
+  public static ColumnVector fromPackedRows(HostBuffer rows, long numRows,
+                                            int rowSize) {
+    return new ColumnVector(DType.LIST, numRows, rows, null, rowSize);
+  }
+
+  private static ColumnVector fromBoxed(DType type, Object[] values) {
+    int width = type.getSizeInBytes();
+    ByteBuffer bb = alloc(values.length * width);
+    byte[] validity = new byte[values.length];
+    boolean anyNull = false;
+    for (int i = 0; i < values.length; i++) {
+      Object v = values[i];
+      validity[i] = (byte) (v == null ? 0 : 1);
+      anyNull |= v == null;
+      putValue(bb, type, v);
+    }
+    HostBuffer valid = anyNull ? HostBuffer.create(validity, "validity") : null;
+    return fixedWidth(type, values.length, bb, valid);
+  }
+
+  private static void putValue(ByteBuffer bb, DType type, Object v) {
+    switch (type.getTypeId()) {
+      case INT64:
+      case UINT64:
+      case DECIMAL64:
+      case TIMESTAMP_SECONDS:
+      case TIMESTAMP_MILLISECONDS:
+      case TIMESTAMP_MICROSECONDS:
+      case TIMESTAMP_NANOSECONDS:
+      case DURATION_SECONDS:
+      case DURATION_MILLISECONDS:
+      case DURATION_MICROSECONDS:
+      case DURATION_NANOSECONDS:
+        bb.putLong(v == null ? 0L : ((Number) v).longValue());
+        break;
+      case INT32:
+      case UINT32:
+      case DECIMAL32:
+      case TIMESTAMP_DAYS:
+      case DURATION_DAYS:
+        bb.putInt(v == null ? 0 : ((Number) v).intValue());
+        break;
+      case INT16:
+      case UINT16:
+        bb.putShort(v == null ? 0 : ((Number) v).shortValue());
+        break;
+      case INT8:
+      case UINT8:
+        bb.put(v == null ? 0 : ((Number) v).byteValue());
+        break;
+      case FLOAT64:
+        bb.putDouble(v == null ? 0 : ((Number) v).doubleValue());
+        break;
+      case FLOAT32:
+        bb.putFloat(v == null ? 0 : ((Number) v).floatValue());
+        break;
+      case BOOL8:
+        bb.put((byte) (v != null && (Boolean) v ? 1 : 0));
+        break;
+      default:
+        throw new IllegalArgumentException("not fixed-width: " + type);
+    }
+  }
+
+  private static ByteBuffer alloc(int nbytes) {
+    return ByteBuffer.allocate(nbytes).order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  private static ColumnVector fixedWidth(DType type, long rows, ByteBuffer bb,
+                                         HostBuffer valid) {
+    HostBuffer data = HostBuffer.create(bb.array(), "column");
+    return new ColumnVector(type, rows, data, valid);
+  }
+
+  /* ---- element access (test/debug path; not the hot path) ------------ */
+
+  public long getLong(long row) {
+    return bufferAt(row, 8).getLong();
+  }
+
+  public int getInt(long row) {
+    return bufferAt(row, 4).getInt();
+  }
+
+  public double getDouble(long row) {
+    return bufferAt(row, 8).getDouble();
+  }
+
+  public float getFloat(long row) {
+    return bufferAt(row, 4).getFloat();
+  }
+
+  public boolean getBoolean(long row) {
+    return bufferAt(row, 1).get() != 0;
+  }
+
+  public byte getByte(long row) {
+    return bufferAt(row, 1).get();
+  }
+
+  public short getShort(long row) {
+    return bufferAt(row, 2).getShort();
+  }
+
+  private ByteBuffer bufferAt(long row, int width) {
+    byte[] all = data.toByteArray();
+    ByteBuffer bb = ByteBuffer.wrap(all).order(ByteOrder.LITTLE_ENDIAN);
+    bb.position((int) (row * width));
+    return bb;
+  }
+}

@@ -1,0 +1,88 @@
+/*
+ * Read-only view of a column: (dtype, row count, data buffer, validity).
+ *
+ * Plays the role of ai.rapids.cudf.ColumnView in the reference layer map
+ * (SURVEY.md L4; imported at RowConversion.java:21) — the non-owning
+ * handle type the repo-local API accepts (convertFromRows takes a
+ * ColumnView, RowConversion.java:113). In the TPU runtime a view is a
+ * pair of registry buffer handles (data + optional validity) instead of
+ * a cudf column_view pointer; validity is a byte-per-row 0/1 vector, the
+ * C ABI convention (c_api.h srt_pack_rows col_valid).
+ */
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.HostBuffer;
+
+public class ColumnView {
+  protected final DType type;
+  protected final long rows;
+  protected HostBuffer data;
+  protected HostBuffer valid; // null when the column has no nulls
+
+  /** For LIST columns (packed row batches): bytes per list element. */
+  protected final int listElementSize;
+
+  public ColumnView(DType type, long rows, HostBuffer data, HostBuffer valid) {
+    this(type, rows, data, valid, 0);
+  }
+
+  ColumnView(DType type, long rows, HostBuffer data, HostBuffer valid,
+             int listElementSize) {
+    this.type = type;
+    this.rows = rows;
+    this.data = data;
+    this.valid = valid;
+    this.listElementSize = listElementSize;
+  }
+
+  public DType getType() {
+    return type;
+  }
+
+  public long getRowCount() {
+    return rows;
+  }
+
+  public long getNullCount() {
+    if (valid == null) {
+      return 0;
+    }
+    long count = 0;
+    for (byte b : valid.toByteArray()) {
+      if (b == 0) {
+        count++;
+      }
+    }
+    return count;
+  }
+
+  public boolean hasNulls() {
+    return getNullCount() > 0;
+  }
+
+  /** Registry handle of the data buffer — the jlong the JNI layer
+   * passes (the getNativeView() analog, RowConversion.java:105). */
+  public long getNativeView() {
+    return data.getHandle();
+  }
+
+  public HostBuffer getData() {
+    return data;
+  }
+
+  public HostBuffer getValid() {
+    return valid;
+  }
+
+  /** For LIST row-batch columns: the fixed byte width of each element. */
+  public int getListElementSize() {
+    return listElementSize;
+  }
+
+  public boolean isNull(long row) {
+    if (valid == null) {
+      return false;
+    }
+    return valid.toByteArray()[(int) row] == 0;
+  }
+}

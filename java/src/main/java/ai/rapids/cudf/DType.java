@@ -1,0 +1,180 @@
+/*
+ * Column data type: (type id, decimal scale).
+ *
+ * API-compatible with the ai.rapids.cudf.DType surface the Spark plugin
+ * and the reference's repo-local layer consume (RowConversion.java:19-22
+ * imports it; RowConversion.java:119-120 calls
+ * getTypeId().getNativeId()/getScale() to build the JNI wire arrays;
+ * RowConversionJni.cpp:56-61 reconstructs types from those arrays).
+ * Native ids match cudf 22.04 type_id values and the TPU runtime's
+ * spark_rapids_jni_tpu.dtype.TypeId — one id space across Java, C and
+ * Python.
+ */
+package ai.rapids.cudf;
+
+import java.util.Objects;
+
+public final class DType {
+
+  public enum DTypeEnum {
+    EMPTY(0, 0),
+    INT8(1, 1),
+    INT16(2, 2),
+    INT32(3, 4),
+    INT64(4, 8),
+    UINT8(5, 1),
+    UINT16(6, 2),
+    UINT32(7, 4),
+    UINT64(8, 8),
+    FLOAT32(9, 4),
+    FLOAT64(10, 8),
+    BOOL8(11, 1),
+    TIMESTAMP_DAYS(12, 4),
+    TIMESTAMP_SECONDS(13, 8),
+    TIMESTAMP_MILLISECONDS(14, 8),
+    TIMESTAMP_MICROSECONDS(15, 8),
+    TIMESTAMP_NANOSECONDS(16, 8),
+    DURATION_DAYS(17, 4),
+    DURATION_SECONDS(18, 8),
+    DURATION_MILLISECONDS(19, 8),
+    DURATION_MICROSECONDS(20, 8),
+    DURATION_NANOSECONDS(21, 8),
+    DICTIONARY32(22, 4),
+    STRING(23, 0),
+    LIST(24, 0),
+    DECIMAL32(25, 4),
+    DECIMAL64(26, 8),
+    DECIMAL128(27, 16),
+    STRUCT(28, 0);
+
+    final int nativeId;
+    final int sizeInBytes;
+
+    DTypeEnum(int nativeId, int sizeInBytes) {
+      this.nativeId = nativeId;
+      this.sizeInBytes = sizeInBytes;
+    }
+
+    public int getNativeId() {
+      return nativeId;
+    }
+  }
+
+  public static final DType EMPTY = new DType(DTypeEnum.EMPTY);
+  public static final DType INT8 = new DType(DTypeEnum.INT8);
+  public static final DType INT16 = new DType(DTypeEnum.INT16);
+  public static final DType INT32 = new DType(DTypeEnum.INT32);
+  public static final DType INT64 = new DType(DTypeEnum.INT64);
+  public static final DType UINT8 = new DType(DTypeEnum.UINT8);
+  public static final DType UINT16 = new DType(DTypeEnum.UINT16);
+  public static final DType UINT32 = new DType(DTypeEnum.UINT32);
+  public static final DType UINT64 = new DType(DTypeEnum.UINT64);
+  public static final DType FLOAT32 = new DType(DTypeEnum.FLOAT32);
+  public static final DType FLOAT64 = new DType(DTypeEnum.FLOAT64);
+  public static final DType BOOL8 = new DType(DTypeEnum.BOOL8);
+  public static final DType TIMESTAMP_DAYS = new DType(DTypeEnum.TIMESTAMP_DAYS);
+  public static final DType TIMESTAMP_SECONDS = new DType(DTypeEnum.TIMESTAMP_SECONDS);
+  public static final DType TIMESTAMP_MILLISECONDS =
+      new DType(DTypeEnum.TIMESTAMP_MILLISECONDS);
+  public static final DType TIMESTAMP_MICROSECONDS =
+      new DType(DTypeEnum.TIMESTAMP_MICROSECONDS);
+  public static final DType TIMESTAMP_NANOSECONDS =
+      new DType(DTypeEnum.TIMESTAMP_NANOSECONDS);
+  public static final DType DURATION_DAYS = new DType(DTypeEnum.DURATION_DAYS);
+  public static final DType DURATION_SECONDS = new DType(DTypeEnum.DURATION_SECONDS);
+  public static final DType DURATION_MILLISECONDS =
+      new DType(DTypeEnum.DURATION_MILLISECONDS);
+  public static final DType DURATION_MICROSECONDS =
+      new DType(DTypeEnum.DURATION_MICROSECONDS);
+  public static final DType DURATION_NANOSECONDS =
+      new DType(DTypeEnum.DURATION_NANOSECONDS);
+  public static final DType STRING = new DType(DTypeEnum.STRING);
+  public static final DType LIST = new DType(DTypeEnum.LIST);
+  public static final DType STRUCT = new DType(DTypeEnum.STRUCT);
+
+  private final DTypeEnum typeId;
+  /** Decimal scale; value = unscaled * 10^scale (cudf convention, so
+   * decimal scales are typically negative). 0 for non-decimals. */
+  private final int scale;
+
+  private DType(DTypeEnum id) {
+    this(id, 0);
+  }
+
+  private DType(DTypeEnum id, int scale) {
+    this.typeId = id;
+    this.scale = scale;
+  }
+
+  public static DType create(DTypeEnum id) {
+    if (id == DTypeEnum.DECIMAL32 || id == DTypeEnum.DECIMAL64
+        || id == DTypeEnum.DECIMAL128) {
+      throw new IllegalArgumentException(
+          "decimal types need a scale: use create(id, scale)");
+    }
+    return new DType(id);
+  }
+
+  public static DType create(DTypeEnum id, int scale) {
+    return new DType(id, scale);
+  }
+
+  /** Rebuild from the (nativeId, scale) wire pair the JNI marshals
+   * (RowConversionJni.cpp:56-61). */
+  public static DType fromNative(int nativeId, int scale) {
+    for (DTypeEnum e : DTypeEnum.values()) {
+      if (e.nativeId == nativeId) {
+        return new DType(e, scale);
+      }
+    }
+    throw new IllegalArgumentException("unknown native type id " + nativeId);
+  }
+
+  public DTypeEnum getTypeId() {
+    return typeId;
+  }
+
+  public int getScale() {
+    return scale;
+  }
+
+  public int getSizeInBytes() {
+    return typeId.sizeInBytes;
+  }
+
+  public boolean isFixedWidth() {
+    return typeId.sizeInBytes > 0 && typeId != DTypeEnum.DICTIONARY32;
+  }
+
+  public boolean isDecimalType() {
+    return typeId == DTypeEnum.DECIMAL32 || typeId == DTypeEnum.DECIMAL64
+        || typeId == DTypeEnum.DECIMAL128;
+  }
+
+  public boolean isTimestampType() {
+    return typeId.nativeId >= DTypeEnum.TIMESTAMP_DAYS.nativeId
+        && typeId.nativeId <= DTypeEnum.TIMESTAMP_NANOSECONDS.nativeId;
+  }
+
+  @Override
+  public boolean equals(Object o) {
+    if (this == o) {
+      return true;
+    }
+    if (!(o instanceof DType)) {
+      return false;
+    }
+    DType other = (DType) o;
+    return typeId == other.typeId && scale == other.scale;
+  }
+
+  @Override
+  public int hashCode() {
+    return Objects.hash(typeId, scale);
+  }
+
+  @Override
+  public String toString() {
+    return isDecimalType() ? typeId + "(scale=" + scale + ")" : typeId.toString();
+  }
+}

@@ -1,0 +1,61 @@
+/*
+ * Native dependency loader — the ai.rapids.cudf.NativeDepsLoader
+ * contract (SURVEY.md §3.3): extract + load per-platform shared
+ * libraries staged under /${os.arch}/${os.name}/ in the jar, loadable
+ * by name, idempotent. The reference's repo-local loader delegates to
+ * this class (NativeLibraryLoader.java:26-35); here both loaders share
+ * one implementation since the TPU runtime ships a single shim library
+ * instead of the libcudf/libcudfjni pair.
+ */
+package ai.rapids.cudf;
+
+import java.io.IOException;
+import java.io.InputStream;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.StandardCopyOption;
+import java.util.HashSet;
+import java.util.Set;
+
+public class NativeDepsLoader {
+  private static final Set<String> loaded = new HashSet<>();
+
+  /** Load the runtime's own native deps (the libcudf.so/libcudfjni.so
+   * analog: here the single libspark_rapids_tpu.so). */
+  public static synchronized void loadNativeDeps() {
+    loadNativeDeps(new String[] {"spark_rapids_tpu"});
+  }
+
+  /** Load the named libraries, each once, resource-first. */
+  public static synchronized void loadNativeDeps(String[] libNames) {
+    for (String name : libNames) {
+      if (loaded.contains(name)) {
+        continue;
+      }
+      loadDep(name);
+      loaded.add(name);
+    }
+  }
+
+  private static void loadDep(String name) {
+    String explicit = System.getProperty("spark.rapids.tpu.native.lib");
+    if (explicit != null && !explicit.isEmpty() && name.equals("spark_rapids_tpu")) {
+      System.load(explicit);
+      return;
+    }
+    String resource = "/" + System.getProperty("os.arch") + "/"
+        + System.getProperty("os.name") + "/lib" + name + ".so";
+    try (InputStream in = NativeDepsLoader.class.getResourceAsStream(resource)) {
+      if (in != null) {
+        Path tmp = Files.createTempFile("lib" + name, ".so");
+        tmp.toFile().deleteOnExit();
+        Files.copy(in, tmp, StandardCopyOption.REPLACE_EXISTING);
+        System.load(tmp.toAbsolutePath().toString());
+        return;
+      }
+    } catch (IOException e) {
+      throw new RuntimeException("failed to extract " + resource, e);
+    }
+    System.loadLibrary(name);
+  }
+}

@@ -1,0 +1,147 @@
+/*
+ * A set of equal-length columns.
+ *
+ * Plays the role of ai.rapids.cudf.Table (SURVEY.md L4; the repo-local
+ * API's input/output type, RowConversion.java:104,123). Includes the
+ * TestBuilder fixture pattern the reference test suite is built on
+ * (RowConversionTest.java:30-39 builds its 8-column table with it) — the
+ * fixture shape downstream consumers reuse via the -tests jar
+ * (SURVEY.md §4 test packaging).
+ */
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.HostBuffer;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.util.ArrayList;
+import java.util.List;
+
+public final class Table implements AutoCloseable {
+  private final ColumnVector[] columns;
+  private final long rows;
+
+  /** Takes ownership of the columns (they are NOT ref-counted up). */
+  public Table(ColumnVector... columns) {
+    if (columns.length == 0) {
+      throw new IllegalArgumentException("table needs at least one column");
+    }
+    this.columns = columns;
+    this.rows = columns[0].getRowCount();
+    for (ColumnVector c : columns) {
+      if (c.getRowCount() != rows) {
+        throw new IllegalArgumentException("column row counts differ");
+      }
+    }
+  }
+
+  public long getRowCount() {
+    return rows;
+  }
+
+  public int getNumberOfColumns() {
+    return columns.length;
+  }
+
+  public ColumnVector getColumn(int i) {
+    return columns[i];
+  }
+
+  /** Registry handle standing in for the native table view jlong
+   * (RowConversion.java:105): the concatenated host layout the JNI
+   * bridge validates and walks (RowConversionJni.cpp — data buffers
+   * back to back, then per-column validity byte vectors). Caller owns
+   * the returned buffer. */
+  public HostBuffer packForNative() {
+    int n = columns.length;
+    long dataBytes = 0;
+    for (ColumnVector c : columns) {
+      dataBytes += (long) c.getType().getSizeInBytes() * rows;
+    }
+    long total = dataBytes + (long) n * rows;
+    if (total > Integer.MAX_VALUE) {
+      throw new IllegalStateException("host table layout exceeds 2GB");
+    }
+    ByteBuffer bb = ByteBuffer.allocate((int) total).order(ByteOrder.LITTLE_ENDIAN);
+    for (ColumnVector c : columns) {
+      bb.put(c.getData().toByteArray());
+    }
+    for (ColumnVector c : columns) {
+      if (c.getValid() != null) {
+        bb.put(c.getValid().toByteArray());
+      } else {
+        for (long r = 0; r < rows; r++) {
+          bb.put((byte) 1);
+        }
+      }
+    }
+    return HostBuffer.create(bb.array(), "table");
+  }
+
+  @Override
+  public void close() {
+    for (ColumnVector c : columns) {
+      c.close();
+    }
+  }
+
+  /* ---- TestBuilder ---------------------------------------------------- */
+
+  public static final class TestBuilder {
+    private final List<ColumnVector> cols = new ArrayList<>();
+
+    public TestBuilder column(Long... values) {
+      cols.add(ColumnVector.fromBoxedLongs(values));
+      return this;
+    }
+
+    public TestBuilder column(Double... values) {
+      cols.add(ColumnVector.fromBoxedDoubles(values));
+      return this;
+    }
+
+    public TestBuilder column(Integer... values) {
+      cols.add(ColumnVector.fromBoxedInts(values));
+      return this;
+    }
+
+    public TestBuilder column(Boolean... values) {
+      cols.add(ColumnVector.fromBoxedBooleans(values));
+      return this;
+    }
+
+    public TestBuilder column(Float... values) {
+      cols.add(ColumnVector.fromBoxedFloats(values));
+      return this;
+    }
+
+    public TestBuilder column(Byte... values) {
+      cols.add(ColumnVector.fromBoxedBytes(values));
+      return this;
+    }
+
+    public TestBuilder column(Short... values) {
+      cols.add(ColumnVector.fromBoxedShorts(values));
+      return this;
+    }
+
+    public TestBuilder decimal32Column(int scale, Integer... unscaled) {
+      cols.add(ColumnVector.decimalFromBoxedInts(scale, unscaled));
+      return this;
+    }
+
+    public TestBuilder decimal64Column(int scale, Long... unscaled) {
+      cols.add(ColumnVector.decimalFromBoxedLongs(scale, unscaled));
+      return this;
+    }
+
+    public TestBuilder timestampMillisecondsColumn(Long... values) {
+      cols.add(ColumnVector.timestampMillisecondsFromBoxedLongs(values));
+      return this;
+    }
+
+    public Table build() {
+      return new Table(cols.toArray(new ColumnVector[0]));
+    }
+  }
+}

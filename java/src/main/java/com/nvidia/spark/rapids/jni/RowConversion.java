@@ -38,6 +38,63 @@ public class RowConversion {
   }
 
   /**
+   * Convert a table into packed UnsafeRow-style batches — the reference's
+   * primary entry point (RowConversion.java:104-111): one LIST&lt;INT8&gt;
+   * ColumnVector per 2 GB batch, each list element one packed row.
+   * Ownership of the returned columns transfers to the caller.
+   */
+  public static ai.rapids.cudf.ColumnVector[] convertToRows(
+      ai.rapids.cudf.Table table) {
+    int n = table.getNumberOfColumns();
+    int[] typeIds = new int[n];
+    for (int i = 0; i < n; i++) {
+      typeIds[i] = table.getColumn(i).getType().getTypeId().getNativeId();
+    }
+    int rowSize = rowSize(typeIds);
+    try (HostBuffer packed = table.packForNative()) {
+      HostBuffer[] batches = convertToRows(packed, typeIds, table.getRowCount());
+      ai.rapids.cudf.ColumnVector[] out =
+          new ai.rapids.cudf.ColumnVector[batches.length];
+      long maxRows = maxRowsPerBatch(rowSize);
+      long remaining = table.getRowCount();
+      for (int b = 0; b < batches.length; b++) {
+        long batchRows = Math.min(maxRows, remaining);
+        out[b] = ai.rapids.cudf.ColumnVector.fromPackedRows(
+            batches[b], batchRows, rowSize);
+        remaining -= batchRows;
+      }
+      return out;
+    }
+  }
+
+  /**
+   * Convert one packed row batch back into a table with the asserted
+   * schema — the reference's convertFromRows(ColumnView, DType...)
+   * (RowConversion.java:113-124). Scales travel as the parallel int
+   * array of the JNI wire format.
+   */
+  public static ai.rapids.cudf.Table convertFromRows(
+      ai.rapids.cudf.ColumnView rows, ai.rapids.cudf.DType... schema) {
+    int n = schema.length;
+    int[] typeIds = new int[n];
+    int[] scales = new int[n];
+    for (int i = 0; i < n; i++) {
+      typeIds[i] = schema[i].getTypeId().getNativeId();
+      scales[i] = schema[i].getScale();
+    }
+    long numRows = rows.getRowCount();
+    long[] handles = convertFromRowsNative(rows.getData().getHandle(),
+                                           typeIds, scales, numRows);
+    ai.rapids.cudf.ColumnVector[] cols = new ai.rapids.cudf.ColumnVector[n];
+    for (int i = 0; i < n; i++) {
+      HostBuffer data = new HostBuffer(handles[i]);
+      HostBuffer valid = new HostBuffer(handles[n + i]);
+      cols[i] = new ai.rapids.cudf.ColumnVector(schema[i], numRows, data, valid);
+    }
+    return new ai.rapids.cudf.Table(cols);
+  }
+
+  /**
    * Convert a host table (column buffers concatenated in the layout the
    * bridge expects: data buffers back to back, then per-column validity
    * byte vectors) into packed row batches.

@@ -1,0 +1,54 @@
+/*
+ * Table/column equality assertions — the AssertUtils helper the
+ * reference test suite leans on (RowConversionTest.java:51 calls
+ * assertTablesAreEqual). Compares dtype, row count, per-row validity and
+ * raw little-endian values.
+ */
+package ai.rapids.cudf;
+
+public final class AssertUtils {
+  private AssertUtils() {}
+
+  public static void assertTablesAreEqual(Table expected, Table actual) {
+    if (expected.getNumberOfColumns() != actual.getNumberOfColumns()) {
+      throw new AssertionError("column count mismatch: "
+          + expected.getNumberOfColumns() + " vs " + actual.getNumberOfColumns());
+    }
+    for (int c = 0; c < expected.getNumberOfColumns(); c++) {
+      assertColumnsAreEqual(expected.getColumn(c), actual.getColumn(c), "col " + c);
+    }
+  }
+
+  public static void assertColumnsAreEqual(ColumnVector expected,
+                                           ColumnVector actual, String what) {
+    if (!expected.getType().equals(actual.getType())) {
+      throw new AssertionError(what + ": dtype " + expected.getType()
+          + " vs " + actual.getType());
+    }
+    if (expected.getRowCount() != actual.getRowCount()) {
+      throw new AssertionError(what + ": rows " + expected.getRowCount()
+          + " vs " + actual.getRowCount());
+    }
+    int width = expected.getType().getSizeInBytes();
+    byte[] edata = expected.getData().toByteArray();
+    byte[] adata = actual.getData().toByteArray();
+    for (long r = 0; r < expected.getRowCount(); r++) {
+      boolean enull = expected.isNull(r);
+      boolean anull = actual.isNull(r);
+      if (enull != anull) {
+        throw new AssertionError(what + " row " + r + ": null " + enull
+            + " vs " + anull);
+      }
+      if (enull) {
+        continue; // values under nulls are unspecified
+      }
+      for (int b = 0; b < width; b++) {
+        int idx = (int) (r * width + b);
+        if (edata[idx] != adata[idx]) {
+          throw new AssertionError(what + " row " + r + " byte " + b
+              + ": " + edata[idx] + " vs " + adata[idx]);
+        }
+      }
+    }
+  }
+}

@@ -184,7 +184,9 @@ def murmur3_table_fused(
         if columns is not None
         else list(table.columns)
     )
-    if not supports(cols):
+    # empty key set: the kernel has no words to read; XLA path returns
+    # the seed-filled column
+    if not cols or not supports(cols):
         from ..ops import hashing as xla_hashing
 
         return xla_hashing.murmur3_table(table, columns, seed)

@@ -230,12 +230,5 @@ def unpack_rows_pallas(
     return [c[:n] for c in cols], valid[:n]
 
 
-def column_bytes_to_storage(raw: jax.Array, d: dt.DType) -> jax.Array:
-    """(n, width) little-endian bytes -> storage-dtype values (host of the
-    kernel boundary; mirrors rows._unpack_batch's bitcast step)."""
-    if d.is_boolean:
-        return raw[:, 0] != 0
-    target = np.dtype(d.storage_dtype)
-    if target.itemsize == 1:
-        return jax.lax.bitcast_convert_type(raw[:, 0], target)
-    return jax.lax.bitcast_convert_type(raw, target)
+# Single shared byte->storage decode (rows.py owns the rule).
+from ..rows import column_bytes_to_storage  # noqa: E402,F401

@@ -159,7 +159,7 @@ def murmur3_table(
         if columns is not None
         else list(table.columns)
     )
-    if kernels.on_tpu() and khash.supports(cols):
+    if cols and kernels.on_tpu() and khash.supports(cols):
         return khash.murmur3_table_fused(table, columns, seed)
     h = jnp.full((table.row_count,), seed, dtype=jnp.uint32)
     for c in cols:

@@ -302,6 +302,19 @@ def to_rows(
 # rows -> columnar
 # ---------------------------------------------------------------------------
 
+def column_bytes_to_storage(raw: jax.Array, d) -> jax.Array:
+    """(n, width) little-endian bytes -> storage-dtype values. The single
+    definition both backends decode through (XLA `_unpack_batch` here and
+    the Pallas kernel boundary in kernels/row_transpose.py) so the
+    storage-dtype rules can never diverge."""
+    if d.is_boolean:
+        return raw[:, 0] != 0
+    target = np.dtype(d.storage_dtype)
+    if target.itemsize == 1:
+        return jax.lax.bitcast_convert_type(raw[:, 0], target)
+    return jax.lax.bitcast_convert_type(raw, target)
+
+
 def _unpack_batch(
     data: jax.Array, layout: RowLayout
 ) -> tuple[list[jax.Array], jax.Array]:
@@ -310,15 +323,7 @@ def _unpack_batch(
     for d, off, w in zip(
         layout.dtypes, layout.column_offsets, layout.column_widths
     ):
-        raw = data[:, off : off + w]
-        if d.is_boolean:
-            cols.append(raw[:, 0] != 0)
-        else:
-            target = np.dtype(d.storage_dtype)
-            if target.itemsize == 1:
-                cols.append(jax.lax.bitcast_convert_type(raw[:, 0], target))
-            else:
-                cols.append(jax.lax.bitcast_convert_type(raw, target))
+        cols.append(column_bytes_to_storage(data[:, off : off + w], d))
     vb = data[
         :, layout.validity_offset : layout.validity_offset + layout.validity_bytes
     ]

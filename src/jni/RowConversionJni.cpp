@@ -58,7 +58,7 @@ bool check_status(JNIEnv* env, srt_status s) {
 extern "C" {
 
 JNIEXPORT jlong JNICALL
-Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
     JNIEnv* env, jclass, jlong table_handle, jintArray type_ids_j,
     jlong num_rows, jlong start_row, jlong batch_rows) {
   if (table_handle == 0) {
@@ -127,7 +127,7 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(
 }
 
 JNIEXPORT jlongArray JNICALL
-Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(
     JNIEnv* env, jclass, jlong rows_handle, jintArray type_ids_j,
     jintArray scales_j, jlong num_rows) {
   (void)scales_j;  // scales don't affect layout; the Java facade keeps them
