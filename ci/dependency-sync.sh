@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Nightly dependency-sync bot — the ci/submodule-sync.sh analog
+# (submodule-sync.sh:23-97). Where the reference advances the cudf
+# submodule to branch HEAD, gates on a full `mvn verify`, and opens an
+# auto-merging PR, this advances the env/requirements-pin.txt pins to
+# the currently-installed (or latest-available) versions, gates on the
+# full premerge build, and opens a PR through the GitHub REST API with
+# the test result as a comment; the PR auto-squash-merges iff green
+# (.github/workflows/dependency-sync.yml drives the schedule).
+#
+# Env: GITHUB_TOKEN, GITHUB_REPO (owner/name), BASE_BRANCH (default main)
+set -euxo pipefail
+
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo"
+base="${BASE_BRANCH:-main}"
+bot_branch="bot-dependency-sync-$(date -u +%Y%m%d)"
+
+# 1. Advance pins to the latest index release (the `git submodule
+#    update --remote --merge` analog, submodule-sync.sh:53). The CI
+#    image installs FROM the pin file, so the installed environment can
+#    never be ahead of it — the candidate version must come from the
+#    package index (SYNC_SOURCE=installed exists for air-gapped runs
+#    where a newer stack was installed by other means).
+python3 - <<'PY'
+import importlib.metadata as md
+import os
+import re
+import subprocess
+
+def latest_from_index(name):
+    # `pip index versions` prints "name (X.Y.Z)\nAvailable versions: ..."
+    out = subprocess.run(
+        ["python3", "-m", "pip", "index", "versions", name],
+        capture_output=True, text=True, timeout=120,
+    )
+    m = re.search(r"Available versions: ([^\s,]+)", out.stdout)
+    return m.group(1) if m else None
+
+source = os.environ.get("SYNC_SOURCE", "index")
+path = "env/requirements-pin.txt"
+with open(path) as f:
+    lines = f.readlines()
+out = []
+changed = False
+for line in lines:
+    m = re.match(r"^(\S+)==(\S+)\s*$", line)
+    if not m:
+        out.append(line)
+        continue
+    name, old = m.groups()
+    new = None
+    if source == "index":
+        new = latest_from_index(name)
+    if new is None:
+        new = md.version(name)
+    if new != old:
+        changed = True
+    out.append(f"{name}=={new}\n")
+with open(path, "w") as f:
+    f.writelines(out)
+print("pins changed" if changed else "pins unchanged")
+PY
+
+# Install the candidate stack so the gate below tests what the new pins
+# describe (the reference's submodule checkout step).
+python3 -m pip install -r env/requirements-pin.txt
+
+if git diff --quiet env/requirements-pin.txt; then
+  echo "dependency-sync: pins already current; nothing to do"
+  exit 0
+fi
+
+# 2. Gate: the full premerge build must pass with the new pins
+#    (submodule-sync.sh:68-72's `mvn verify` gate).
+test_pass=true
+bash ci/premerge-build.sh || test_pass=false
+
+# 3. Branch, commit, push, PR (REST calls the action-helper python
+#    performs in the reference, utils.py:60-146).
+git checkout -b "$bot_branch"
+git add env/requirements-pin.txt
+git commit -m "Advance pinned compute-stack versions (dependency-sync bot)"
+git push -u origin "$bot_branch"
+
+api="https://api.github.com/repos/${GITHUB_REPO}"
+auth=(-H "Authorization: token ${GITHUB_TOKEN}" -H "Accept: application/vnd.github.v3+json")
+
+pr_number=$(curl -sf "${auth[@]}" -X POST "$api/pulls" -d "$(python3 -c "
+import json
+print(json.dumps({
+  'title': '[bot] dependency-sync: advance env pins',
+  'head': '$bot_branch',
+  'base': '$base',
+  'body': 'Automated pin advance; premerge gate result posted below.',
+}))")" | python3 -c "import json,sys; print(json.load(sys.stdin)['number'])")
+
+curl -sf "${auth[@]}" -X POST "$api/issues/$pr_number/comments" \
+  -d "{\"body\": \"premerge build: $([[ $test_pass == true ]] && echo PASSED || echo FAILED)\"}"
+
+# 4. Auto-squash-merge iff the gate passed (submodule-sync.sh:83-97).
+if [[ "$test_pass" == "true" ]]; then
+  curl -sf "${auth[@]}" -X PUT "$api/pulls/$pr_number/merge" \
+    -d '{"merge_method": "squash"}'
+else
+  echo "gate failed; leaving PR open for triage"
+  exit 1
+fi

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Deploy artifacts — the ci/deploy.sh analog (ci/deploy.sh:32-76):
+# package the per-platform jar + python wheel, optionally GPG-sign, and
+# publish to the configured repository. Platform classifiers replace the
+# reference's per-CUDA classifiers (cuda11 -> v5e/v5p/v4).
+#
+# Args:    SIGN_FILE (true|false)
+# Env:     CLASSIFIERS (default "v5e"), SERVER_ID, SERVER_URL,
+#          GPG_PASSPHRASE (when signing)
+set -euxo pipefail
+
+SIGN_FILE="${1:-false}"
+CLASSIFIERS="${CLASSIFIERS:-v5e}"
+
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo"
+
+version="$(grep -m1 -o '<version>[^<]*</version>' pom.xml | sed 's/<[^>]*>//g')"
+out="$repo/dist"
+mkdir -p "$out"
+
+# Python wheel of the compute stack.
+python3 -m pip wheel --no-deps --wheel-dir "$out" . || \
+  python3 setup.py bdist_wheel --dist-dir "$out" || true
+
+# Per-platform jars (requires a JDK + maven node; premerge built them).
+IFS=',' read -ra classifiers <<< "$CLASSIFIERS"
+for cls in "${classifiers[@]}"; do
+  jar="spark-rapids-tpu-jni/target/rapids-4-spark-tpu-${version}-${cls}.jar"
+  if [[ -f "$jar" ]]; then
+    cp "$jar" "$out/"
+    if [[ "$SIGN_FILE" == "true" ]]; then
+      gpg --batch --yes --passphrase "$GPG_PASSPHRASE" \
+        --detach-sign --armor "$out/$(basename "$jar")"
+    fi
+  else
+    echo "WARNING: $jar not built; skipping classifier $cls"
+  fi
+done
+
+if [[ -n "${SERVER_URL:-}" ]]; then
+  mvn -s ci/settings.xml deploy -DskipTests \
+    -DaltDeploymentRepository="${SERVER_ID}::default::${SERVER_URL}"
+fi
+
+ls -l "$out"

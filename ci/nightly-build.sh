@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Nightly build — the ci/nightly-build.sh analog: clean rebuild of the
+# native shim, full verification, packaged artifacts. Unlike premerge,
+# starts from a clean build tree (`mvn clean package` analog).
+set -euxo pipefail
+
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo"
+
+rm -rf build
+build/dependency-check || true  # nightly reports drift but proceeds
+NATIVE_BUILD_CONFIGURE=true SRT_WERROR=ON \
+  CPP_PARALLEL_LEVEL="${PARALLEL_LEVEL:-4}" \
+  bash spark-rapids-tpu-runtime/build-native.sh
+
+python3 -m pytest tests/ -q
+
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python3 -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+# Nightly bench record (BENCH_nightly.json artifact).
+python3 bench.py | tee BENCH_nightly.json

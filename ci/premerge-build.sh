@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Premerge gate — the ci/premerge-build.sh analog: runs on a TPU node,
+# gates on accelerator presence (the nvidia-smi gate,
+# premerge-build.sh:20), validates the pinned environment, builds the
+# native shim with warnings-as-errors, runs the full test suite, the
+# multi-chip dry run, and a bench smoke.
+#
+# Env:
+#   REQUIRE_TPU=true|false   fail if no TPU visible (default true on CI)
+#   PARALLEL_LEVEL           native build parallelism (default 4)
+set -euxo pipefail
+
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo"
+
+# Accelerator gate: the premerge tier needs the real chip the way the
+# reference needs a GPU (`nvidia-smi` at premerge-build.sh:20).
+if [[ "${REQUIRE_TPU:-true}" == "true" ]]; then
+  python3 -c "import jax; ds = jax.devices(); assert ds and ds[0].platform != 'cpu', f'no accelerator: {ds}'; print('devices:', ds)"
+fi
+
+build/dependency-check
+
+# Native build: forced reconfigure on CI (the
+# -Dlibcudf.build.configure=true of premerge-build.sh:26).
+NATIVE_BUILD_CONFIGURE=true SRT_WERROR=ON \
+  CPP_PARALLEL_LEVEL="${PARALLEL_LEVEL:-4}" \
+  bash spark-rapids-tpu-runtime/build-native.sh
+
+# Full suite (CPU-forced inside conftest; op surface + native codec +
+# java facade structure).
+python3 -m pytest tests/ -q
+
+# Multi-chip sharding must compile+run on a virtual 8-device mesh.
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python3 -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+# Single-chip flagship step compile check.
+python3 -c "
+from __graft_entry__ import entry
+import jax
+fn, args = entry()
+jax.block_until_ready(jax.jit(fn)(*args))
+print('entry OK')
+"
+
+# Bench smoke on whatever device this node has.
+python3 bench.py

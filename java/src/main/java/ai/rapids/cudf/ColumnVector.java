@@ -41,6 +41,11 @@ public final class ColumnVector extends ColumnView implements AutoCloseable {
 
   @Override
   public synchronized void close() {
+    if (refCount <= 0) {
+      // the double-close class of bug the refcount-debug mode exists to
+      // catch: fail loudly instead of driving the count negative
+      throw new IllegalStateException("close called too many times");
+    }
     refCount--;
     if (refCount == 0) {
       if (data != null) {
@@ -263,6 +268,7 @@ public final class ColumnVector extends ColumnView implements AutoCloseable {
   }
 
   private ByteBuffer bufferAt(long row, int width) {
+    requireOpen();
     byte[] all = data.toByteArray();
     ByteBuffer bb = ByteBuffer.wrap(all).order(ByteOrder.LITTLE_ENDIAN);
     bb.position((int) (row * width));

@@ -43,7 +43,16 @@ public class ColumnView {
     return rows;
   }
 
+  /** Closed columns (ColumnVector.close() nulls the buffers) must fail
+   * with a diagnostic, not an NPE deep in the registry. */
+  protected final void requireOpen() {
+    if (data == null) {
+      throw new IllegalStateException("column already closed");
+    }
+  }
+
   public long getNullCount() {
+    requireOpen();
     if (valid == null) {
       return 0;
     }
@@ -63,14 +72,17 @@ public class ColumnView {
   /** Registry handle of the data buffer — the jlong the JNI layer
    * passes (the getNativeView() analog, RowConversion.java:105). */
   public long getNativeView() {
+    requireOpen();
     return data.getHandle();
   }
 
   public HostBuffer getData() {
+    requireOpen();
     return data;
   }
 
   public HostBuffer getValid() {
+    requireOpen();
     return valid;
   }
 
@@ -80,6 +92,7 @@ public class ColumnView {
   }
 
   public boolean isNull(long row) {
+    requireOpen();
     if (valid == null) {
       return false;
     }

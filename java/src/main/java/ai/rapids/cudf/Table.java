@@ -21,18 +21,28 @@ public final class Table implements AutoCloseable {
   private final ColumnVector[] columns;
   private final long rows;
 
-  /** Takes ownership of the columns (they are NOT ref-counted up). */
+  /** Takes ownership of the columns (they are NOT ref-counted up) —
+   * including on construction failure, where they are closed before the
+   * throw so the caller can't leak what it no longer owns. */
   public Table(ColumnVector... columns) {
     if (columns.length == 0) {
       throw new IllegalArgumentException("table needs at least one column");
     }
-    this.columns = columns;
-    this.rows = columns[0].getRowCount();
+    long rows0 = columns[0].getRowCount();
     for (ColumnVector c : columns) {
-      if (c.getRowCount() != rows) {
+      if (c.getRowCount() != rows0) {
+        for (ColumnVector toClose : columns) {
+          try {
+            toClose.close();
+          } catch (RuntimeException ignored) {
+            // keep closing the rest; the mismatch error wins
+          }
+        }
         throw new IllegalArgumentException("column row counts differ");
       }
     }
+    this.columns = columns;
+    this.rows = rows0;
   }
 
   public long getRowCount() {

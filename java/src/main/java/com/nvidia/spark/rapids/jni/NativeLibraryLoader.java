@@ -4,59 +4,21 @@
  * Plays the role of the reference's NativeLibraryLoader
  * (NativeLibraryLoader.java:22-37): an idempotent, synchronized,
  * load-once entry point triggered from static initializers of the API
- * classes. The reference delegates to cudf's NativeDepsLoader, which
- * extracts per-platform .so resources staged under
- * ${os.arch}/${os.name}/ in the jar (spark-rapids-jni/pom.xml:179-188);
- * this loader implements the same resource contract directly (no cudf),
- * falling back to System.loadLibrary for installed copies.
+ * classes, delegating to ai.rapids.cudf.NativeDepsLoader exactly as the
+ * reference does (NativeLibraryLoader.java:26-35) — NativeDepsLoader
+ * owns the resource-extraction contract
+ * (/${os.arch}/${os.name}/lib*.so in the jar) and the once-per-library
+ * bookkeeping; this class only names the runtime's libraries.
  */
 package com.nvidia.spark.rapids.jni;
 
-import java.io.File;
-import java.io.IOException;
-import java.io.InputStream;
-import java.nio.file.Files;
-import java.nio.file.Path;
-import java.nio.file.StandardCopyOption;
+import ai.rapids.cudf.NativeDepsLoader;
 
 public class NativeLibraryLoader {
-  private static final String LIB_NAME = "spark_rapids_tpu";
-  private static boolean loaded = false;
+  private NativeLibraryLoader() {}
 
-  /**
-   * Load the native runtime once. Order:
-   *   1. -Dspark.rapids.tpu.native.lib=/abs/path (the
-   *      SPARK_RAPIDS_TPU_NATIVE_LIB flag of the Python embedder),
-   *   2. jar resource /${os.arch}/${os.name}/libspark_rapids_tpu.so
-   *      (the NativeDepsLoader staging convention),
-   *   3. System.loadLibrary on java.library.path.
-   */
-  public static synchronized void loadNativeLibs() {
-    if (loaded) {
-      return;
-    }
-    String explicit = System.getProperty("spark.rapids.tpu.native.lib");
-    if (explicit != null && !explicit.isEmpty()) {
-      System.load(explicit);
-      loaded = true;
-      return;
-    }
-    String resource =
-        "/" + System.getProperty("os.arch") + "/" + System.getProperty("os.name")
-            + "/lib" + LIB_NAME + ".so";
-    try (InputStream in = NativeLibraryLoader.class.getResourceAsStream(resource)) {
-      if (in != null) {
-        Path tmp = Files.createTempFile("lib" + LIB_NAME, ".so");
-        tmp.toFile().deleteOnExit();
-        Files.copy(in, tmp, StandardCopyOption.REPLACE_EXISTING);
-        System.load(tmp.toAbsolutePath().toString());
-        loaded = true;
-        return;
-      }
-    } catch (IOException e) {
-      throw new RuntimeException("failed to extract " + resource, e);
-    }
-    System.loadLibrary(LIB_NAME);
-    loaded = true;
+  /** Load the native runtime once (safe to call repeatedly). */
+  public static void loadNativeLibs() {
+    NativeDepsLoader.loadNativeDeps(new String[] {"spark_rapids_tpu"});
   }
 }

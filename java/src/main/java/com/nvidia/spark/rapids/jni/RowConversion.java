@@ -117,16 +117,27 @@ public class RowConversion {
     // Each batch packs its own disjoint [start, start+count) row range —
     // maxRows is a multiple of 32 so validity words never straddle
     // batches (RowConversion.java:36-37,104-111).
-    for (int b = 0; b < numBatches; b++) {
-      long start = b * maxRows;
-      long count = Math.min(maxRows, numRows - start);
-      if (numRows == 0) {
-        start = 0;
-        count = 0;
+    try {
+      for (int b = 0; b < numBatches; b++) {
+        long start = b * maxRows;
+        long count = Math.min(maxRows, numRows - start);
+        if (numRows == 0) {
+          start = 0;
+          count = 0;
+        }
+        out[b] = new HostBuffer(
+            convertToRowsNative(table.getHandle(), typeIds, numRows, start,
+                                count));
       }
-      out[b] = new HostBuffer(
-          convertToRowsNative(table.getHandle(), typeIds, numRows, start,
-                              count));
+    } catch (RuntimeException e) {
+      // a later batch failed: release the batches already owned here, or
+      // their registry buffers leak past the caller forever
+      for (HostBuffer b : out) {
+        if (b != null) {
+          b.close();
+        }
+      }
+      throw e;
     }
     return out;
   }

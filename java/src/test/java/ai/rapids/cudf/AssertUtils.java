@@ -32,9 +32,15 @@ public final class AssertUtils {
     int width = expected.getType().getSizeInBytes();
     byte[] edata = expected.getData().toByteArray();
     byte[] adata = actual.getData().toByteArray();
+    // hoist validity copies out of the row loop: isNull() per row would
+    // re-copy the whole native buffer each call
+    byte[] evalid = expected.getValid() == null ? null
+        : expected.getValid().toByteArray();
+    byte[] avalid = actual.getValid() == null ? null
+        : actual.getValid().toByteArray();
     for (long r = 0; r < expected.getRowCount(); r++) {
-      boolean enull = expected.isNull(r);
-      boolean anull = actual.isNull(r);
+      boolean enull = evalid != null && evalid[(int) r] == 0;
+      boolean anull = avalid != null && avalid[(int) r] == 0;
       if (enull != anull) {
         throw new AssertionError(what + " row " + r + ": null " + enull
             + " vs " + anull);

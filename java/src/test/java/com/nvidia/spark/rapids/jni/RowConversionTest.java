@@ -17,8 +17,11 @@ import ai.rapids.cudf.ColumnVector;
 import ai.rapids.cudf.DType;
 import ai.rapids.cudf.Table;
 
+import org.junit.jupiter.api.Test;
+
 public class RowConversionTest {
 
+  @Test
   public void fixedWidthRowsRoundTrip() {
     long before = HostBuffer.liveHandleCount();
     Table in = new Table.TestBuilder()
@@ -65,6 +68,7 @@ public class RowConversionTest {
     }
   }
 
+  @Test
   public void emptySchemaRejected() {
     boolean threw = false;
     try {

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Configure-once native build — the build-libcudf.xml discipline
+# (build-libcudf.xml:23-30): only rerun CMake configure when no
+# CMakeCache.txt exists or NATIVE_BUILD_CONFIGURE=true, so incremental
+# `mvn verify` runs reuse the build tree (CONTRIBUTING.md:46-55
+# rationale in the reference).
+set -euo pipefail
+
+here="$(cd "$(dirname "$0")" && pwd)"
+repo="$(cd "$here/.." && pwd)"
+build="$repo/build"
+
+if [[ ! -f "$build/CMakeCache.txt" || "${NATIVE_BUILD_CONFIGURE:-false}" == "true" ]]; then
+  cmake -S "$repo/src" -B "$build" \
+    -DSRT_WERROR="${SRT_WERROR:-ON}" \
+    -DCMAKE_BUILD_TYPE=Release
+fi
+cmake --build "$build" --parallel "${CPP_PARALLEL_LEVEL:-4}"

@@ -113,7 +113,12 @@ def test_every_native_method_has_a_bridge_symbol():
     assert natives, "no native methods found in the Java tree"
     for fqcn, method in natives:
         sym = _jni_mangle(fqcn, method)
-        assert sym in jni_src, f"bridge missing JNI symbol {sym}"
+        # must be the full symbol (followed by its parameter list), not a
+        # prefix of a longer one: `convertToRows` does not match
+        # `convertToRowsNative(`
+        assert re.search(
+            re.escape(sym) + r"\s*\(", jni_src
+        ), f"bridge missing JNI symbol {sym}"
 
 
 def test_dtype_ids_match_python():
