@@ -62,8 +62,14 @@ with open(path, "w") as f:
 print("pins changed" if changed else "pins unchanged")
 PY
 
-# Install the candidate stack so the gate below tests what the new pins
-# describe (the reference's submodule checkout step).
+# Install the candidate stack into a throwaway venv so the shared
+# runner's environment is untouched whatever the gate decides (a failed
+# gate must not leave other jobs' dependency-check red).
+sync_venv="$(mktemp -d)/venv"
+python3 -m venv --system-site-packages "$sync_venv"
+# shellcheck disable=SC1091
+source "$sync_venv/bin/activate"
+trap 'deactivate || true' EXIT
 python3 -m pip install -r env/requirements-pin.txt
 
 if git diff --quiet env/requirements-pin.txt; then

@@ -39,7 +39,10 @@ for cls in "${classifiers[@]}"; do
 done
 
 if [[ -n "${SERVER_URL:-}" ]]; then
-  mvn -s ci/settings.xml deploy -DskipTests \
+  # ci/settings.xml wires a central mirror from MAVEN_MIRROR_URL; only
+  # pass it when that variable is set, or the unresolved placeholder
+  # would break every dependency download
+  mvn ${MAVEN_MIRROR_URL:+-s ci/settings.xml} deploy -DskipTests \
     -DaltDeploymentRepository="${SERVER_ID}::default::${SERVER_URL}"
 fi
 

@@ -7,7 +7,10 @@ set -euxo pipefail
 repo="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$repo"
 
-rm -rf build
+# Clean only the CMake outputs: build/ also holds the checked-in
+# build-info and dependency-check scripts.
+rm -rf build/CMakeCache.txt build/CMakeFiles build/Makefile \
+  build/cmake_install.cmake build/libspark_rapids_tpu.so
 build/dependency-check || true  # nightly reports drift but proceeds
 NATIVE_BUILD_CONFIGURE=true SRT_WERROR=ON \
   CPP_PARALLEL_LEVEL="${PARALLEL_LEVEL:-4}" \

@@ -24,6 +24,25 @@ from .hashing import murmur3_column, murmur3_table
 from .groupby import groupby_aggregate, GroupbyAgg
 from .join import inner_join, left_join, semi_join, anti_join
 from .partition import hash_partition, round_robin_partition
+from .rounding import round_column
+from . import datetime, replace, rounding
+from .copying import (
+    concatenate,
+    concatenate_columns,
+    interleave_columns,
+    copy_if_else,
+    sequence,
+)
+from .replace import (
+    replace_nulls,
+    replace_nulls_policy,
+    nans_to_nulls,
+    find_and_replace,
+    clamp,
+)
+from .search import lower_bound, upper_bound, contains_column
+from .scan import scan
+from .compaction import distinct, distinct_capped, distinct_count
 
 __all__ = [
     "compute",
@@ -61,4 +80,23 @@ __all__ = [
     "anti_join",
     "hash_partition",
     "round_robin_partition",
+    "round_column",
+    "datetime",
+    "concatenate",
+    "concatenate_columns",
+    "interleave_columns",
+    "copy_if_else",
+    "sequence",
+    "replace_nulls",
+    "replace_nulls_policy",
+    "nans_to_nulls",
+    "find_and_replace",
+    "clamp",
+    "lower_bound",
+    "upper_bound",
+    "contains_column",
+    "scan",
+    "distinct",
+    "distinct_capped",
+    "distinct_count",
 ]

@@ -1,0 +1,79 @@
+"""Stream compaction (cudf ``distinct`` / ``unique`` / ``distinct_count``).
+
+Capability-surface row of SURVEY.md §2.3. Distinct is sort-based — the
+canonical TPU formulation (SURVEY.md §7 hard part 1: no device-wide
+hash-table atomics; sorting by the uniform u64 order keys replaces
+cuco's insert-and-test): sort rows by key words, keep each run head.
+Follows the library's two-phase shape discipline: ``distinct`` host-syncs
+the count (cudf call model), ``distinct_capped`` stays jittable with a
+caller capacity, ``distinct_count`` is a jittable scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtype as dt
+from ..column import Column, Table
+from .filter import filter_table, filter_table_capped
+from .keys import column_order_keys
+
+
+def _first_of_run_mask(table: Table, keys: Optional[Sequence]) -> Column:
+    """BOOL8 mask keeping the first occurrence of each distinct key row
+    (order-preserving: the kept row is the earliest original row)."""
+    cols = (
+        [table.column(k) for k in keys] if keys is not None else list(table.columns)
+    )
+    # cudf distinct treats nulls as equal to each other: zero a null
+    # row's data words (whatever bytes sit under a null must not split
+    # the null group) and add a validity word to separate null from a
+    # genuine zero key
+    words: list[jax.Array] = []
+    for c in cols:
+        cwords = column_order_keys(c)
+        if c.validity is not None:
+            cwords = [jnp.where(c.validity, w, jnp.uint64(0)) for w in cwords]
+            cwords.append(c.validity.astype(jnp.uint64))
+        words.extend(cwords)
+    n = table.row_count
+    perm = jnp.lexsort(tuple(reversed([*words, jnp.arange(n, dtype=jnp.uint64)])))
+    sorted_words = [w[perm] for w in words]
+    neq_prev = jnp.zeros((n,), dtype=jnp.bool_)
+    for w in sorted_words:
+        neq_prev = jnp.logical_or(
+            neq_prev, jnp.concatenate([jnp.ones((1,), jnp.bool_), w[1:] != w[:-1]])
+        )
+    # head of each run in sorted order; stable tiebreaker (arange above)
+    # makes the head the smallest original index
+    keep_sorted = neq_prev
+    keep = jnp.zeros((n,), dtype=jnp.bool_).at[perm].set(keep_sorted)
+    return Column(keep, dt.BOOL8, None)
+
+
+def distinct(table: Table, keys: Optional[Sequence] = None) -> Table:
+    """First occurrence of every distinct key row (eager; host-syncs the
+    result size, the cudf/JNI call model)."""
+    return filter_table(table, _first_of_run_mask(table, keys))
+
+
+def distinct_capped(
+    table: Table, keys: Optional[Sequence] = None, capacity: Optional[int] = None
+) -> tuple[Table, jax.Array]:
+    """Jittable distinct: padded result + device count."""
+    cap = capacity if capacity is not None else table.row_count
+    return filter_table_capped(table, _first_of_run_mask(table, keys), cap)
+
+
+def distinct_count(
+    obj: Union[Table, Column], keys: Optional[Sequence] = None
+) -> jax.Array:
+    """Number of distinct rows/values (jittable scalar; cudf
+    ``distinct_count``). Nulls count as one group, matching
+    NULL_POLICY.INCLUDE."""
+    table = obj if isinstance(obj, Table) else Table([obj], ["c"])
+    mask = _first_of_run_mask(table, keys)
+    return jnp.sum(mask.data).astype(jnp.int32)

@@ -1,0 +1,134 @@
+"""Copy-family ops (cudf ``concatenate`` / ``interleave_columns`` /
+``copy_if_else`` / ``sequence``).
+
+Capability-surface rows of SURVEY.md §2.3: column factories and
+table-assembly utilities the vendored cudf Java suite exercises. All
+shapes here are static functions of the inputs, so every op jits.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column, Table
+from . import compute
+
+
+def concatenate_columns(cols: Sequence[Column]) -> Column:
+    """Vertical concatenation of same-dtype columns."""
+    if not cols:
+        raise ValueError("concatenate needs at least one column")
+    d = cols[0].dtype
+    for c in cols[1:]:
+        if c.dtype != d:
+            raise TypeError(f"concatenate dtype mismatch: {d} vs {c.dtype}")
+    lengths = None
+    if d.is_string:
+        # strings carry a (n,) lengths vector beside the padded matrix;
+        # repad to the widest so row widths agree before concatenating
+        from .strings import repad
+
+        width = max(c.data.shape[1] for c in cols)
+        cols = [repad(c, width) for c in cols]
+        data = jnp.concatenate([c.data for c in cols], axis=0)
+        lengths = jnp.concatenate([c.lengths for c in cols])
+    else:
+        data = jnp.concatenate([c.data for c in cols], axis=0)
+    if any(c.validity is not None for c in cols):
+        valid = jnp.concatenate([compute.valid_mask(c) for c in cols])
+    else:
+        valid = None
+    return Column(data, d, valid, lengths)
+
+
+def concatenate(tables: Sequence[Table]) -> Table:
+    """Vertical concatenation of same-schema tables (cudf
+    ``Table.concatenate``)."""
+    if not tables:
+        raise ValueError("concatenate needs at least one table")
+    first = tables[0]
+    for t in tables[1:]:
+        if t.num_columns != first.num_columns:
+            raise ValueError("concatenate: column counts differ")
+    out = [
+        concatenate_columns([t.columns[i] for t in tables])
+        for i in range(first.num_columns)
+    ]
+    return Table(out, list(first.names))
+
+
+def interleave_columns(table: Table) -> Column:
+    """Row-major interleave of same-dtype columns into one column
+    (cudf ``interleave_columns``): output row i*ncols+j = col j row i."""
+    d = table.columns[0].dtype
+    for c in table.columns[1:]:
+        if c.dtype != d:
+            raise TypeError("interleave_columns needs uniform dtype")
+    if d.is_string:
+        raise TypeError("interleave_columns: fixed-width only")
+    data = jnp.stack([c.data for c in table.columns], axis=1).reshape(-1)
+    if any(c.validity is not None for c in table.columns):
+        valid = jnp.stack(
+            [compute.valid_mask(c) for c in table.columns], axis=1
+        ).reshape(-1)
+    else:
+        valid = None
+    return Column(data, d, valid)
+
+
+def copy_if_else(
+    mask: Column, lhs: Union[Column, object], rhs: Union[Column, object]
+) -> Column:
+    """Per-row select: mask TRUE -> lhs, else rhs (cudf ``copy_if_else``).
+    Null mask rows select rhs (Spark CASE WHEN semantics). Scalars are
+    broadcast."""
+    if not mask.dtype.is_boolean:
+        raise TypeError("copy_if_else mask must be BOOL8")
+    pred = mask.data
+    if mask.validity is not None:
+        pred = jnp.logical_and(pred, mask.validity)
+    n = len(mask)
+
+    def as_column(x, like: Column | None):
+        if isinstance(x, Column):
+            return x
+        if like is None:
+            raise TypeError("copy_if_else: both sides scalar is ambiguous")
+        vals = jnp.full((n,), x)
+        return compute.from_values(vals, like.dtype, None)
+
+    lhs_col = as_column(lhs, rhs if isinstance(rhs, Column) else None)
+    rhs_col = as_column(rhs, lhs_col)
+    if lhs_col.dtype != rhs_col.dtype:
+        raise TypeError(
+            f"copy_if_else dtype mismatch: {lhs_col.dtype} vs {rhs_col.dtype}"
+        )
+    lengths = None
+    if lhs_col.dtype.is_string:
+        if lhs_col.data.shape[1] != rhs_col.data.shape[1]:
+            from .strings import repad
+
+            width = max(lhs_col.data.shape[1], rhs_col.data.shape[1])
+            lhs_col, rhs_col = repad(lhs_col, width), repad(rhs_col, width)
+        data = jnp.where(pred[:, None], lhs_col.data, rhs_col.data)
+        lengths = jnp.where(pred, lhs_col.lengths, rhs_col.lengths)
+    else:
+        data = jnp.where(pred, lhs_col.data, rhs_col.data)
+    if lhs_col.validity is None and rhs_col.validity is None:
+        valid = None
+    else:
+        valid = jnp.where(
+            pred, compute.valid_mask(lhs_col), compute.valid_mask(rhs_col)
+        )
+    return Column(data, lhs_col.dtype, valid, lengths)
+
+
+def sequence(n: int, start=0, step=1, dtype: dt.DType = dt.INT32) -> Column:
+    """Arithmetic sequence column (cudf ``sequence``; the offsets builder
+    of the reference's row conversion, row_conversion.cu:389-390)."""
+    vals = start + step * jnp.arange(n, dtype=jnp.int64)
+    return compute.from_values(vals, dtype, None)

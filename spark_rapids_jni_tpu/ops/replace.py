@@ -1,0 +1,113 @@
+"""Replace-family ops (cudf ``replace_nulls`` / ``nans_to_nulls`` /
+``find_and_replace`` / ``clamp``).
+
+Capability-surface rows of SURVEY.md §2.3. The fill policies re-express
+cudf's scan-based implementations as ``jnp`` cumulative maxima over row
+indices — O(n) segmented-propagation without serial loops, which is the
+TPU-friendly formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import dtype as dt
+from ..column import Column
+from . import compute
+
+PRECEDING = "preceding"  # carry last valid value forward
+FOLLOWING = "following"  # carry next valid value backward
+
+
+def replace_nulls(col: Column, value) -> Column:
+    """Nulls -> ``value`` (scalar or same-dtype column); result keeps
+    nulls only where a replacement column is itself null. Strings route
+    through copy_if_else (which handles the 2-D byte matrix + lengths)."""
+    if col.validity is None:
+        return col
+    if col.dtype.is_string:
+        from .copying import copy_if_else
+
+        if not isinstance(value, Column):
+            value = Column.from_strings([value] * len(col))
+        mask = Column(col.validity, dt.BOOL8, None)
+        return copy_if_else(mask, col, value)
+    if isinstance(value, Column):
+        if value.dtype != col.dtype:
+            raise TypeError("replace_nulls: replacement dtype mismatch")
+        data = jnp.where(col.validity, col.data, value.data)
+        valid = jnp.where(
+            col.validity, True, compute.valid_mask(value)
+        )
+        return Column(data, col.dtype, valid)
+    fill = compute.encode_values(jnp.full((1,), value), col.dtype)[0]
+    data = jnp.where(col.validity, col.data, fill)
+    return Column(data, col.dtype, None)
+
+
+def replace_nulls_policy(col: Column, policy: str) -> Column:
+    """Directional fill: PRECEDING = last-observation-carried-forward,
+    FOLLOWING = next-observation-carried-backward. Leading (resp.
+    trailing) nulls stay null."""
+    if col.validity is None:
+        return col
+    if col.dtype.is_string:
+        raise TypeError("replace_nulls_policy: fixed-width only")
+    n = len(col)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if policy == PRECEDING:
+        # source[i] = largest valid row index <= i
+        src = lax.cummax(jnp.where(col.validity, idx, -1))
+        valid = src >= 0
+    elif policy == FOLLOWING:
+        # source[i] = smallest valid row index >= i (cummax on the
+        # reversed, negated index)
+        src = jnp.where(col.validity, idx, n)
+        src = n - 1 - lax.cummax((n - 1 - src)[::-1])[::-1]
+        valid = src <= n - 1
+    else:
+        raise ValueError(f"unknown fill policy {policy!r}")
+    data = jnp.take(col.data, jnp.clip(src, 0, n - 1), axis=0)
+    return Column(data, col.dtype, valid)
+
+
+def nans_to_nulls(col: Column) -> Column:
+    """Float NaN payloads become nulls (cudf ``nans_to_nulls``)."""
+    if not col.dtype.is_floating:
+        return col
+    not_nan = jnp.logical_not(jnp.isnan(compute.values(col)))
+    valid = (
+        not_nan
+        if col.validity is None
+        else jnp.logical_and(col.validity, not_nan)
+    )
+    return Column(col.data, col.dtype, valid)
+
+
+def find_and_replace(col: Column, old_values, new_values) -> Column:
+    """Value substitution table (cudf ``find_and_replace_all``): each row
+    equal to old_values[k] becomes new_values[k]."""
+    if len(old_values) != len(new_values):
+        raise ValueError("find_and_replace: length mismatch")
+    vals = compute.values(col)
+    out = vals
+    for old, new in zip(old_values, new_values):
+        out = jnp.where(vals == old, jnp.asarray(new, out.dtype), out)
+    return compute.from_values(out, col.dtype, col.validity)
+
+
+def clamp(
+    col: Column,
+    lo: Union[int, float, None] = None,
+    hi: Union[int, float, None] = None,
+) -> Column:
+    """Clamp values into [lo, hi] (cudf ``clamp``); None bound = open."""
+    vals = compute.values(col)
+    if lo is not None:
+        vals = jnp.maximum(vals, jnp.asarray(lo, vals.dtype))
+    if hi is not None:
+        vals = jnp.minimum(vals, jnp.asarray(hi, vals.dtype))
+    return compute.from_values(vals, col.dtype, col.validity)

@@ -1,0 +1,80 @@
+"""Rounding (cudf ``round``: HALF_UP / HALF_EVEN) for numeric and
+decimal columns.
+
+Capability-surface row of SURVEY.md §2.3 (the vendored cudf Java test
+suite exercises ``Table.round``/``ColumnVector.round``). Decimal columns
+round on the unscaled integer representation — exact, no float detour —
+matching Spark's Decimal semantics; floats scale/round/unscale in f64.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import dtype as dt
+from ..column import Column
+from . import compute
+
+HALF_UP = "half_up"
+HALF_EVEN = "half_even"
+
+
+def _round_half_up(vals, scale):
+    # half away from zero (Spark/cudf HALF_UP), not floor(x+0.5)
+    scaled = vals * scale
+    return jnp.where(
+        scaled >= 0, jnp.floor(scaled + 0.5), jnp.ceil(scaled - 0.5)
+    ) / scale
+
+
+def _round_half_even(vals, scale):
+    # jnp.round implements banker's rounding
+    return jnp.round(vals * scale) / scale
+
+
+def _round_unscaled(unscaled, shift, how):
+    """Round integer ``unscaled`` to a multiple of 10**shift (shift>0),
+    exactly, in integer arithmetic."""
+    p = jnp.asarray(10, unscaled.dtype) ** shift
+    q = unscaled // p  # floor division
+    r = unscaled - q * p  # remainder in [0, p)
+    if how == HALF_UP:
+        # half away from zero. r is the floor-division remainder, so for
+        # negatives the tie (2r == p) must stay at q (the more-negative
+        # floor) while positives move up.
+        up = jnp.where(unscaled >= 0, r * 2 >= p, r * 2 > p)
+    else:
+        tie = r * 2 == p
+        up = jnp.where(tie, q % 2 != 0, r * 2 > p)
+    return (q + up.astype(unscaled.dtype)) * p
+
+
+def round_column(
+    col: Column, decimal_places: int = 0, how: str = HALF_UP
+) -> Column:
+    """Round to ``decimal_places`` (negative = powers of ten left of the
+    point). Output dtype: unchanged for floats/ints; decimals keep their
+    scale (cudf round keeps the column type, adjusting only values)."""
+    if how not in (HALF_UP, HALF_EVEN):
+        raise ValueError(f"unknown rounding mode {how!r}")
+    d = col.dtype
+    if d.is_decimal:
+        # value = unscaled * 10^scale; rounding at decimal_places means
+        # zeroing digits below 10^(-decimal_places)
+        shift = -decimal_places - d.scale
+        if shift <= 0:
+            return col  # already coarser than requested
+        out = _round_unscaled(col.data, shift, how)
+        return Column(out, d, col.validity)
+    if d.is_floating:
+        vals = compute.values(col)
+        scale = 10.0 ** decimal_places
+        fn = _round_half_up if how == HALF_UP else _round_half_even
+        return compute.from_values(fn(vals, scale), d, col.validity)
+    if d.is_integer:
+        if decimal_places >= 0:
+            return col
+        shift = -decimal_places
+        out = _round_unscaled(col.data, shift, how)
+        return Column(out, d, col.validity)
+    raise TypeError(f"round: unsupported dtype {d}")

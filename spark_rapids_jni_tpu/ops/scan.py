@@ -1,0 +1,53 @@
+"""Cumulative scans (cudf ``scan``: SUM/MIN/MAX/PRODUCT, inclusive or
+exclusive, null-excluding).
+
+Capability-surface row of SURVEY.md §2.3 (cudf Java suite covers
+ColumnVector.scan). Null policy matches cudf EXCLUDE: null rows emit
+null and do not contribute; the running aggregate carries past them —
+expressed as a masked identity substitution before one ``associative_scan``,
+which XLA lowers to a log-depth TPU scan.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..column import Column
+from . import compute
+
+_OPS = {
+    "sum": jnp.add,
+    "product": jnp.multiply,
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+
+def _identity_for(agg: str, dtype) -> object:
+    if agg == "sum":
+        return 0
+    if agg == "product":
+        return 1
+    if jnp.issubdtype(dtype, jnp.bool_):
+        return agg == "min"  # min identity True, max identity False
+    info_fn = jnp.finfo if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo
+    if agg == "min":
+        return info_fn(dtype).max
+    return info_fn(dtype).min
+
+
+def scan(col: Column, agg: str, inclusive: bool = True) -> Column:
+    """Running aggregate down the column. Output dtype == input dtype
+    (cudf scan contract); null rows are excluded and stay null."""
+    if agg not in _OPS:
+        raise ValueError(f"unknown scan aggregation {agg!r}")
+    vals = compute.values(col)
+    ident = jnp.asarray(_identity_for(agg, vals.dtype), vals.dtype)
+    if col.validity is not None:
+        vals = jnp.where(col.validity, vals, ident)
+    out = lax.associative_scan(_OPS[agg], vals)
+    if not inclusive:
+        # exclusive scan: shift right, seed with identity
+        out = jnp.concatenate([ident[None], out[:-1]])
+    return compute.from_values(out, col.dtype, col.validity)

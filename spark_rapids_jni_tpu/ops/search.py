@@ -1,0 +1,105 @@
+"""Search ops (cudf ``lower_bound`` / ``upper_bound`` / ``contains``).
+
+Capability-surface rows of SURVEY.md §2.3 (the vendored cudf Java suite
+covers Table.lowerBound/upperBound and ColumnVector.contains). Rows
+reduce to the shared uint64 order-key space of ops/keys.py and the
+bounds run through the same vectorized multi-word binary search the
+join uses — one code path for every fixed-width and string type instead
+of cudf's per-type comparator dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtype as dt
+from ..column import Column, Table
+from .join import _lex_searchsorted
+from .keys import table_order_keys
+
+
+def _key_words(table: Table, keys: Sequence | None) -> list[jax.Array]:
+    cols = (
+        [table.column(k) for k in keys]
+        if keys is not None
+        else list(table.columns)
+    )
+    return table_order_keys(cols)
+
+
+def lower_bound(
+    haystack: Table, needles: Table, keys: Sequence | None = None
+) -> Column:
+    """First insertion index of each needle row into the sorted haystack
+    (cudf ``lower_bound``). ``haystack`` must already be sorted ascending
+    on ``keys`` (defaults: positionally, all columns)."""
+    return _bound(haystack, needles, keys, "left")
+
+
+def upper_bound(
+    haystack: Table, needles: Table, keys: Sequence | None = None
+) -> Column:
+    """One-past-last insertion index (cudf ``upper_bound``)."""
+    return _bound(haystack, needles, keys, "right")
+
+
+def _bound(haystack: Table, needles: Table, keys, side: str) -> Column:
+    hwords = _key_words(haystack, keys)
+    nwords = _key_words(
+        needles, keys if keys is not None and _names_apply(needles, keys) else None
+    )
+    if len(hwords) != len(nwords):
+        raise ValueError("lower/upper_bound: key schemas differ")
+    out = _lex_searchsorted(hwords, nwords, side)
+    return Column(out.astype(jnp.int32), dt.INT32, None)
+
+
+def _names_apply(table: Table, keys) -> bool:
+    try:
+        for k in keys:
+            table.column(k)
+        return True
+    except (KeyError, IndexError, ValueError):
+        return False
+
+
+def _sorted_words(words: list[jax.Array]) -> list[jax.Array]:
+    """Sort rows of a multi-word key set lexicographically."""
+    # lexsort: last key is primary
+    perm = jnp.lexsort(tuple(reversed(words)))
+    return [w[perm] for w in words]
+
+
+def contains_column(
+    haystack: Column, needles: Column
+) -> Column:
+    """BOOL8 column: is each needle value present in haystack (cudf
+    ``contains``, the IN-list expression). Null needles stay null; null
+    haystack entries never match."""
+    if haystack.dtype != needles.dtype:
+        raise TypeError(
+            f"contains: dtype mismatch {haystack.dtype} vs {needles.dtype}"
+        )
+    hwords = table_order_keys([haystack])
+    nwords = table_order_keys([needles])
+    if haystack.validity is not None:
+        # exile null rows to a key needles can only match if they also
+        # carry the max key AND are valid — handled by the equality scan
+        # below over hi>lo ranges of *valid* rows only
+        mask = haystack.validity
+        hwords = [
+            jnp.where(mask, w, jnp.uint64(0xFFFFFFFFFFFFFFFF)) for w in hwords
+        ]
+    sw = _sorted_words(hwords)
+    lo = _lex_searchsorted(sw, nwords, "left")
+    hi = _lex_searchsorted(sw, nwords, "right")
+    found = hi > lo
+    if haystack.validity is not None:
+        # a needle equal to the exile key could false-positive against
+        # nulled slots; cap the range at the count of valid rows
+        n_valid = jnp.sum(haystack.validity).astype(jnp.int32)
+        found = jnp.logical_and(found, lo < n_valid)
+    return Column(found, dt.BOOL8, needles.validity)

@@ -1,0 +1,347 @@
+"""Tests for the extended op families (round, datetime, copying,
+replace, search, scan, compaction) — the remaining rows of the cudf
+capability surface (SURVEY.md §2.3), each checked against an
+independent numpy/python oracle."""
+
+import datetime as pydt
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu as srt
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import ops
+from spark_rapids_jni_tpu.column import Column, Table
+
+
+def col(values, dtype=None):
+    return Column.from_numpy(np.asarray(values, dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# round
+# ---------------------------------------------------------------------------
+
+class TestRound:
+    def test_float_half_up(self):
+        c = col([2.5, 3.5, -2.5, 1.234, -1.235], np.float64)
+        got = ops.round_column(c, 0, "half_up").to_pylist()
+        assert got[:3] == [3.0, 4.0, -3.0]
+
+    def test_float_half_even(self):
+        c = col([2.5, 3.5, 4.5, -2.5], np.float64)
+        got = ops.round_column(c, 0, "half_even").to_pylist()
+        assert got == [2.0, 4.0, 4.0, -2.0]
+
+    def test_float_places(self):
+        c = col([1.25, 1.351, -9.875], np.float64)
+        got = ops.round_column(c, 1, "half_up").to_pylist()
+        np.testing.assert_allclose(got, [1.3, 1.4, -9.9], atol=1e-9)
+
+    def test_int_negative_places(self):
+        c = col([149, 150, -150, -151, 1250], np.int64)
+        up = ops.round_column(c, -2, "half_up").to_pylist()
+        assert up == [100, 200, -200, -200, 1300]
+        even = ops.round_column(c, -2, "half_even").to_pylist()
+        assert even == [100, 200, -200, -200, 1200]
+
+    def test_decimal_exact(self):
+        # DECIMAL64 scale -3: unscaled 1500 = 1.500
+        c = Column(np.array([1500, 2500, -1500], np.int64), dt.DType(dt.TypeId.DECIMAL64, -3), None)
+        got = ops.round_column(c, 0, "half_up")
+        assert got.dtype == c.dtype
+        assert [int(v) for v in np.asarray(got.data)] == [2000, 3000, -2000]
+
+    def test_nulls_pass_through(self):
+        c = Column.from_numpy(np.array([1.5, 2.5]), validity=np.array([True, False]))
+        got = ops.round_column(c, 0, "half_up")
+        assert got.to_pylist() == [2.0, None]
+
+
+# ---------------------------------------------------------------------------
+# datetime
+# ---------------------------------------------------------------------------
+
+class TestDatetime:
+    def _ts_col(self, dates, unit=dt.TypeId.TIMESTAMP_SECONDS):
+        epoch = pydt.datetime(1970, 1, 1)
+        secs = np.array(
+            [int((d - epoch).total_seconds()) for d in dates], np.int64
+        )
+        return Column(secs, dt.DType(unit), None), dates
+
+    def test_ymd_fields(self):
+        dates = [
+            pydt.datetime(2000, 2, 29, 13, 45, 56),
+            pydt.datetime(1969, 12, 31, 23, 59, 59),
+            pydt.datetime(2024, 1, 1, 0, 0, 0),
+            pydt.datetime(1900, 3, 1, 6, 30, 15),
+        ]
+        c, ds = self._ts_col(dates)
+        assert ops.datetime.year(c).to_pylist() == [d.year for d in ds]
+        assert ops.datetime.month(c).to_pylist() == [d.month for d in ds]
+        assert ops.datetime.day(c).to_pylist() == [d.day for d in ds]
+        assert ops.datetime.hour(c).to_pylist() == [d.hour for d in ds]
+        assert ops.datetime.minute(c).to_pylist() == [d.minute for d in ds]
+        assert ops.datetime.second(c).to_pylist() == [d.second for d in ds]
+
+    def test_weekday_iso(self):
+        dates = [
+            pydt.datetime(2024, 7, 29) + pydt.timedelta(days=i)
+            for i in range(7)
+        ]  # Mon..Sun
+        c, ds = self._ts_col(dates)
+        assert ops.datetime.weekday(c).to_pylist() == [
+            d.isoweekday() for d in ds
+        ]
+
+    def test_day_of_year(self):
+        dates = [pydt.datetime(2024, 3, 1), pydt.datetime(2023, 3, 1)]
+        c, ds = self._ts_col(dates)
+        assert ops.datetime.day_of_year(c).to_pylist() == [
+            d.timetuple().tm_yday for d in ds
+        ]
+
+    def test_last_day_of_month(self):
+        days = np.array(
+            [
+                (pydt.date(2024, 2, 5) - pydt.date(1970, 1, 1)).days,
+                (pydt.date(2023, 2, 5) - pydt.date(1970, 1, 1)).days,
+            ],
+            np.int32,
+        )
+        c = Column(days, dt.TIMESTAMP_DAYS, None)
+        got = ops.datetime.last_day_of_month(c)
+        want = [
+            (pydt.date(2024, 2, 29) - pydt.date(1970, 1, 1)).days,
+            (pydt.date(2023, 2, 28) - pydt.date(1970, 1, 1)).days,
+        ]
+        assert [int(v) for v in np.asarray(got.data)] == want
+
+    def test_add_months_clamps(self):
+        days = np.array(
+            [(pydt.date(2024, 1, 31) - pydt.date(1970, 1, 1)).days], np.int32
+        )
+        c = Column(days, dt.TIMESTAMP_DAYS, None)
+        got = ops.datetime.add_calendrical_months(c, 1)
+        want = (pydt.date(2024, 2, 29) - pydt.date(1970, 1, 1)).days
+        assert int(np.asarray(got.data)[0]) == want
+
+    def test_random_roundtrip_vs_numpy(self):
+        rng = np.random.default_rng(7)
+        days = rng.integers(-40000, 40000, 200).astype(np.int64)
+        secs = days * 86400 + rng.integers(0, 86400, 200)
+        c = Column(secs, dt.DType(dt.TypeId.TIMESTAMP_SECONDS), None)
+        as_np = secs.astype("datetime64[s]")
+        y = as_np.astype("datetime64[Y]").astype(int) + 1970
+        assert ops.datetime.year(c).to_pylist() == list(y)
+
+
+# ---------------------------------------------------------------------------
+# copying
+# ---------------------------------------------------------------------------
+
+class TestCopying:
+    def test_concatenate_tables(self):
+        t1 = Table.from_pydict({"a": [1, 2], "b": [1.0, 2.0]})
+        t2 = Table.from_pydict({"a": [3, None], "b": [3.0, 4.0]})
+        out = ops.concatenate([t1, t2])
+        assert out["a"].to_pylist() == [1, 2, 3, None]
+        assert out["b"].to_pylist() == [1.0, 2.0, 3.0, 4.0]
+
+    def test_concatenate_strings(self):
+        t1 = Table.from_pydict({"s": ["a", "bb"]})
+        t2 = Table.from_pydict({"s": ["cccc", None]})
+        out = ops.concatenate([t1, t2])
+        assert out["s"].to_pylist() == ["a", "bb", "cccc", None]
+
+    def test_interleave(self):
+        t = Table.from_pydict({"a": [1, 2], "b": [10, 20]})
+        out = ops.interleave_columns(t)
+        assert out.to_pylist() == [1, 10, 2, 20]
+
+    def test_copy_if_else_columns(self):
+        mask = Column(np.array([True, False, True]), dt.BOOL8, np.array([True, True, False]))
+        lhs = col([1, 2, 3], np.int64)
+        rhs = col([10, 20, 30], np.int64)
+        out = ops.copy_if_else(mask, lhs, rhs)
+        # null mask row selects rhs
+        assert out.to_pylist() == [1, 20, 30]
+
+    def test_copy_if_else_scalar(self):
+        mask = Column(np.array([True, False]), dt.BOOL8, None)
+        rhs = col([5, 6], np.int64)
+        out = ops.copy_if_else(mask, 0, rhs)
+        assert out.to_pylist() == [0, 6]
+
+    def test_sequence(self):
+        out = ops.sequence(5, start=10, step=3, dtype=dt.INT64)
+        assert out.to_pylist() == [10, 13, 16, 19, 22]
+
+
+# ---------------------------------------------------------------------------
+# replace
+# ---------------------------------------------------------------------------
+
+class TestReplace:
+    def test_replace_nulls_scalar(self):
+        c = Column.from_numpy(
+            np.array([1, 2, 3], np.int64), validity=np.array([True, False, True])
+        )
+        out = ops.replace_nulls(c, 99)
+        assert out.to_pylist() == [1, 99, 3]
+        assert out.validity is None
+
+    def test_replace_nulls_column(self):
+        c = Column.from_numpy(
+            np.array([1, 2, 3], np.int64), validity=np.array([False, True, False])
+        )
+        fill = col([10, 20, 30], np.int64)
+        assert ops.replace_nulls(c, fill).to_pylist() == [10, 2, 30]
+
+    def test_fill_preceding_following(self):
+        c = Column.from_numpy(
+            np.array([0, 1, 0, 0, 4], np.int64),
+            validity=np.array([False, True, False, False, True]),
+        )
+        fwd = ops.replace_nulls_policy(c, ops.replace.PRECEDING)
+        assert fwd.to_pylist() == [None, 1, 1, 1, 4]
+        bwd = ops.replace_nulls_policy(c, ops.replace.FOLLOWING)
+        assert bwd.to_pylist() == [1, 1, 4, 4, 4]
+
+    def test_replace_nulls_strings(self):
+        c = Column.from_strings(["aa", None, "cccc"])
+        out = ops.replace_nulls(c, "xx")
+        assert out.to_pylist() == ["aa", "xx", "cccc"]
+        fill = Column.from_strings(["1", "22", "333"])
+        out2 = ops.replace_nulls(c, fill)
+        assert out2.to_pylist() == ["aa", "22", "cccc"]
+
+    def test_nans_to_nulls(self):
+        c = col([1.0, np.nan, 3.0], np.float64)
+        out = ops.nans_to_nulls(c)
+        assert out.to_pylist() == [1.0, None, 3.0]
+
+    def test_find_and_replace(self):
+        c = col([1, 2, 3, 2], np.int64)
+        out = ops.find_and_replace(c, [2, 3], [20, 30])
+        assert out.to_pylist() == [1, 20, 30, 20]
+
+    def test_clamp(self):
+        c = col([-5, 0, 5, 10], np.int64)
+        assert ops.clamp(c, 0, 6).to_pylist() == [0, 0, 5, 6]
+        assert ops.clamp(c, lo=0).to_pylist() == [0, 0, 5, 10]
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+class TestSearch:
+    def test_bounds_single_key(self):
+        hay = Table.from_pydict({"k": [10, 20, 20, 30]})
+        ndl = Table.from_pydict({"k": [5, 20, 35]})
+        lo = ops.lower_bound(hay, ndl).to_pylist()
+        hi = ops.upper_bound(hay, ndl).to_pylist()
+        assert lo == [0, 1, 4]
+        assert hi == [0, 3, 4]
+
+    def test_bounds_multi_key(self):
+        hay = Table.from_pydict({"a": [1, 1, 2, 2], "b": [1.0, 5.0, 1.0, 5.0]})
+        ndl = Table.from_pydict({"a": [1, 2], "b": [5.0, 0.5]})
+        assert ops.lower_bound(hay, ndl).to_pylist() == [1, 2]
+        assert ops.upper_bound(hay, ndl).to_pylist() == [2, 2]
+
+    def test_contains(self):
+        hay = col([1, 3, 5], np.int64)
+        ndl = col([0, 3, 5, 7], np.int64)
+        assert ops.contains_column(hay, ndl).to_pylist() == [
+            False, True, True, False,
+        ]
+
+    def test_contains_null_haystack_never_matches(self):
+        hay = Column.from_numpy(
+            np.array([1, 999], np.int64), validity=np.array([True, False])
+        )
+        ndl = col([999, 1], np.int64)
+        assert ops.contains_column(hay, ndl).to_pylist() == [False, True]
+
+    def test_contains_strings(self):
+        hay = Column.from_strings(["apple", "pear"])
+        ndl = Column.from_strings(["pear", "plum"])
+        assert ops.contains_column(hay, ndl).to_pylist() == [True, False]
+
+
+# ---------------------------------------------------------------------------
+# scan
+# ---------------------------------------------------------------------------
+
+class TestScan:
+    def test_cumsum(self):
+        c = col([1, 2, 3, 4], np.int64)
+        assert ops.scan(c, "sum").to_pylist() == [1, 3, 6, 10]
+        assert ops.scan(c, "sum", inclusive=False).to_pylist() == [0, 1, 3, 6]
+
+    def test_cummin_max_product(self):
+        c = col([3, 1, 4, 1], np.int64)
+        assert ops.scan(c, "min").to_pylist() == [3, 1, 1, 1]
+        assert ops.scan(c, "max").to_pylist() == [3, 3, 4, 4]
+        assert ops.scan(c, "product").to_pylist() == [3, 3, 12, 12]
+
+    def test_scan_skips_nulls(self):
+        c = Column.from_numpy(
+            np.array([1, 5, 2], np.int64),
+            validity=np.array([True, False, True]),
+        )
+        # null emits null; running sum carries past it
+        assert ops.scan(c, "sum").to_pylist() == [1, None, 3]
+
+    def test_scan_bool_min_max(self):
+        c = Column(np.array([True, False, True]), dt.BOOL8, None)
+        assert ops.scan(c, "min").to_pylist() == [True, False, False]
+        assert ops.scan(c, "max").to_pylist() == [True, True, True]
+
+    def test_scan_float(self):
+        c = col([0.5, 0.25, 0.125], np.float64)
+        np.testing.assert_allclose(
+            ops.scan(c, "sum").to_pylist(), [0.5, 0.75, 0.875]
+        )
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+class TestCompaction:
+    def test_distinct_preserves_first(self):
+        t = Table.from_pydict({"k": [3, 1, 3, 2, 1], "v": [0, 1, 2, 3, 4]})
+        out = ops.distinct(t, ["k"])
+        assert out["k"].to_pylist() == [3, 1, 2]
+        assert out["v"].to_pylist() == [0, 1, 3]
+
+    def test_distinct_count(self):
+        t = Table.from_pydict({"k": [1, 1, 2, None, None]})
+        assert int(ops.distinct_count(t)) == 3  # 1, 2, null
+
+    def test_distinct_null_group_ignores_payload_bytes(self):
+        # two nulls over different underlying bytes are ONE group
+        c = Column.from_numpy(
+            np.array([7, 8], np.int64), validity=np.array([False, False])
+        )
+        assert int(ops.distinct_count(Table([c], ["c"]))) == 1
+
+    def test_distinct_capped_jits(self):
+        import jax
+
+        t = Table.from_pydict({"k": [1, 2, 1, 2, 3]})
+        fn = jax.jit(lambda t: ops.distinct_capped(t, ["k"], capacity=5))
+        out, count = fn(t)
+        assert int(count) == 3
+
+    def test_distinct_multi_key_vs_python(self):
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 5, 100)
+        b = rng.integers(0, 4, 100)
+        t = Table.from_pydict({"a": a, "b": b})
+        want = len({(x, y) for x, y in zip(a, b)})
+        assert int(ops.distinct_count(t)) == want
