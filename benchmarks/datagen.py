@@ -1,0 +1,64 @@
+"""Synthetic TPC-DS-like star schema at a row-count scale.
+
+Shapes mirror the tables q5/q23/q64 touch (store_sales, web_sales,
+item, customer, date_dim) with the key distributions that matter for
+the ops under test: skewed fact keys, dense dimension keys, date
+windows. Pure numpy; upload happens in Table.from_pydict.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_jni_tpu.column import Table
+
+
+def generate(sales_rows: int = 100_000, seed: int = 0) -> dict:
+    """Star schema sized off the fact-table row count.
+
+    items ~ rows/50, customers ~ rows/20, dates = 2 years daily.
+    """
+    rng = np.random.default_rng(seed)
+    n_items = max(sales_rows // 50, 8)
+    n_cust = max(sales_rows // 20, 8)
+    n_dates = 730
+
+    # Zipf-ish item popularity: the skew that stresses hash partitioning
+    item_pop = rng.zipf(1.3, sales_rows) % n_items
+
+    def fact(n):
+        return {
+            "item_sk": item_pop[:n].astype(np.int64),
+            "customer_sk": rng.integers(0, n_cust, n, dtype=np.int64),
+            "date_sk": rng.integers(0, n_dates, n, dtype=np.int64),
+            "quantity": rng.integers(1, 100, n, dtype=np.int64),
+            "sales_price": np.round(rng.uniform(0.5, 300.0, n), 2),
+            "net_profit": np.round(rng.uniform(-50.0, 120.0, n), 2),
+        }
+
+    store_sales = fact(sales_rows)
+    web_sales = fact(max(sales_rows // 4, 8))
+
+    item = {
+        "item_sk": np.arange(n_items, dtype=np.int64),
+        "brand_id": rng.integers(0, 100, n_items, dtype=np.int64),
+        "category_id": rng.integers(0, 12, n_items, dtype=np.int64),
+        "current_price": np.round(rng.uniform(0.5, 300.0, n_items), 2),
+    }
+    customer = {
+        "customer_sk": np.arange(n_cust, dtype=np.int64),
+        "birth_year": rng.integers(1930, 2005, n_cust, dtype=np.int64),
+        "state_id": rng.integers(0, 50, n_cust, dtype=np.int64),
+    }
+    date_dim = {
+        "date_sk": np.arange(n_dates, dtype=np.int64),
+        "year": 2000 + np.arange(n_dates, dtype=np.int64) // 365,
+        "moy": (np.arange(n_dates, dtype=np.int64) // 30) % 12 + 1,
+    }
+    return {
+        "store_sales": Table.from_pydict(store_sales),
+        "web_sales": Table.from_pydict(web_sales),
+        "item": Table.from_pydict(item),
+        "customer": Table.from_pydict(customer),
+        "date_dim": Table.from_pydict(date_dim),
+    }

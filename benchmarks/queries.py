@@ -1,0 +1,258 @@
+"""TPC-DS q5/q23/q64-shaped queries over the op library.
+
+Not the literal TPC-DS SQL (whose dimension DDL is far wider) but the
+same operator DAGs at the same shapes — the structures BASELINE.json
+configs 4-5 name:
+
+* q5-shape:  multi-channel fact union -> date filter -> dimension join
+             -> rollup aggregation.
+* q23-shape: frequent-item CTE (groupby+filter) -> semi join against the
+             fact table -> per-customer aggregation.
+* q64-shape: chained multi-dimension joins (item, customer, date) with
+             predicates -> wide-key aggregation.
+
+Each query runs single-chip (eager ops) or distributed over a mesh
+(shuffle-exchange + local capped ops under one jitted shard_map — the
+GpuShuffleExchangeExec replacement, SURVEY.md §2.5/§5.8).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import ops
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+from spark_rapids_jni_tpu.parallel.distributed import (
+    distributed_groupby,
+    distributed_inner_join,
+)
+
+
+def _date_filter(t: Table, lo: int, hi: int) -> Table:
+    mask = Column(
+        jnp.logical_and(t["date_sk"].data >= lo, t["date_sk"].data < hi),
+        dt.BOOL8,
+        None,
+    )
+    return ops.filter_table(t, mask)
+
+
+# ---------------------------------------------------------------------------
+# q5-shape: channel union -> date window -> join item -> category rollup
+# ---------------------------------------------------------------------------
+
+def q5(tables: dict, date_lo: int = 100, date_hi: int = 200) -> Table:
+    store = _date_filter(tables["store_sales"], date_lo, date_hi)
+    web = _date_filter(tables["web_sales"], date_lo, date_hi)
+    allsales = ops.concatenate([store, web])
+    joined = ops.inner_join(allsales, tables["item"], ["item_sk"])
+    rev = ops.mul(joined["quantity"], joined["sales_price"])
+    with_rev = Table(
+        [*joined.columns, rev], [*joined.names, "revenue"]
+    )
+    return ops.groupby_aggregate(
+        with_rev,
+        ["category_id"],
+        [
+            GroupbyAgg("revenue", "sum"),
+            GroupbyAgg("net_profit", "sum"),
+            GroupbyAgg("revenue", "count"),
+        ],
+    )
+
+
+def q5_distributed(tables: dict, mesh, date_lo=100, date_hi=200):
+    """Distributed q5: the union + filter happen per-shard inside the
+    fact tables (cheap, embarrassingly parallel); the aggregation
+    shuffles by category over ICI."""
+    store = _date_filter(tables["store_sales"], date_lo, date_hi)
+    web = _date_filter(tables["web_sales"], date_lo, date_hi)
+    allsales = ops.concatenate([store, web])
+    joined = ops.inner_join(allsales, tables["item"], ["item_sk"])
+    rev = ops.mul(joined["quantity"], joined["sales_price"])
+    with_rev = Table([*joined.columns, rev], [*joined.names, "revenue"])
+    # pad rows to a multiple of the mesh size for sharding; capacity =
+    # a full local shard (12 categories over the mesh is maximally
+    # skewed: one destination may receive everything a device holds)
+    padded = _pad_to_mesh(with_rev, mesh)
+    return distributed_groupby(
+        padded,
+        ["category_id"],
+        [
+            GroupbyAgg("revenue", "sum"),
+            GroupbyAgg("net_profit", "sum"),
+            GroupbyAgg("revenue", "count"),
+        ],
+        mesh,
+        capacity=_full_shard_capacity(padded, mesh),
+    )
+
+
+# ---------------------------------------------------------------------------
+# q23-shape: frequent items CTE -> semi join -> per-customer spend
+# ---------------------------------------------------------------------------
+
+def q23(tables: dict, min_count: int = 4) -> Table:
+    sales = tables["store_sales"]
+    freq = ops.groupby_aggregate(
+        sales, ["item_sk"], [GroupbyAgg("item_sk", "count")]
+    )
+    hot = ops.filter_table(
+        freq,
+        Column(freq["count_item_sk"].data >= min_count, dt.BOOL8, None),
+    )
+    hot_sales = ops.semi_join(sales, hot, ["item_sk"])
+    spend = ops.mul(hot_sales["quantity"], hot_sales["sales_price"])
+    t = Table([*hot_sales.columns, spend], [*hot_sales.names, "spend"])
+    return ops.groupby_aggregate(
+        t, ["customer_sk"], [GroupbyAgg("spend", "sum")]
+    )
+
+
+def q23_distributed(tables: dict, mesh, min_count: int = 4):
+    sales = tables["store_sales"]
+    # distributed frequent-item count (shuffle by item)
+    sales_padded = _pad_to_mesh(sales, mesh)
+    freq_padded, counts, _ = distributed_groupby(
+        sales_padded,
+        ["item_sk"],
+        [GroupbyAgg("item_sk", "count")],
+        mesh,
+        capacity=_full_shard_capacity(sales_padded, mesh),
+    )
+    # gather the (small) hot-item list to every chip, host-side finish
+    freq = _unpad_groupby(freq_padded, counts)
+    hot = ops.filter_table(
+        freq,
+        Column(freq["count_item_sk"].data >= min_count, dt.BOOL8, None),
+    )
+    hot_sales = ops.semi_join(sales, hot, ["item_sk"])
+    spend = ops.mul(hot_sales["quantity"], hot_sales["sales_price"])
+    t = Table([*hot_sales.columns, spend], [*hot_sales.names, "spend"])
+    # customer_sk is uniform (~rows/20 distinct): the balanced default
+    # capacity scales with the mesh instead of replicating the table
+    t_padded = _pad_to_mesh(t, mesh)
+    return distributed_groupby(
+        t_padded, ["customer_sk"], [GroupbyAgg("spend", "sum")], mesh
+    )
+
+
+# ---------------------------------------------------------------------------
+# q64-shape: chained dimension joins -> wide-key aggregation
+# ---------------------------------------------------------------------------
+
+def q64(tables: dict, max_price: float = 150.0) -> Table:
+    sales = tables["store_sales"]
+    item = tables["item"]
+    cheap = ops.filter_table(
+        item,
+        Column(
+            ops.compute.values(item["current_price"]) <= max_price,
+            dt.BOOL8,
+            None,
+        ),
+    )
+    j1 = ops.inner_join(sales, cheap, ["item_sk"])
+    j2 = ops.inner_join(j1, tables["customer"], ["customer_sk"])
+    j3 = ops.inner_join(j2, tables["date_dim"], ["date_sk"])
+    rev = ops.mul(j3["quantity"], j3["sales_price"])
+    t = Table([*j3.columns, rev], [*j3.names, "revenue"])
+    return ops.groupby_aggregate(
+        t,
+        ["brand_id", "state_id", "year"],
+        [GroupbyAgg("revenue", "sum"), GroupbyAgg("revenue", "count")],
+    )
+
+
+def q64_distributed(tables: dict, mesh, max_price: float = 150.0):
+    """Distributed q64: the big fact-fact-shaped join (sales x customer)
+    shuffles both sides; the small dimension joins replicate."""
+    sales = tables["store_sales"]
+    item = tables["item"]
+    cheap = ops.filter_table(
+        item,
+        Column(
+            ops.compute.values(item["current_price"]) <= max_price,
+            dt.BOOL8,
+            None,
+        ),
+    )
+    j1 = ops.inner_join(sales, cheap, ["item_sk"])
+    lpad = _pad_to_mesh(j1, mesh)
+    rpad = _pad_to_mesh(tables["customer"], mesh)
+    # customer_sk is unique on the right, so per-device join matches can
+    # never exceed the left rows received: out_capacity = one full left
+    # table per device is a provable bound (the 4x default over-allocates)
+    joined, counts, lov, rov = distributed_inner_join(
+        lpad,
+        rpad,
+        ["customer_sk"],
+        mesh,
+        out_capacity=lpad.row_count,
+    )
+    out = _unpad_join(joined, counts)
+    j3 = ops.inner_join(out, tables["date_dim"], ["date_sk"])
+    rev = ops.mul(j3["quantity"], j3["sales_price"])
+    t = Table([*j3.columns, rev], [*j3.names, "revenue"])
+    return ops.groupby_aggregate(
+        t,
+        ["brand_id", "state_id", "year"],
+        [GroupbyAgg("revenue", "sum"), GroupbyAgg("revenue", "count")],
+    )
+
+
+# ---------------------------------------------------------------------------
+# padding helpers (mesh sharding wants row_count % devices == 0; padding
+# rows carry a key no real row uses so they aggregate separately and are
+# dropped on unpad)
+# ---------------------------------------------------------------------------
+
+_PAD_KEY = np.int64(-(2**62))
+
+
+def _full_shard_capacity(padded: Table, mesh) -> int:
+    """Per-(src,dst) exchange capacity that can never overflow: one
+    device's whole local shard (the worst case when hash partitioning is
+    fully skewed to a single destination)."""
+    num = int(np.prod(list(mesh.shape.values())))
+    return max(padded.row_count // num, 1)
+
+
+def _pad_to_mesh(table: Table, mesh) -> Table:
+    num = int(np.prod(list(mesh.shape.values())))
+    n = table.row_count
+    rem = (-n) % num
+    if rem == 0:
+        return table
+    pad_cols = []
+    for c in table.columns:
+        if c.dtype.is_string:
+            raise TypeError("benchmark padding: fixed-width only")
+        fill_vals = jnp.full((rem,), _PAD_KEY).astype(c.data.dtype)
+        pad_cols.append(Column(fill_vals, c.dtype, None))
+    pad = Table(pad_cols, list(table.names))
+    return ops.concatenate([table, pad])
+
+
+def _unpad_groupby(padded: Table, counts) -> Table:
+    """Compact the sharded padded result: keep each device's first
+    count rows, drop padding groups (the _PAD_KEY key). Device-side
+    filter so storage encodings (FLOAT64 bit patterns) stay intact."""
+    cnt = jnp.asarray(counts).reshape(-1)
+    n_dev = cnt.shape[0]
+    per = padded.row_count // n_dev
+    slot = jnp.arange(padded.row_count, dtype=jnp.int32)
+    occupied = (slot % per) < cnt[slot // per]
+    real = padded.columns[0].data != jnp.asarray(
+        _PAD_KEY, padded.columns[0].data.dtype
+    )
+    mask = Column(jnp.logical_and(occupied, real), dt.BOOL8, None)
+    return ops.filter_table(padded, mask)
+
+
+def _unpad_join(padded: Table, counts) -> Table:
+    """Same shard-stacking for distributed join output."""
+    return _unpad_groupby(padded, counts)

@@ -1,0 +1,101 @@
+"""Benchmark runner: one JSON line per configuration.
+
+Usage:
+  python -m benchmarks.run [--rows N] [--devices D] [--configs 3,4]
+
+Config 3 (single-chip joins/queries) runs on the default device (the
+real TPU under the driver). Config 4 (distributed q5/q23/q64) needs a
+multi-device mesh — on a one-chip box, run with
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+to exercise the shuffle path; the numbers are then CPU-simulation
+numbers and are labeled as such.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+
+from . import datagen, queries
+
+
+def _time(fn, *args, repeats=1):
+    out = fn(*args)  # warmup/compile (eager queries cache per-shape)
+    jax.block_until_ready(jax.tree.leaves(out))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(jax.tree.leaves(out))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="mesh size for distributed configs (0 = skip)")
+    ap.add_argument("--configs", default="3")
+    ap.add_argument("--repeats", type=int, default=1)
+    args = ap.parse_args()
+    configs = {c.strip() for c in args.configs.split(",")}
+
+    # Platform forcing must happen after argparse (so abbreviations like
+    # --device work) but before anything touches the backend. Explicit
+    # "cpu": the env pins JAX_PLATFORMS to the TPU plugin and overrides
+    # don't stick (see tests/conftest.py), so on a one-chip box a
+    # multi-device run means the forced host platform.
+    if args.devices and "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        jax.config.update("jax_platforms", "cpu")
+
+    # Persistent compilation cache: the eager query DAGs compile dozens
+    # of per-shape executables; caching makes repeat runs start hot.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "SRT_COMPILE_CACHE", os.path.expanduser("~/.cache/srt-xla")
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    tables = datagen.generate(args.rows)
+    platform = jax.devices()[0].platform
+
+    if "3" in configs:
+        for name, fn in [("q5", queries.q5), ("q23", queries.q23),
+                         ("q64", queries.q64)]:
+            secs = _time(fn, tables, repeats=args.repeats)
+            print(json.dumps({
+                "config": 3, "query": name, "rows": args.rows,
+                "seconds": round(secs, 4),
+                "rows_per_sec": round(args.rows / secs),
+                "platform": platform,
+            }))
+
+    if "4" in configs and args.devices:
+        from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.devices)
+        for name, fn in [
+            ("q5", queries.q5_distributed),
+            ("q23", queries.q23_distributed),
+            ("q64", queries.q64_distributed),
+        ]:
+            secs = _time(fn, tables, mesh, repeats=args.repeats)
+            print(json.dumps({
+                "config": 4, "query": name, "rows": args.rows,
+                "devices": args.devices, "seconds": round(secs, 4),
+                "rows_per_sec": round(args.rows / secs),
+                "platform": platform,
+            }))
+
+
+if __name__ == "__main__":
+    main()
