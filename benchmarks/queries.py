@@ -183,16 +183,25 @@ def q64_distributed(tables: dict, mesh, max_price: float = 150.0):
     j1 = ops.inner_join(sales, cheap, ["item_sk"])
     lpad = _pad_to_mesh(j1, mesh)
     rpad = _pad_to_mesh(tables["customer"], mesh)
-    # customer_sk is unique on the right, so per-device join matches can
-    # never exceed the left rows received: out_capacity = one full left
-    # table per device is a provable bound (the 4x default over-allocates)
+    num = int(np.prod(list(mesh.shape.values())))
+    # customer_sk is unique on the right, so per-device real matches are
+    # bounded by the left rows received (<= lpad.row_count); pad rows
+    # share _PAD_KEY on both sides and cross-join on one device, adding
+    # at most (num-1)^2 pairs
     joined, counts, lov, rov = distributed_inner_join(
         lpad,
         rpad,
         ["customer_sk"],
         mesh,
-        out_capacity=lpad.row_count,
+        out_capacity=lpad.row_count + (num - 1) ** 2,
     )
+    # balanced default shuffle capacities can overflow on skewed data;
+    # dropped rows would silently corrupt the benchmark result
+    if int(np.asarray(lov).max()) > 0 or int(np.asarray(rov).max()) > 0:
+        raise RuntimeError(
+            "q64_distributed: shuffle overflow dropped rows; rerun with "
+            "explicit capacity"
+        )
     out = _unpad_join(joined, counts)
     j3 = ops.inner_join(out, tables["date_dim"], ["date_sk"])
     rev = ops.mul(j3["quantity"], j3["sales_price"])

@@ -44,6 +44,15 @@ def main():
     ap.add_argument("--repeats", type=int, default=1)
     args = ap.parse_args()
     configs = {c.strip() for c in args.configs.split(",")}
+    unknown = configs - {"3", "4"}
+    if unknown:
+        raise SystemExit(
+            f"unknown configs {sorted(unknown)}: this runner implements 3 "
+            "(single-chip) and 4 (distributed); config 5 is config 4 at "
+            "full scale on real hardware"
+        )
+    if "4" in configs and not args.devices:
+        raise SystemExit("--configs 4 needs --devices N")
 
     # Platform forcing must happen after argparse (so abbreviations like
     # --device work) but before anything touches the backend. Explicit

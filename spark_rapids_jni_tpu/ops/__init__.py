@@ -43,6 +43,15 @@ from .replace import (
 from .search import lower_bound, upper_bound, contains_column
 from .scan import scan
 from .compaction import distinct, distinct_capped, distinct_count
+from . import window
+from .window import (
+    rolling_aggregate,
+    grouped_rolling_aggregate,
+    lead,
+    lag,
+    row_number,
+)
+from .quantiles import quantile
 
 __all__ = [
     "compute",
@@ -99,4 +108,11 @@ __all__ = [
     "distinct",
     "distinct_capped",
     "distinct_count",
+    "window",
+    "rolling_aggregate",
+    "grouped_rolling_aggregate",
+    "lead",
+    "lag",
+    "row_number",
+    "quantile",
 ]

@@ -26,7 +26,7 @@ from . import compute
 from . import keys as keys_mod
 from .gather import gather_table
 
-_AGG_OPS = {"sum", "count", "min", "max", "mean"}
+_AGG_OPS = {"sum", "count", "min", "max", "mean", "variance", "std"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,6 +126,28 @@ def _aggregate_segment(
                 total, dt.DType(dt.TypeId.DECIMAL64, col.dtype.scale), has
             )
         return compute.from_values(total, dt.INT64, has)
+
+    if op in ("variance", "std"):
+        # two-pass: segment mean, gather back to rows, segment-sum of
+        # squared deviations (the mean-subtracting formula; the naive
+        # E[x^2]-E[x]^2 shortcut catastrophically cancels for
+        # large-magnitude values). Sample variance, ddof=1; groups with
+        # fewer than 2 valid rows are null.
+        fvals = vals.astype(jnp.float64)
+        if col.dtype.is_decimal:
+            fvals = fvals * (10.0 ** col.dtype.scale)
+        nf = n_valid.astype(jnp.float64)
+        s1 = jax.ops.segment_sum(
+            jnp.where(valid, fvals, 0.0), seg, num_segments=num_segments
+        )
+        mean = s1 / jnp.maximum(nf, 1)
+        dev = fvals - mean[seg]
+        sq = jax.ops.segment_sum(
+            jnp.where(valid, dev * dev, 0.0), seg, num_segments=num_segments
+        )
+        var = sq / jnp.maximum(nf - 1, 1)
+        out = jnp.sqrt(var) if op == "std" else var
+        return compute.from_values(out, dt.FLOAT64, n_valid > 1)
 
     # min / max via masked sentinels
     if col.dtype.is_floating:

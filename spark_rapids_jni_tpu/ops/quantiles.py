@@ -1,0 +1,63 @@
+"""Quantiles (cudf ``quantile``: LINEAR / LOWER / HIGHER / MIDPOINT /
+NEAREST interpolation, null-excluding).
+
+Capability-surface row of SURVEY.md §2.3 (cudf Java suite covers
+ColumnVector.quantile). One device sort with nulls exiled past the end,
+then index arithmetic against the device-resident valid count — fully
+jittable, no host sync for the n_valid-dependent positions.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .. import dtype as dt
+from ..column import Column
+from . import compute
+
+LINEAR = "linear"
+LOWER = "lower"
+HIGHER = "higher"
+MIDPOINT = "midpoint"
+NEAREST = "nearest"
+
+
+def quantile(
+    col: Column, qs: Sequence[float], interpolation: str = LINEAR
+) -> Column:
+    """FLOAT64 column of one quantile per entry of ``qs`` (null when the
+    input has no valid rows)."""
+    if interpolation not in (LINEAR, LOWER, HIGHER, MIDPOINT, NEAREST):
+        raise ValueError(f"unknown interpolation {interpolation!r}")
+    if not (col.dtype.is_numeric or col.dtype.is_timestamp):
+        raise TypeError(f"quantile: numeric input required, got {col.dtype}")
+    n = len(col)
+    vals = compute.values(col).astype(jnp.float64)
+    if col.dtype.is_decimal:
+        vals = vals * (10.0 ** col.dtype.scale)
+    valid = compute.valid_mask(col)
+    # nulls sort past every real value; n_valid bounds the index range
+    sorted_vals = jnp.sort(jnp.where(valid, vals, jnp.inf))
+    n_valid = jnp.sum(valid).astype(jnp.float64)
+
+    q = jnp.asarray(list(qs), jnp.float64)
+    pos = q * jnp.maximum(n_valid - 1, 0)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, max(n - 1, 0))
+    hi = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, max(n - 1, 0))
+    vlo = sorted_vals[lo]
+    vhi = sorted_vals[hi]
+    if interpolation == LINEAR:
+        frac = pos - jnp.floor(pos)
+        out = vlo + (vhi - vlo) * frac
+    elif interpolation == LOWER:
+        out = vlo
+    elif interpolation == HIGHER:
+        out = vhi
+    elif interpolation == MIDPOINT:
+        out = (vlo + vhi) * 0.5
+    else:  # NEAREST
+        out = jnp.where(pos - jnp.floor(pos) <= 0.5, vlo, vhi)
+    has = jnp.broadcast_to(n_valid > 0, out.shape)
+    return compute.from_values(out, dt.FLOAT64, has)

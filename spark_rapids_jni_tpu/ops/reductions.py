@@ -13,7 +13,10 @@ from .. import dtype as dt
 from ..column import Column
 from . import compute
 
-_REDUCTIONS = {"sum", "min", "max", "mean", "count", "any", "all", "product"}
+_REDUCTIONS = {
+    "sum", "min", "max", "mean", "count", "any", "all", "product",
+    "variance", "std",
+}
 
 
 def reduce(col: Column, op: str) -> Column:
@@ -58,6 +61,18 @@ def reduce(col: Column, op: str) -> Column:
             out_dt = dt.DType(dt.TypeId.DECIMAL64, col.dtype.scale)
             return compute.from_values(total[None], out_dt, has_result)
         return compute.from_values(total[None], dt.INT64, has_result)
+
+    if op in ("variance", "std"):
+        # sample variance (ddof=1), cudf/Spark default; null when fewer
+        # than 2 valid rows
+        fvals = vals.astype(jnp.float64)
+        if col.dtype.is_decimal:
+            fvals = fvals * (10.0 ** col.dtype.scale)
+        m = jnp.sum(jnp.where(valid, fvals, 0)) / jnp.maximum(n_valid, 1)
+        sq = jnp.sum(jnp.where(valid, (fvals - m) ** 2, 0))
+        var = sq / jnp.maximum(n_valid - 1, 1)
+        out = jnp.sqrt(var) if op == "std" else var
+        return compute.from_values(out[None], dt.FLOAT64, (n_valid > 1)[None])
 
     if op == "product":
         acc = jnp.where(valid, vals, 1)

@@ -1,0 +1,300 @@
+"""Window functions (cudf ``rolling_window`` / grouped windows, Spark
+WindowExec): rolling aggregates over row-based frames, lead/lag,
+row_number — with or without PARTITION BY.
+
+Capability-surface row of SURVEY.md §2.3 (cudf's Java WindowTest
+family). TPU formulation: no per-row loops — SUM/COUNT/MEAN windows are
+prefix-sum differences, MIN/MAX windows combine two overlapping
+power-of-two block minima from a sparse table (O(n log n) build, O(1)
+per row), and partition clamping is just index arithmetic on the
+sorted-by-(partition, order) layout. Everything jits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column, Table
+from . import compute
+from .keys import column_order_keys
+
+_SUMLIKE = {"sum", "count", "mean"}
+_MINMAX = {"min", "max"}
+
+
+def _window_bounds(n, preceding: int, following: int, part_start, part_end):
+    """Per-row [start, end) frame, clamped to the partition."""
+    i = jnp.arange(n, dtype=jnp.int32)
+    start = jnp.maximum(i - preceding, part_start)
+    end = jnp.minimum(i + following + 1, part_end)
+    return start, jnp.maximum(end, start)
+
+
+def _prefix_window(vals, valid, start, end, agg):
+    """SUM/COUNT/MEAN via exclusive prefix sums over masked values."""
+    acc = jnp.where(valid, vals, 0).astype(
+        jnp.float64 if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.int64
+    )
+    cs = jnp.concatenate([jnp.zeros((1,), acc.dtype), jnp.cumsum(acc)])
+    cnt = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(valid.astype(jnp.int64))]
+    )
+    wsum = cs[end] - cs[start]
+    wcnt = cnt[end] - cnt[start]
+    if agg == "count":
+        return wcnt, wcnt >= 0
+    if agg == "sum":
+        return wsum, wcnt > 0
+    return wsum.astype(jnp.float64) / jnp.maximum(wcnt, 1), wcnt > 0
+
+
+def _minmax_window(col: Column, start, end, op):
+    """MIN/MAX over [start, end) via two overlapping blocks of a sparse
+    table of winner positions, on order keys (exact for every supported
+    dtype incl. f64 bit patterns). Nulls take an exiled key so they only
+    win all-null frames; key ties between a null and a legitimate
+    extreme value (INT64_MAX has the same key as the min-exile) break
+    toward the VALID row, so the winner's validity decides the output."""
+    n = len(col)
+    keys = column_order_keys(col)
+    if len(keys) != 1:
+        raise TypeError("window min/max: fixed-width columns only")
+    key = keys[0]
+    valid = compute.valid_mask(col)
+    exile = (
+        jnp.uint64(0xFFFFFFFFFFFFFFFF) if op == "min" else jnp.uint64(0)
+    )
+    key = jnp.where(valid, key, exile)
+    length = jnp.maximum(end - start, 1)
+    k = jnp.floor(jnp.log2(length.astype(jnp.float64))).astype(jnp.int32)
+    # frame [start, end) = block [start, start+2^k) ∪ [end-2^k, end)
+    pos_table = _sparse_table_pos(key, valid, op)
+    k = jnp.clip(k, 0, pos_table.shape[0] - 1)
+    second = jnp.maximum(end - jnp.left_shift(1, k), start)
+    pl = pos_table[k, start]
+    pr = pos_table[k, second]
+    kl, vl = key[pl], valid[pl]
+    kr, vr = key[pr], valid[pr]
+    if op == "min":
+        take_left = (kl < kr) | ((kl == kr) & (vl | ~vr))
+    else:
+        take_left = (kl > kr) | ((kl == kr) & (vl | ~vr))
+    pos = jnp.where(take_left, pl, pr)
+    # the winner is null only when the whole frame is null (or empty)
+    return pos, valid[pos] & (end > start)
+
+
+def _sparse_table_pos(keys, valid, op):
+    """(K, n) table of the index attaining the op over [i, i+2^k),
+    with key ties broken toward valid rows (see _minmax_window)."""
+    n = keys.shape[0]
+    pad_val = (
+        jnp.uint64(0xFFFFFFFFFFFFFFFF) if op == "min" else jnp.uint64(0)
+    )
+
+    def better(ak, av, bk, bv):
+        if op == "min":
+            return (ak < bk) | ((ak == bk) & (av | ~bv))
+        return (ak > bk) | ((ak == bk) & (av | ~bv))
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    levels_k = [keys]
+    levels_v = [valid]
+    levels_p = [idx]
+    k = 1
+    while k < n:
+        pk, pv, pp = levels_k[-1], levels_v[-1], levels_p[-1]
+        pad_n = min(k, n)
+        sk = jnp.concatenate([pk[k:], jnp.full((pad_n,), pad_val, pk.dtype)])
+        sv = jnp.concatenate([pv[k:], jnp.zeros((pad_n,), jnp.bool_)])
+        sp = jnp.concatenate([pp[k:], pp[:pad_n]])
+        keep = better(pk, pv, sk, sv)
+        levels_k.append(jnp.where(keep, pk, sk))
+        levels_v.append(jnp.where(keep, pv, sv))
+        levels_p.append(jnp.where(keep, pp, sp))
+        k *= 2
+    return jnp.stack(levels_p)
+
+
+def rolling_aggregate(
+    col: Column,
+    preceding: int,
+    following: int,
+    agg: str,
+    min_periods: int = 1,
+    partition_starts: Optional[jax.Array] = None,
+    partition_ends: Optional[jax.Array] = None,
+) -> Column:
+    """Row-based rolling window over the column's current order.
+
+    ``preceding``/``following`` are row counts either side of the current
+    row (cudf rolling_window semantics). Rows whose frame holds fewer
+    than ``min_periods`` valid values are null.
+    """
+    n = len(col)
+    ps = (
+        partition_starts
+        if partition_starts is not None
+        else jnp.zeros((n,), jnp.int32)
+    )
+    pe = (
+        partition_ends
+        if partition_ends is not None
+        else jnp.full((n,), n, jnp.int32)
+    )
+    start, end = _window_bounds(n, preceding, following, ps, pe)
+    valid = compute.valid_mask(col)
+
+    if agg in _SUMLIKE:
+        vals = compute.values(col)
+        out, has = _prefix_window(vals, valid, start, end, agg)
+        cnt = _prefix_window(vals, valid, start, end, "count")[0]
+        ok = jnp.logical_and(has, cnt >= min_periods)
+        if agg == "count":
+            return Column(out.astype(jnp.int32), dt.INT32, ok)
+        if agg == "mean":
+            if col.dtype.is_decimal:
+                # unscaled ints -> logical values (the groupby/reduce
+                # mean convention, groupby.py mean branch)
+                out = out * (10.0 ** col.dtype.scale)
+            return compute.from_values(out, dt.FLOAT64, ok)
+        if col.dtype.is_floating:
+            return compute.from_values(out, dt.FLOAT64, ok)
+        out_dt = (
+            dt.DType(dt.TypeId.DECIMAL64, col.dtype.scale)
+            if col.dtype.is_decimal
+            else dt.INT64
+        )
+        return compute.from_values(out, out_dt, ok)
+
+    if agg in _MINMAX:
+        pos, has = _minmax_window(col, start, end, agg)
+        cnt = _prefix_window(
+            jnp.zeros((n,)), valid, start, end, "count"
+        )[0]
+        ok = jnp.logical_and(has, cnt >= min_periods)
+        return Column(jnp.take(col.data, pos, axis=0), col.dtype, ok)
+
+    raise ValueError(f"unknown window aggregation {agg!r}")
+
+
+def _partition_bounds(table: Table, partition_by: Sequence):
+    """(starts, ends) per row for a table sorted by the partition keys."""
+    n = table.row_count
+    words = []
+    for c in (table.column(k) for k in partition_by):
+        cwords = column_order_keys(c)
+        if c.validity is not None:
+            cwords = [jnp.where(c.validity, w, jnp.uint64(0)) for w in cwords]
+            cwords.append(c.validity.astype(jnp.uint64))
+        words.extend(cwords)
+    new_part = jnp.zeros((n,), jnp.bool_)
+    for w in words:
+        new_part = jnp.logical_or(
+            new_part,
+            jnp.concatenate([jnp.ones((1,), jnp.bool_), w[1:] != w[:-1]]),
+        )
+    idx = jnp.arange(n, dtype=jnp.int32)
+    starts = jax.lax.cummax(jnp.where(new_part, idx, 0))
+    # ends: next partition start (reverse cummin of starts-after)
+    next_start = jnp.concatenate(
+        [jnp.where(new_part, idx, n + 1)[1:], jnp.full((1,), n, jnp.int32)]
+    )
+    rev = jax.lax.cummin(next_start[::-1])[::-1]
+    ends = jnp.minimum(rev, n)
+    return starts, ends
+
+
+def grouped_rolling_aggregate(
+    table: Table,
+    partition_by: Sequence,
+    order_by: Sequence,
+    value: Union[int, str],
+    preceding: int,
+    following: int,
+    agg: str,
+    min_periods: int = 1,
+) -> Column:
+    """PARTITION BY + ORDER BY rolling window; result aligned to the
+    table's ORIGINAL row order (Spark WindowExec contract)."""
+    from .sort import SortKey, argsort_table
+
+    n = table.row_count
+    keys = [SortKey(k) for k in [*partition_by, *order_by]]
+    perm = argsort_table(table, keys)
+    from .gather import gather_table
+
+    sorted_t = gather_table(table, perm)
+    starts, ends = _partition_bounds(sorted_t, partition_by)
+    out_sorted = rolling_aggregate(
+        sorted_t.column(value),
+        preceding,
+        following,
+        agg,
+        min_periods,
+        partition_starts=starts,
+        partition_ends=ends,
+    )
+    # scatter back to original order
+    inv = jnp.zeros((n,), jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))
+    from .gather import gather_column
+
+    return gather_column(out_sorted, inv)
+
+
+def lead(col: Column, n: int = 1, partition_ids=None) -> Column:
+    """Value ``n`` rows ahead; null past the end (Spark LEAD)."""
+    return _shift(col, -n, partition_ids)
+
+
+def lag(col: Column, n: int = 1, partition_ids=None) -> Column:
+    """Value ``n`` rows behind; null before the start (Spark LAG)."""
+    return _shift(col, n, partition_ids)
+
+
+def _shift(col: Column, n: int, partition_ids) -> Column:
+    size = len(col)
+    idx = jnp.arange(size, dtype=jnp.int32) - n
+    in_range = jnp.logical_and(idx >= 0, idx < size)
+    safe = jnp.clip(idx, 0, size - 1)
+    if partition_ids is not None:
+        same = partition_ids[safe] == partition_ids
+        in_range = jnp.logical_and(in_range, same)
+    data = jnp.take(col.data, safe, axis=0)
+    valid = (
+        in_range
+        if col.validity is None
+        else jnp.logical_and(in_range, jnp.take(col.validity, safe))
+    )
+    lengths = (
+        None if col.lengths is None else jnp.take(col.lengths, safe)
+    )
+    return Column(data, col.dtype, valid, lengths)
+
+
+def row_number(
+    table: Table, partition_by: Sequence, order_by: Sequence
+) -> Column:
+    """1-based rank within each partition, in the table's original row
+    order (Spark ROW_NUMBER)."""
+    from .gather import gather_column
+    from .sort import SortKey, argsort_table
+
+    n = table.row_count
+    perm = argsort_table(
+        table, [SortKey(k) for k in [*partition_by, *order_by]]
+    )
+    from .gather import gather_table
+
+    sorted_t = gather_table(table, perm)
+    starts, _ = _partition_bounds(sorted_t, partition_by)
+    rn_sorted = jnp.arange(n, dtype=jnp.int32) - starts + 1
+    inv = jnp.zeros((n,), jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))
+    return gather_column(
+        Column(rn_sorted, dt.INT32, None), inv
+    )

@@ -1,0 +1,204 @@
+"""Window / quantile / variance tests — validated against pure-python
+sliding-window oracles and numpy statistics."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import ops
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+
+
+def _oracle_window(values, valid, preceding, following, agg, min_periods=1):
+    n = len(values)
+    out = []
+    for i in range(n):
+        lo = max(i - preceding, 0)
+        hi = min(i + following + 1, n)
+        frame = [values[j] for j in range(lo, hi) if valid[j]]
+        if len(frame) < min_periods or not frame:
+            out.append(None)
+        elif agg == "sum":
+            out.append(sum(frame))
+        elif agg == "count":
+            out.append(len(frame))
+        elif agg == "mean":
+            out.append(sum(frame) / len(frame))
+        elif agg == "min":
+            out.append(min(frame))
+        elif agg == "max":
+            out.append(max(frame))
+    return out
+
+
+class TestRolling:
+    @pytest.mark.parametrize("agg", ["sum", "count", "mean", "min", "max"])
+    def test_vs_oracle(self, agg):
+        rng = np.random.default_rng(5)
+        vals = rng.integers(-50, 50, 64).astype(np.int64)
+        valid = rng.random(64) > 0.2
+        col = Column.from_numpy(vals, validity=valid)
+        got = ops.rolling_aggregate(col, 3, 1, agg).to_pylist()
+        want = _oracle_window(list(vals), list(valid), 3, 1, agg)
+        if agg == "mean":
+            for g, w in zip(got, want):
+                assert (g is None) == (w is None)
+                if w is not None:
+                    assert g == pytest.approx(w)
+        else:
+            assert got == want
+
+    def test_min_periods(self):
+        col = Column.from_numpy(np.arange(5, dtype=np.int64))
+        got = ops.rolling_aggregate(col, 2, 0, "sum", min_periods=3)
+        assert got.to_pylist() == [None, None, 3, 6, 9]
+
+    def test_float_window(self):
+        col = Column.from_numpy(np.array([1.5, -2.5, 4.0], np.float64))
+        got = ops.rolling_aggregate(col, 1, 0, "max").to_pylist()
+        assert got == [1.5, 1.5, 4.0]
+
+    def test_extreme_values_not_nulled(self):
+        # INT64_MAX shares its order key with the min-exile sentinel;
+        # the winner must still surface as a valid value
+        m = np.iinfo(np.int64)
+        col = Column.from_numpy(
+            np.array([m.max, m.max], np.int64),
+            validity=np.array([True, False]),
+        )
+        assert ops.rolling_aggregate(col, 1, 0, "min").to_pylist() == [
+            m.max, m.max,
+        ]
+        col2 = Column.from_numpy(
+            np.array([m.min, m.min], np.int64),
+            validity=np.array([True, False]),
+        )
+        assert ops.rolling_aggregate(col2, 1, 0, "max").to_pylist() == [
+            m.min, m.min,
+        ]
+
+    def test_large_window_min(self):
+        rng = np.random.default_rng(9)
+        vals = rng.standard_normal(300)
+        col = Column.from_numpy(vals)
+        got = ops.rolling_aggregate(col, 100, 50, "min").to_pylist()
+        want = _oracle_window(list(vals), [True] * 300, 100, 50, "min")
+        np.testing.assert_allclose(got, want)
+
+
+class TestGroupedWindow:
+    def test_partitioned_sum_matches_python(self):
+        rng = np.random.default_rng(2)
+        part = rng.integers(0, 4, 50)
+        order = rng.permutation(50)
+        vals = rng.integers(0, 100, 50).astype(np.int64)
+        t = Table.from_pydict({"p": part, "o": order, "v": vals})
+        got = ops.grouped_rolling_aggregate(
+            t, ["p"], ["o"], "v", preceding=2, following=0, agg="sum"
+        ).to_pylist()
+        # python oracle: per partition, ordered by o, window sum over
+        # up-to-3 trailing rows; result in original row order
+        want = [None] * 50
+        for p in set(part):
+            rows = sorted(
+                [i for i in range(50) if part[i] == p], key=lambda i: order[i]
+            )
+            for j, i in enumerate(rows):
+                frame = rows[max(j - 2, 0) : j + 1]
+                want[i] = int(sum(vals[k] for k in frame))
+        assert got == want
+
+    def test_lead_lag(self):
+        col = Column.from_numpy(np.array([10, 20, 30], np.int64))
+        assert ops.lead(col).to_pylist() == [20, 30, None]
+        assert ops.lag(col).to_pylist() == [None, 10, 20]
+
+    def test_lag_partitioned(self):
+        col = Column.from_numpy(np.array([1, 2, 3, 4], np.int64))
+        pids = np.array([0, 0, 1, 1])
+        assert ops.lag(col, 1, pids).to_pylist() == [None, 1, None, 3]
+
+    def test_row_number(self):
+        t = Table.from_pydict({"p": [1, 0, 1, 0, 1], "o": [5, 3, 1, 9, 2]})
+        got = ops.row_number(t, ["p"], ["o"]).to_pylist()
+        # partition 0 rows (idx 1,3): o=3 -> 1, o=9 -> 2
+        # partition 1 rows (idx 0,2,4): o=5 -> 3, o=1 -> 1, o=2 -> 2
+        assert got == [3, 1, 1, 2, 2]
+
+
+class TestQuantile:
+    def test_linear_matches_numpy(self):
+        rng = np.random.default_rng(4)
+        vals = rng.standard_normal(101)
+        col = Column.from_numpy(vals)
+        qs = [0.0, 0.25, 0.5, 0.75, 1.0]
+        got = ops.quantile(col, qs).to_pylist()
+        want = np.quantile(vals, qs)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    @pytest.mark.parametrize(
+        "interp,npinterp",
+        [("lower", "lower"), ("higher", "higher"),
+         ("midpoint", "midpoint"), ("nearest", "nearest")],
+    )
+    def test_interpolations(self, interp, npinterp):
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        col = Column.from_numpy(vals)
+        got = ops.quantile(col, [0.4], interp).to_pylist()
+        want = np.quantile(vals, [0.4], method=npinterp)
+        np.testing.assert_allclose(got, want)
+
+    def test_nulls_excluded(self):
+        col = Column.from_numpy(
+            np.array([1.0, 100.0, 3.0]), validity=np.array([True, False, True])
+        )
+        got = ops.quantile(col, [1.0]).to_pylist()
+        assert got == [3.0]
+
+    def test_all_null_gives_null(self):
+        col = Column.from_numpy(
+            np.array([1.0]), validity=np.array([False])
+        )
+        assert ops.quantile(col, [0.5]).to_pylist() == [None]
+
+
+class TestVariance:
+    def test_reduce_var_std(self):
+        vals = np.array([1.0, 4.0, 9.0, 16.0])
+        col = Column.from_numpy(vals)
+        assert ops.reduce_column(col, "variance").to_pylist()[0] == pytest.approx(
+            np.var(vals, ddof=1)
+        )
+        assert ops.reduce_column(col, "std").to_pylist()[0] == pytest.approx(
+            np.std(vals, ddof=1)
+        )
+
+    def test_groupby_variance_large_magnitude(self):
+        # mean-subtracting formula: exact where E[x^2]-E[x]^2 cancels
+        v = np.array([1e9, 1e9 + 1, 1e9 + 2])
+        t = Table.from_pydict({"k": np.zeros(3, np.int64), "v": v})
+        got = ops.groupby_aggregate(t, ["k"], [GroupbyAgg("v", "variance")])
+        assert got["variance_v"].to_pylist()[0] == pytest.approx(1.0)
+
+    def test_groupby_variance(self):
+        k = np.array([0, 0, 0, 1, 1, 2])
+        v = np.array([1.0, 2.0, 4.0, 10.0, 30.0, 5.0])
+        t = Table.from_pydict({"k": k, "v": v})
+        got = ops.groupby_aggregate(
+            t, ["k"], [GroupbyAgg("v", "variance"), GroupbyAgg("v", "std")]
+        )
+        gk = got["k"].to_pylist()
+        gv = got["variance_v"].to_pylist()
+        gs = got["std_v"].to_pylist()
+        want = {
+            0: np.var([1.0, 2.0, 4.0], ddof=1),
+            1: np.var([10.0, 30.0], ddof=1),
+            2: None,  # single row -> null sample variance
+        }
+        for kk, vv, ss in zip(gk, gv, gs):
+            if want[kk] is None:
+                assert vv is None and ss is None
+            else:
+                assert vv == pytest.approx(want[kk])
+                assert ss == pytest.approx(np.sqrt(want[kk]))
