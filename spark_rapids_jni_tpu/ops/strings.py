@@ -60,24 +60,35 @@ def _literal_bytes(pat: str | bytes) -> np.ndarray:
     return np.frombuffer(pat, dtype=np.uint8)
 
 
-def contains(col: Column, pattern: str | bytes) -> Column:
-    """Literal substring search (Spark ``contains``), via a sliding
-    window compare — static pad width makes this a fixed unrolled scan."""
-    _require_string(col)
-    pat = _literal_bytes(pattern)
+def _window_matches(col: Column, pat: np.ndarray) -> list[jax.Array]:
+    """match[start] = (n,) bool: the literal ``pat`` occurs at byte
+    ``start`` fully inside the string. The one sliding-window scan that
+    contains/find/replace all build on — static pad width makes it a
+    fixed unrolled compare."""
     m = len(pat)
     n, pad = col.data.shape
-    if m == 0:
-        return Column(jnp.ones((n,), jnp.bool_), dt.BOOL8, col.validity)
-    if m > pad:
-        return Column(jnp.zeros((n,), jnp.bool_), dt.BOOL8, col.validity)
-    mat = col.data
     patv = jnp.asarray(pat)
-    found = jnp.zeros((n,), dtype=jnp.bool_)
+    out = []
     for start in range(pad - m + 1):
-        window_eq = jnp.all(mat[:, start : start + m] == patv[None, :], axis=1)
-        in_len = col.lengths >= start + m
-        found = found | (window_eq & in_len)
+        window_eq = jnp.all(
+            col.data[:, start : start + m] == patv[None, :], axis=1
+        )
+        out.append(window_eq & (col.lengths >= start + m))
+    return out
+
+
+def contains(col: Column, pattern: str | bytes) -> Column:
+    """Literal substring search (Spark ``contains``)."""
+    _require_string(col)
+    pat = _literal_bytes(pattern)
+    n, pad = col.data.shape
+    if len(pat) == 0:
+        return Column(jnp.ones((n,), jnp.bool_), dt.BOOL8, col.validity)
+    if len(pat) > pad:
+        return Column(jnp.zeros((n,), jnp.bool_), dt.BOOL8, col.validity)
+    found = jnp.zeros((n,), dtype=jnp.bool_)
+    for hit in _window_matches(col, pat):
+        found = found | hit
     return Column(found, dt.BOOL8, col.validity)
 
 
@@ -201,3 +212,197 @@ def cast(col: Column, to: dt.DType) -> Column:
     raise NotImplementedError(
         "string casts land with the format/parse phase"
     )
+
+
+def _shift_left(col: Column, shift: jax.Array, new_len: jax.Array) -> Column:
+    """Row-wise left shift by a per-row amount, zeroing past new_len."""
+    n, pad = col.data.shape
+    j = jnp.arange(pad)[None, :]
+    src = jnp.clip(j + shift[:, None], 0, pad - 1)
+    out = jnp.take_along_axis(col.data, src, axis=1)
+    out = jnp.where(j < new_len[:, None], out, 0).astype(jnp.uint8)
+    return Column(out, dt.STRING, col.validity, new_len.astype(jnp.int32))
+
+
+def _strip_counts(col: Column, chars: bytes, from_left: bool):
+    """Count of strip-set bytes at the left (or right) edge of each row."""
+    n, pad = col.data.shape
+    in_set = jnp.zeros((n, pad), dtype=jnp.bool_)
+    for ch in chars:
+        in_set = in_set | (col.data == ch)
+    j = jnp.arange(pad)[None, :]
+    in_str = j < col.lengths[:, None]
+    if from_left:
+        # leading run length: first position that is in-string and not
+        # in the strip set
+        boundary = in_str & ~in_set
+        has = jnp.any(boundary, axis=1)
+        first = jnp.argmax(boundary, axis=1)
+        return jnp.where(has, first, col.lengths)
+    # trailing run: scan from the right
+    boundary = in_str & ~in_set
+    has = jnp.any(boundary, axis=1)
+    last = pad - 1 - jnp.argmax(boundary[:, ::-1], axis=1)
+    return jnp.where(has, col.lengths - last - 1, col.lengths)
+
+
+def strip(col: Column, chars: str | bytes = b" ") -> Column:
+    """Trim the byte set from both ends. Default trims only the space
+    byte — Spark ``trim`` semantics (pass explicit chars for python-str
+    whitespace stripping)."""
+    _require_string(col)
+    cset = chars.encode() if isinstance(chars, str) else bytes(chars)
+    left = _strip_counts(col, cset, True)
+    right = _strip_counts(col, cset, False)
+    new_len = jnp.maximum(col.lengths - left - right, 0)
+    return _shift_left(col, left, new_len)
+
+
+def lstrip(col: Column, chars: str | bytes = b" ") -> Column:
+    """Spark ``ltrim`` (space-only default)."""
+    _require_string(col)
+    cset = chars.encode() if isinstance(chars, str) else bytes(chars)
+    left = _strip_counts(col, cset, True)
+    return _shift_left(col, left, col.lengths - left)
+
+
+def rstrip(col: Column, chars: str | bytes = b" ") -> Column:
+    """Spark ``rtrim`` (space-only default)."""
+    _require_string(col)
+    cset = chars.encode() if isinstance(chars, str) else bytes(chars)
+    right = _strip_counts(col, cset, False)
+    new_len = col.lengths - right
+    return _shift_left(col, jnp.zeros_like(col.lengths), new_len)
+
+
+def find(col: Column, pattern: str | bytes) -> Column:
+    """First byte index of the literal pattern, -1 if absent (Spark
+    ``instr`` is this + 1)."""
+    _require_string(col)
+    pat = _literal_bytes(pattern)
+    m = len(pat)
+    n, pad_w = col.data.shape
+    if m == 0:
+        return Column(jnp.zeros((n,), jnp.int32), dt.INT32, col.validity)
+    pos = jnp.full((n,), -1, dtype=jnp.int32)
+    if m <= pad_w:
+        matches = _window_matches(col, pat)
+        for start in range(len(matches) - 1, -1, -1):  # right-to-left keeps first
+            pos = jnp.where(matches[start], start, pos)
+    return Column(pos, dt.INT32, col.validity)
+
+
+def pad(col: Column, width: int, side: str = "right", fill: str = " ") -> Column:
+    """Spark ``lpad``/``rpad``: result is EXACTLY ``width`` bytes — padded
+    with the (possibly multi-byte) ``fill`` pattern when shorter,
+    truncated to the leading ``width`` bytes when longer."""
+    _require_string(col)
+    fill_b = _literal_bytes(fill)
+    if len(fill_b) == 0:
+        raise ValueError("pad: fill must be non-empty")
+    if side not in ("left", "right"):
+        raise ValueError("side must be 'left' or 'right'")
+    n, old = col.data.shape
+    c = repad(col, max(old, width))
+    out_pad = c.data.shape[1]
+    j = jnp.arange(out_pad)[None, :]
+    fillv = jnp.asarray(fill_b)
+    m = len(fill_b)
+    s_len = jnp.minimum(c.lengths, width)  # truncation bound
+    if side == "right":
+        fill_idx = (j - c.lengths[:, None]) % m
+        data = jnp.where(
+            j < s_len[:, None], c.data, fillv[fill_idx]
+        )
+    else:
+        shift = jnp.maximum(width - c.lengths, 0)
+        src = jnp.clip(j - shift[:, None], 0, out_pad - 1)
+        moved = jnp.take_along_axis(c.data, src, axis=1)
+        data = jnp.where(j < shift[:, None], fillv[j % m], moved)
+    new_len = jnp.full((n,), width, jnp.int32)
+    data = jnp.where(j < new_len[:, None], data, 0)
+    out = Column(
+        data.astype(jnp.uint8), dt.STRING, c.validity, new_len
+    )
+    return repad(out, max(width, 1))
+
+
+def replace(col: Column, old: str | bytes, new: str | bytes) -> Column:
+    """Literal, non-overlapping, leftmost-first replacement (Spark
+    ``replace``). Equal-width substitutions stay fully on device; width-
+    changing substitutions rebuild the column (eager host path, the cudf
+    call model)."""
+    _require_string(col)
+    old_b = _literal_bytes(old)
+    new_b = _literal_bytes(new)
+    m = len(old_b)
+    if m == 0:
+        return col
+    n, pad_w = col.data.shape
+    if len(new_b) == m and m <= pad_w:
+        # device path: greedy non-overlapping match selection, then an
+        # unrolled masked substitution of one rolled pattern row
+        match = _window_matches(col, old_b)
+        base_row = jnp.zeros((pad_w,), jnp.uint8).at[:m].set(
+            jnp.asarray(new_b)
+        )
+        j = jnp.arange(pad_w)[None, :]
+        data = col.data
+        next_free = jnp.zeros((n,), jnp.int32)
+        for start in range(pad_w - m + 1):
+            sel = match[start] & (next_free <= start)
+            in_window = (j >= start) & (j < start + m)
+            data = jnp.where(
+                sel[:, None] & in_window,
+                jnp.roll(base_row, start)[None, :],
+                data,
+            )
+            next_free = jnp.where(sel, start + m, next_free)
+        return Column(data.astype(jnp.uint8), dt.STRING, col.validity, col.lengths)
+    # host path for width-changing substitutions
+    out = [
+        None if v is None else v.replace(
+            old if isinstance(old, str) else old.decode("utf-8", "surrogateescape"),
+            new if isinstance(new, str) else new.decode("utf-8", "surrogateescape"),
+        )
+        for v in col.to_pylist()
+    ]
+    return Column.from_strings(out)
+
+
+def split_get(col: Column, delimiter: str | bytes, index: int) -> Column:
+    """k-th field after splitting on a single-byte delimiter (Spark
+    ``split_part`` with 0-based index); empty string when out of range."""
+    _require_string(col)
+    d = _literal_bytes(delimiter)
+    if len(d) != 1:
+        raise ValueError("split_get: single-byte delimiter only")
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < col.lengths[:, None]
+    is_delim = (col.data == d[0]) & in_str
+    # field id of each byte = number of delimiters before it
+    field = jnp.cumsum(is_delim.astype(jnp.int32), axis=1) - is_delim.astype(
+        jnp.int32
+    )
+    keep = in_str & ~is_delim & (field == index)
+    tok_len = jnp.sum(keep, axis=1)
+    # start = first kept position (or 0)
+    has = jnp.any(keep, axis=1)
+    start = jnp.where(has, jnp.argmax(keep, axis=1), 0)
+    return _shift_left(
+        Column(col.data, dt.STRING, col.validity, col.lengths),
+        start.astype(jnp.int32),
+        tok_len.astype(jnp.int32),
+    )
+
+
+def reverse(col: Column) -> Column:
+    """Byte-wise reversal (Spark ``reverse``; char-exact for ASCII)."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    src = jnp.clip(col.lengths[:, None] - 1 - j, 0, pad_w - 1)
+    out = jnp.take_along_axis(col.data, src, axis=1)
+    out = jnp.where(j < col.lengths[:, None], out, 0).astype(jnp.uint8)
+    return Column(out, dt.STRING, col.validity, col.lengths)

@@ -1,0 +1,108 @@
+"""Tests for the extended string ops (strip/find/pad/replace/split/
+reverse) against python's str semantics on ASCII data."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.column import Column
+from spark_rapids_jni_tpu.ops import strings
+
+
+@pytest.fixture
+def col():
+    return Column.from_strings(
+        ["  hello  ", "world", "", None, "a b c", "xx"]
+    )
+
+
+class TestStrip:
+    def test_strip(self, col):
+        got = strings.strip(col).to_pylist()
+        assert got == ["hello", "world", "", None, "a b c", "xx"]
+
+    def test_lstrip_rstrip(self, col):
+        assert strings.lstrip(col).to_pylist() == [
+            "hello  ", "world", "", None, "a b c", "xx",
+        ]
+        assert strings.rstrip(col).to_pylist() == [
+            "  hello", "world", "", None, "a b c", "xx",
+        ]
+
+    def test_strip_custom_chars(self):
+        c = Column.from_strings(["xxabcxx", "xyx"])
+        assert strings.strip(c, "x").to_pylist() == ["abc", "y"]
+
+    def test_strip_all_stripped(self):
+        c = Column.from_strings(["   ", "a"])
+        assert strings.strip(c).to_pylist() == ["", "a"]
+
+
+class TestFind:
+    def test_find(self):
+        c = Column.from_strings(["hello", "world", "ololo", ""])
+        got = strings.find(c, "lo").to_pylist()
+        assert got == [s.find("lo") for s in ["hello", "world", "ololo", ""]]
+
+    def test_find_first_occurrence(self):
+        c = Column.from_strings(["abcabc"])
+        assert strings.find(c, "bc").to_pylist() == [1]
+
+    def test_find_empty_pattern(self):
+        c = Column.from_strings(["abc"])
+        assert strings.find(c, "").to_pylist() == [0]
+
+
+class TestPad:
+    def test_rpad_truncates_like_spark(self):
+        c = Column.from_strings(["ab", "abcdef"])
+        got = strings.pad(c, 4, "right", "*").to_pylist()
+        assert got == ["ab**", "abcd"]  # Spark rpad truncates to width
+
+    def test_lpad_truncates_like_spark(self):
+        c = Column.from_strings(["ab", "abcdef"])
+        got = strings.pad(c, 4, "left", "0").to_pylist()
+        assert got == ["00ab", "abcd"]
+
+    def test_multichar_fill(self):
+        c = Column.from_strings(["x"])
+        assert strings.pad(c, 6, "left", "ab").to_pylist() == ["ababax"]
+        assert strings.pad(c, 6, "right", "ab").to_pylist() == ["xababa"]
+
+    def test_trim_space_only_default(self):
+        c = Column.from_strings(["\thi\t", " hi "])
+        # Spark trim removes only spaces by default
+        assert strings.strip(c).to_pylist() == ["\thi\t", "hi"]
+
+
+class TestReplace:
+    def test_equal_width_device(self):
+        c = Column.from_strings(["banana", "abcabc", "xyz"])
+        got = strings.replace(c, "an", "AN").to_pylist()
+        assert got == [s.replace("an", "AN") for s in ["banana", "abcabc", "xyz"]]
+
+    def test_nonoverlapping_greedy(self):
+        c = Column.from_strings(["aaaa"])
+        assert strings.replace(c, "aa", "bb").to_pylist() == ["bbbb"]
+
+    def test_width_changing_host(self):
+        c = Column.from_strings(["banana", None, "x"])
+        got = strings.replace(c, "na", "_").to_pylist()
+        assert got == ["ba__", None, "x"]
+
+
+class TestSplit:
+    def test_split_get(self):
+        c = Column.from_strings(["a,b,c", "one", ",x", "a,,b"])
+        for i in range(3):
+            got = strings.split_get(c, ",", i).to_pylist()
+            want = [
+                (s.split(",")[i] if i < len(s.split(",")) else "")
+                for s in ["a,b,c", "one", ",x", "a,,b"]
+            ]
+            assert got == want, f"index {i}"
+
+
+class TestReverse:
+    def test_reverse(self):
+        c = Column.from_strings(["abc", "", "xy", None])
+        assert strings.reverse(c).to_pylist() == ["cba", "", "yx", None]
