@@ -9,7 +9,10 @@
 # (.github/workflows/dependency-sync.yml drives the schedule).
 #
 # Env: GITHUB_TOKEN, GITHUB_REPO (owner/name), BASE_BRANCH (default main)
-set -euxo pipefail
+#
+# No -x: the REST calls below carry the Authorization token; xtrace
+# would write it into the build log (Actions masking is best-effort).
+set -euo pipefail
 
 repo="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$repo"

@@ -7,7 +7,10 @@
 # Args:    SIGN_FILE (true|false)
 # Env:     CLASSIFIERS (default "v5e"), SERVER_ID, SERVER_URL,
 #          GPG_PASSPHRASE (when signing)
-set -euxo pipefail
+#
+# No -x: signing runs here, and xtrace would echo secret-bearing
+# command lines into the build log (Actions masking is best-effort).
+set -euo pipefail
 
 SIGN_FILE="${1:-false}"
 CLASSIFIERS="${CLASSIFIERS:-v5e}"
@@ -30,8 +33,10 @@ for cls in "${classifiers[@]}"; do
   if [[ -f "$jar" ]]; then
     cp "$jar" "$out/"
     if [[ "$SIGN_FILE" == "true" ]]; then
-      gpg --batch --yes --passphrase "$GPG_PASSPHRASE" \
-        --detach-sign --armor "$out/$(basename "$jar")"
+      # passphrase over fd 3, never argv (argv is visible in /proc)
+      gpg --batch --yes --pinentry-mode loopback --passphrase-fd 3 \
+        --detach-sign --armor "$out/$(basename "$jar")" \
+        3<<<"$GPG_PASSPHRASE"
     fi
   else
     echo "WARNING: $jar not built; skipping classifier $cls"

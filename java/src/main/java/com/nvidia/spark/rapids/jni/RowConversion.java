@@ -57,11 +57,25 @@ public class RowConversion {
           new ai.rapids.cudf.ColumnVector[batches.length];
       long maxRows = maxRowsPerBatch(rowSize);
       long remaining = table.getRowCount();
-      for (int b = 0; b < batches.length; b++) {
-        long batchRows = Math.min(maxRows, remaining);
-        out[b] = ai.rapids.cudf.ColumnVector.fromPackedRows(
-            batches[b], batchRows, rowSize);
-        remaining -= batchRows;
+      try {
+        for (int b = 0; b < batches.length; b++) {
+          long batchRows = Math.min(maxRows, remaining);
+          out[b] = ai.rapids.cudf.ColumnVector.fromPackedRows(
+              batches[b], batchRows, rowSize);
+          remaining -= batchRows;
+        }
+      } catch (RuntimeException e) {
+        // wrapping failed mid-loop: close the vectors already built and
+        // the batch buffers not yet owned by one, or their registry
+        // handles leak past the caller forever
+        for (int b = 0; b < batches.length; b++) {
+          if (out[b] != null) {
+            out[b].close();
+          } else if (batches[b] != null) {
+            batches[b].close();
+          }
+        }
+        throw e;
       }
       return out;
     }
@@ -86,12 +100,34 @@ public class RowConversion {
     long[] handles = convertFromRowsNative(rows.getData().getHandle(),
                                            typeIds, scales, numRows);
     ai.rapids.cudf.ColumnVector[] cols = new ai.rapids.cudf.ColumnVector[n];
-    for (int i = 0; i < n; i++) {
-      HostBuffer data = new HostBuffer(handles[i]);
-      HostBuffer valid = new HostBuffer(handles[n + i]);
-      cols[i] = new ai.rapids.cudf.ColumnVector(schema[i], numRows, data, valid);
+    HostBuffer[] bufs = new HostBuffer[2 * n];
+    try {
+      for (int i = 0; i < 2 * n; i++) {
+        bufs[i] = new HostBuffer(handles[i]);
+      }
+      for (int i = 0; i < n; i++) {
+        cols[i] = new ai.rapids.cudf.ColumnVector(
+            schema[i], numRows, bufs[i], bufs[n + i]);
+      }
+      return new ai.rapids.cudf.Table(cols);
+    } catch (RuntimeException e) {
+      // column/table assembly failed: close the vectors already built
+      // (each owns its two buffers) and every buffer no vector owns,
+      // or their registry handles leak past the caller forever
+      for (int i = 0; i < n; i++) {
+        if (cols[i] != null) {
+          cols[i].close();
+          bufs[i] = null;
+          bufs[n + i] = null;
+        }
+      }
+      for (HostBuffer b : bufs) {
+        if (b != null) {
+          b.close();
+        }
+      }
+      throw e;
     }
-    return new ai.rapids.cudf.Table(cols);
   }
 
   /**

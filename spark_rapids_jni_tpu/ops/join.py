@@ -195,6 +195,24 @@ def inner_join_capped(
     return Table(cols, out.names), jnp.sum(counts)
 
 
+def inner_join_count(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+    left_valid: Optional[jax.Array] = None,
+    right_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Jittable match count — phase 1 of the two-phase output sizing
+    (the generalization of row_conversion.cu:505-511): count on device,
+    host-sync once, then materialize with a static capacity."""
+    right_on = right_on or on
+    _, _, counts, _ = _match_ranges(
+        left, right, on, right_on, left_valid, right_valid
+    )
+    return jnp.sum(counts)
+
+
 def inner_join(
     left: Table,
     right: Table,

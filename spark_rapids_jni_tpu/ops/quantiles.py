@@ -33,19 +33,28 @@ def quantile(
         raise ValueError(f"unknown interpolation {interpolation!r}")
     if not (col.dtype.is_numeric or col.dtype.is_timestamp):
         raise TypeError(f"quantile: numeric input required, got {col.dtype}")
+    qs = list(qs)
+    if any(not (0.0 <= float(q) <= 1.0) for q in qs):
+        raise ValueError(f"quantile fractions must be in [0, 1], got {qs}")
     n = len(col)
     vals = compute.values(col).astype(jnp.float64)
     if col.dtype.is_decimal:
         vals = vals * (10.0 ** col.dtype.scale)
     valid = compute.valid_mask(col)
+    # NaNs are excluded like nulls (pandas/cudf null-excluding quantile);
+    # otherwise they'd sort past the inf null-exile region and shift it
+    valid = jnp.logical_and(valid, jnp.logical_not(jnp.isnan(vals)))
     # nulls sort past every real value; n_valid bounds the index range
     sorted_vals = jnp.sort(jnp.where(valid, vals, jnp.inf))
     n_valid = jnp.sum(valid).astype(jnp.float64)
 
-    q = jnp.asarray(list(qs), jnp.float64)
+    q = jnp.asarray(qs, jnp.float64)
     pos = q * jnp.maximum(n_valid - 1, 0)
-    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, max(n - 1, 0))
-    hi = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, max(n - 1, 0))
+    # clamp to the valid region, not just [0, n-1] — indices past
+    # n_valid-1 would read the null-exile infs
+    max_i = jnp.maximum(n_valid - 1, 0).astype(jnp.int32)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, max_i)
+    hi = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, max_i)
     vlo = sorted_vals[lo]
     vhi = sorted_vals[hi]
     if interpolation == LINEAR:

@@ -35,22 +35,31 @@ def _window_bounds(n, preceding: int, following: int, part_start, part_end):
     return start, jnp.maximum(end, start)
 
 
+def _count_window(valid, start, end):
+    """Per-row count of valid values in [start, end) via prefix sums."""
+    cnt = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), jnp.cumsum(valid.astype(jnp.int64))]
+    )
+    return cnt[end] - cnt[start]
+
+
 def _prefix_window(vals, valid, start, end, agg):
-    """SUM/COUNT/MEAN via exclusive prefix sums over masked values."""
+    """SUM/COUNT/MEAN via exclusive prefix sums over masked values.
+
+    Returns ``(out, has, wcnt)`` — the per-row valid count comes along
+    so callers never recompute the count prefix sums.
+    """
     acc = jnp.where(valid, vals, 0).astype(
         jnp.float64 if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.int64
     )
     cs = jnp.concatenate([jnp.zeros((1,), acc.dtype), jnp.cumsum(acc)])
-    cnt = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int64), jnp.cumsum(valid.astype(jnp.int64))]
-    )
     wsum = cs[end] - cs[start]
-    wcnt = cnt[end] - cnt[start]
+    wcnt = _count_window(valid, start, end)
     if agg == "count":
-        return wcnt, wcnt >= 0
+        return wcnt, wcnt >= 0, wcnt
     if agg == "sum":
-        return wsum, wcnt > 0
-    return wsum.astype(jnp.float64) / jnp.maximum(wcnt, 1), wcnt > 0
+        return wsum, wcnt > 0, wcnt
+    return wsum.astype(jnp.float64) / jnp.maximum(wcnt, 1), wcnt > 0, wcnt
 
 
 def _minmax_window(col: Column, start, end, op):
@@ -152,8 +161,7 @@ def rolling_aggregate(
 
     if agg in _SUMLIKE:
         vals = compute.values(col)
-        out, has = _prefix_window(vals, valid, start, end, agg)
-        cnt = _prefix_window(vals, valid, start, end, "count")[0]
+        out, has, cnt = _prefix_window(vals, valid, start, end, agg)
         ok = jnp.logical_and(has, cnt >= min_periods)
         if agg == "count":
             return Column(out.astype(jnp.int32), dt.INT32, ok)
@@ -164,7 +172,11 @@ def rolling_aggregate(
                 out = out * (10.0 ** col.dtype.scale)
             return compute.from_values(out, dt.FLOAT64, ok)
         if col.dtype.is_floating:
-            return compute.from_values(out, dt.FLOAT64, ok)
+            # f64 accumulation, but the output keeps the input floating
+            # type (cudf rolling_window preserves it)
+            return compute.from_values(
+                out.astype(vals.dtype), col.dtype, ok
+            )
         out_dt = (
             dt.DType(dt.TypeId.DECIMAL64, col.dtype.scale)
             if col.dtype.is_decimal
@@ -174,9 +186,7 @@ def rolling_aggregate(
 
     if agg in _MINMAX:
         pos, has = _minmax_window(col, start, end, agg)
-        cnt = _prefix_window(
-            jnp.zeros((n,)), valid, start, end, "count"
-        )[0]
+        cnt = _count_window(valid, start, end)
         ok = jnp.logical_and(has, cnt >= min_periods)
         return Column(jnp.take(col.data, pos, axis=0), col.dtype, ok)
 

@@ -8,8 +8,16 @@ ICI axis under ``shard_map``, with XLA inserting the collective schedule.
 """
 
 from .mesh import make_mesh, shard_table, replicate_table, local_shards
-from .shuffle import exchange, shuffle_table
+from .shuffle import (
+    ShuffleOverflowError,
+    exchange,
+    partition_counts,
+    plan_capacity,
+    shuffle_table,
+)
 from .distributed import (
+    GroupOverflowError,
+    JoinOverflowError,
     distributed_groupby,
     distributed_inner_join,
 )
@@ -20,7 +28,12 @@ __all__ = [
     "replicate_table",
     "local_shards",
     "exchange",
+    "partition_counts",
+    "plan_capacity",
     "shuffle_table",
+    "ShuffleOverflowError",
+    "GroupOverflowError",
+    "JoinOverflowError",
     "distributed_groupby",
     "distributed_inner_join",
 ]

@@ -7,6 +7,16 @@ chip runs the local sort-based op on its partition with padding rows
 masked by occupancy. Results stay device-resident and sharded (each chip
 owns its key range by hash), exactly how a Spark stage chain consumes
 them.
+
+Sizing is LOSSLESS by default: exchange capacities come from the
+planning pass (parallel/shuffle.py:partition_counts) and join output
+capacity from a jitted count pass (ops/join.py:inner_join_count) — the
+distributed instances of the reference's two-phase sizing discipline
+(row_conversion.cu:505-511). Explicit undersized capacities raise
+``ShuffleOverflowError``/``JoinOverflowError``/``GroupOverflowError``
+rather than dropping rows. The join exchanges each side ONCE: the
+shuffled shards stay device-resident between the count pass and the
+materialize pass.
 """
 
 from __future__ import annotations
@@ -19,9 +29,27 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..column import Table
 from ..ops.groupby import GroupbyAgg, groupby_aggregate_capped
-from ..ops.join import inner_join_capped
+from ..ops.join import inner_join_capped, inner_join_count
 from .mesh import SHUFFLE_AXIS, shard_map, shard_table
-from .shuffle import exchange_by_hash
+from .shuffle import (
+    _round_capacity,
+    check_overflow,
+    exchange_by_hash,
+    plan_capacity,
+    validate_on_overflow,
+)
+
+
+class JoinOverflowError(RuntimeError):
+    """A capped join produced more matches than its static output
+    capacity — rows would have been dropped. Raised by the host
+    wrappers; never silent."""
+
+
+class GroupOverflowError(RuntimeError):
+    """A capped groupby saw more distinct keys than its static segment
+    capacity — groups would have been dropped. Raised by the host
+    wrappers; never silent."""
 
 
 def distributed_groupby(
@@ -32,18 +60,23 @@ def distributed_groupby(
     capacity: Optional[int] = None,
     groups_per_device: Optional[int] = None,
     axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
 ):
     """Shuffle-then-aggregate GROUP BY over the mesh.
 
     Returns (sharded padded result table, per-device group counts (P,),
     per-device shuffle overflow (P,)). Groups are complete: each key lives
-    on exactly one device, by Spark hash partitioning.
+    on exactly one device, by Spark hash partitioning. ``capacity=None``
+    auto-plans from the real partition counts (lossless); an explicit
+    undersized ``capacity`` or ``groups_per_device`` raises unless
+    ``on_overflow="allow"``.
     """
+    validate_on_overflow(on_overflow)
     num = int(mesh.shape[axis])
-    per_dev = table.row_count // num
-    cap = capacity or max(2 * per_dev // num, 16)
-    seg_cap = groups_per_device or num * cap
     sharded = shard_table(table, mesh, axis)
+    cap = capacity or plan_capacity(sharded, by, mesh, axis)
+    # a device can't see more groups than the rows it receives
+    seg_cap = groups_per_device or num * cap
 
     def body(local: Table):
         shuffled, occ, overflow = exchange_by_hash(local, by, num, cap, axis)
@@ -59,7 +92,17 @@ def distributed_groupby(
         out_specs=P(axis),
         check_vma=False,
     )
-    return fn(sharded)
+    agg, ngroups, overflow = fn(sharded)
+    if on_overflow == "raise":
+        check_overflow(overflow, cap, "groupby")
+        worst_groups = int(jnp.max(ngroups))
+        if worst_groups > seg_cap:
+            raise GroupOverflowError(
+                f"groups_per_device {seg_cap} undersized: a device saw "
+                f"{worst_groups} distinct keys; omit groups_per_device "
+                f"to auto-size"
+            )
+    return agg, ngroups, overflow
 
 
 def distributed_inner_join(
@@ -70,38 +113,74 @@ def distributed_inner_join(
     capacity: Optional[int] = None,
     out_capacity: Optional[int] = None,
     axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
 ):
     """Shuffle-shuffle hash-partitioned inner join over the mesh.
 
     Both sides are hash-exchanged on the join keys (co-partitioning), then
     each chip joins its partitions locally. Returns (sharded padded join
     output, per-device match counts, left/right shuffle overflows).
+
+    ``capacity=None`` plans both exchanges exactly; ``out_capacity=None``
+    counts matches on the co-partitioned shards and sizes the output to
+    the real per-device maximum (two-phase sizing). Each side crosses the
+    ICI exactly once — the count pass and the materialize pass share the
+    shuffled, device-resident shards. Explicit undersized values raise
+    unless ``on_overflow="allow"``.
     """
+    validate_on_overflow(on_overflow)
     num = int(mesh.shape[axis])
-    lcap = capacity or max(2 * (left.row_count // num) // num, 16)
-    rcap = capacity or max(2 * (right.row_count // num) // num, 16)
-    ocap = out_capacity or 4 * max(lcap, rcap) * num
     lsh = shard_table(left, mesh, axis)
     rsh = shard_table(right, mesh, axis)
+    lcap = capacity or plan_capacity(lsh, on, mesh, axis)
+    rcap = capacity or plan_capacity(rsh, on, mesh, axis)
+    count_pass = out_capacity is None
 
-    def body(l_local: Table, r_local: Table):
+    def exchange_body(l_local: Table, r_local: Table):
         ls, locc, lov = exchange_by_hash(l_local, on, num, lcap, axis)
         rs, rocc, rov = exchange_by_hash(r_local, on, num, rcap, axis)
-        out, count = inner_join_capped(
-            ls,
-            rs,
-            on,
-            capacity=ocap,
-            left_valid=locc,
-            right_valid=rocc,
+        cnt = (
+            inner_join_count(ls, rs, on, left_valid=locc, right_valid=rocc)
+            if count_pass
+            else jnp.zeros((), jnp.int64)
         )
-        return out, count[None], lov[None], rov[None]
+        return ls, locc, lov[None], rs, rocc, rov[None], cnt[None]
 
-    fn = shard_map(
-        body,
+    ex_fn = shard_map(
+        exchange_body,
         mesh=mesh,
         in_specs=P(axis),
         out_specs=P(axis),
         check_vma=False,
     )
-    return fn(lsh, rsh)
+    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = ex_fn(lsh, rsh)
+    if on_overflow == "raise":
+        check_overflow(lov, lcap, "left join")
+        check_overflow(rov, rcap, "right join")
+    ocap = (
+        _round_capacity(int(jnp.max(cnts))) if count_pass else out_capacity
+    )
+
+    def join_body(ls: Table, locc, rs: Table, rocc):
+        out, count = inner_join_capped(
+            ls, rs, on, capacity=ocap, left_valid=locc, right_valid=rocc
+        )
+        return out, count[None]
+
+    join_fn = shard_map(
+        join_body,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    out, count = join_fn(ls_g, locc_g, rs_g, rocc_g)
+    if on_overflow == "raise":
+        worst = int(jnp.max(count))
+        if worst > ocap:
+            raise JoinOverflowError(
+                f"join output capacity {ocap} undersized: a device "
+                f"produced {worst} matches; pass out_capacity=None to "
+                f"auto-size"
+            )
+    return out, count, lov, rov

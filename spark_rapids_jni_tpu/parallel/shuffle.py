@@ -9,8 +9,13 @@ capacity keeps shapes static for XLA (the shuffle-side instance of the
 two-phase discipline); received padding is tracked with an occupancy mask
 that downstream capped ops treat as absent rows.
 
-``shuffle_table`` is the host-level wrapper: shard -> shard_map(exchange)
--> globally sharded padded table + occupancy.
+``shuffle_table`` is the host-level wrapper: shard -> plan capacity
+(exact per-(src,dst) counts, the generalization of the reference's
+two-phase sizing, row_conversion.cu:505-511) -> shard_map(exchange)
+-> globally sharded padded table + occupancy. The default path is
+LOSSLESS: capacity is planned from the real counts, and any overflow
+(possible only with an explicit undersized ``capacity``) raises
+``ShuffleOverflowError`` instead of silently dropping rows.
 """
 
 from __future__ import annotations
@@ -24,6 +29,74 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..column import Column, Table
 from ..ops.partition import partition_ids_hash
 from .mesh import SHUFFLE_AXIS, shard_map, shard_table
+
+
+class ShuffleOverflowError(RuntimeError):
+    """An exchange received more rows for a (src, dst) pair than its
+    static capacity — rows would have been dropped. Raised by the host
+    wrappers; never silent."""
+
+
+def validate_on_overflow(on_overflow: str) -> None:
+    """Shared host-wrapper argument check: typos must not silently
+    disable overflow detection."""
+    if on_overflow not in ("raise", "allow"):
+        raise ValueError(
+            f"on_overflow must be 'raise' or 'allow', got {on_overflow!r}"
+        )
+
+
+def check_overflow(overflow, capacity: int, what: str) -> None:
+    """Raise ``ShuffleOverflowError`` if any device reported overflow."""
+    worst = int(jnp.max(overflow))
+    if worst > 0:
+        raise ShuffleOverflowError(
+            f"{what} exchange capacity {capacity} undersized by {worst} "
+            f"rows per (src, dst) pair; pass capacity=None to auto-plan"
+        )
+
+
+def partition_counts(
+    sharded: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    mesh: Mesh,
+    axis: str = SHUFFLE_AXIS,
+) -> jax.Array:
+    """(num, num) per-(src, dst) row counts — the shuffle planning pass.
+
+    Row [s, d] is how many of source s's rows hash to partition d. The
+    max entry is the exact minimal per-pair exchange capacity.
+    """
+    num = int(mesh.shape[axis])
+
+    def body(local: Table):
+        dest = partition_ids_hash(local, columns, num)
+        return jnp.bincount(dest, length=num).astype(jnp.int32)[None, :]
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False
+    )
+    return fn(sharded)
+
+
+def _round_capacity(exact: int) -> int:
+    """Round a planned capacity up to the next power of two (min 16) so
+    repeated shuffles of similar volume reuse one compiled executable."""
+    cap = 16
+    while cap < exact:
+        cap *= 2
+    return cap
+
+
+def plan_capacity(
+    sharded: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    mesh: Mesh,
+    axis: str = SHUFFLE_AXIS,
+) -> int:
+    """Exact-overflow-free exchange capacity for ``sharded`` (host sync)."""
+    counts = partition_counts(sharded, columns, mesh, axis)
+    return _round_capacity(int(jnp.max(counts)))
 
 
 def exchange(
@@ -106,17 +179,22 @@ def shuffle_table(
     mesh: Mesh,
     capacity: Optional[int] = None,
     axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
 ):
     """Host-level shuffle: row-shard ``table`` and hash-exchange it.
 
     Returns (globally sharded padded table, occupancy column, overflow).
-    ``capacity`` defaults to 2x the perfectly-balanced per-pair share.
+    ``capacity=None`` (the default) runs the planning pass and sizes the
+    exchange exactly — no row can ever be dropped. An explicit capacity
+    skips planning; if it turns out undersized, ``on_overflow="raise"``
+    (default) raises ``ShuffleOverflowError``; ``"allow"`` opts into the
+    caller checking the returned overflow counts itself.
     """
+    validate_on_overflow(on_overflow)
     num = int(mesh.shape[axis])
     sharded = shard_table(table, mesh, axis)
-    per_dev = table.row_count // num
     if capacity is None:
-        capacity = max(2 * per_dev // num, 16)
+        capacity = plan_capacity(sharded, columns, mesh, axis)
 
     def run(local):
         out, occ, overflow = exchange_by_hash(
@@ -127,4 +205,7 @@ def shuffle_table(
     fn = shard_map(
         run, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False
     )
-    return fn(sharded)
+    out, occ, overflow = fn(sharded)
+    if on_overflow == "raise":
+        check_overflow(overflow, capacity, "shuffle")
+    return out, occ, overflow
