@@ -1,0 +1,134 @@
+/*
+ * Device table ops: the JVM's path to TPU compute.
+ *
+ * The reference's Java layer reaches device kernels through per-op JNI
+ * natives (RowConversion.java:104-128 -> RowConversionJni.cpp:24-66).
+ * This class is the TPU equivalent over the generic device dispatch of
+ * the native runtime (src/jni/DeviceTableJni.cpp ->
+ * src/cpp/jax_runtime.cpp): a Spark executor builds host column
+ * buffers, wraps them in registry handles (HostBuffer), and runs
+ * groupby / sort / filter / row-transpose ops that execute on the XLA
+ * backend. Ownership discipline matches the reference: every returned
+ * buffer is caller-owned and must be closed (the refcount-debug leak
+ * report catches violations, pom.xml:86,199 analog).
+ */
+package com.nvidia.spark.rapids.jni;
+
+public class DeviceTable {
+  static {
+    NativeLibraryLoader.loadNativeLibs();
+  }
+
+  /** Result of a device table op: parallel arrays per output column. */
+  public static final class Result implements AutoCloseable {
+    public final int[] typeIds;
+    public final int[] scales;
+    public final HostBuffer[] data;
+    public final HostBuffer[] valid; // null entry = column has no nulls
+    public final long numRows;
+
+    Result(int[] typeIds, int[] scales, HostBuffer[] data,
+           HostBuffer[] valid, long numRows) {
+      this.typeIds = typeIds;
+      this.scales = scales;
+      this.data = data;
+      this.valid = valid;
+      this.numRows = numRows;
+    }
+
+    @Override
+    public void close() {
+      for (HostBuffer b : data) {
+        if (b != null) {
+          b.close();
+        }
+      }
+      for (HostBuffer b : valid) {
+        if (b != null) {
+          b.close();
+        }
+      }
+    }
+  }
+
+  /** True when the loaded native library embeds the device runtime. */
+  public static native boolean isDeviceRuntimeAvailable();
+
+  /** Initialize (or join) the embedded JAX runtime; idempotent. */
+  public static native void initDeviceRuntime();
+
+  /** Active device platform name ("tpu", "cpu"). */
+  public static native String devicePlatform();
+
+  /**
+   * Run one table op on the device runtime.
+   *
+   * @param opJson   op spec (see runtime_bridge.py op vocabulary:
+   *                 groupby / sort_by / filter / to_rows / from_rows)
+   * @param typeIds  native dtype ids per input column
+   *                 (RowConversionJni.cpp:56-61 wire format)
+   * @param scales   decimal scales per input column
+   * @param colData  input column buffers (little-endian fixed-width)
+   * @param colValid per-column validity byte vectors; null = no nulls
+   * @param numRows  rows in every input column
+   * @return caller-owned result columns computed on the XLA backend
+   */
+  public static Result tableOp(String opJson, int[] typeIds, int[] scales,
+                               HostBuffer[] colData, HostBuffer[] colValid,
+                               long numRows) {
+    int n = typeIds.length;
+    long[] dataHandles = new long[n];
+    long[] validHandles = new long[n];
+    for (int i = 0; i < n; i++) {
+      dataHandles[i] = colData[i].getHandle();
+      validHandles[i] = colValid[i] == null ? 0 : colValid[i].getHandle();
+    }
+    long[] packed = tableOpNative(opJson, typeIds, scales, dataHandles,
+                                  validHandles, numRows);
+    int outCols = (int) packed[0];
+    long outRows = packed[1];
+    int[] outIds = new int[outCols];
+    int[] outScales = new int[outCols];
+    HostBuffer[] outData = new HostBuffer[outCols];
+    HostBuffer[] outValid = new HostBuffer[outCols];
+    int wrapped = 0;
+    try {
+      for (; wrapped < outCols; wrapped++) {
+        int i = wrapped;
+        outIds[i] = (int) packed[2 + i];
+        outScales[i] = (int) packed[2 + outCols + i];
+        outData[i] = new HostBuffer(packed[2 + 2 * outCols + i]);
+        long vh = packed[2 + 3 * outCols + i];
+        outValid[i] = vh == 0 ? null : new HostBuffer(vh);
+      }
+    } catch (RuntimeException e) {
+      // wrap failure mid-loop: close the wrappers that exist, then
+      // release the raw handles never wrapped (the RowConversion
+      // cleanup discipline — registry buffers must not leak)
+      for (int j = 0; j < outCols; j++) {
+        if (outData[j] != null) {
+          outData[j].close();
+        }
+        if (outValid[j] != null) {
+          outValid[j].close();
+        }
+      }
+      for (int j = wrapped; j < outCols; j++) {
+        long dh = packed[2 + 2 * outCols + j];
+        long vh = packed[2 + 3 * outCols + j];
+        if (outData[j] == null && dh != 0) {
+          new HostBuffer(dh).close();
+        }
+        if (outValid[j] == null && vh != 0) {
+          new HostBuffer(vh).close();
+        }
+      }
+      throw e;
+    }
+    return new Result(outIds, outScales, outData, outValid, outRows);
+  }
+
+  private static native long[] tableOpNative(String opJson, int[] typeIds,
+                                             int[] scales, long[] colData,
+                                             long[] colValid, long numRows);
+}

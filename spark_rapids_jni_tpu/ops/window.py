@@ -170,6 +170,12 @@ def rolling_aggregate(
                 # unscaled ints -> logical values (the groupby/reduce
                 # mean convention, groupby.py mean branch)
                 out = out * (10.0 ** col.dtype.scale)
+            if col.dtype.is_floating:
+                # like sum: f64 accumulation, input floating type out
+                # (libcudf MEAN preserves the source floating type)
+                return compute.from_values(
+                    out.astype(vals.dtype), col.dtype, ok
+                )
             return compute.from_values(out, dt.FLOAT64, ok)
         if col.dtype.is_floating:
             # f64 accumulation, but the output keeps the input floating

@@ -1,0 +1,152 @@
+"""Wire-level dispatch for the embedded native runtime.
+
+This module is what ``libspark_rapids_tpu.so`` imports when a native
+caller (JNI bridge, C program, Spark executor) initializes the embedded
+JAX runtime (src/cpp/jax_runtime.cpp). It is the TPU answer to the
+reference's JNI entry points dispatching into device kernels
+(RowConversionJni.cpp:24-66): host bytes come in over the C ABI, columns
+are built on the XLA backend, the op runs on device, and result columns
+travel back as host bytes.
+
+The wire format mirrors the reference's dtype marshaling: parallel
+(type id, scale) int arrays (RowConversionJni.cpp:56-61), little-endian
+fixed-width data buffers (FLOAT64 as IEEE-754 doubles, BOOL8 as one 0/1
+byte per value), and per-column 0/1 validity byte vectors. Fixed-width
+types only — the same gate the reference enforces at
+row_conversion.cu:514-516.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import dtype as dt
+from .column import Column, Table
+
+
+def _wire_np(d: dt.DType) -> np.dtype:
+    """Host wire numpy dtype of a fixed-width column."""
+    if not d.is_fixed_width:
+        raise TypeError(f"wire format: fixed-width types only, got {d}")
+    if d.id == dt.TypeId.FLOAT64:
+        # device storage is the uint64 bit pattern; the wire carries
+        # doubles (same bytes, different view)
+        return np.dtype(np.float64)
+    return np.dtype(d.storage_dtype)
+
+
+def _column_from_wire(
+    type_id: int, scale: int, data: Optional[bytes],
+    valid: Optional[bytes], num_rows: int,
+) -> Column:
+    d = dt.DType(dt.TypeId(type_id), scale)
+    arr = np.frombuffer(data, dtype=_wire_np(d), count=num_rows)
+    v = (
+        None
+        if valid is None
+        else np.frombuffer(valid, dtype=np.uint8, count=num_rows).astype(
+            np.bool_
+        )
+    )
+    return Column.from_numpy(arr, validity=v, dtype=d)
+
+
+def _column_to_wire(c: Column):
+    """(type_id, scale, data bytes, valid bytes | None)."""
+    host = np.ascontiguousarray(np.asarray(c.data))
+    valid = (
+        None
+        if c.validity is None
+        else np.asarray(c.validity).astype(np.uint8).tobytes()
+    )
+    return (
+        int(c.dtype.id.value),
+        int(c.dtype.scale),
+        host.tobytes(),
+        valid,
+    )
+
+
+def _dispatch(op: dict, table: Table) -> Table:
+    """Run one op on device; returns the result Table."""
+    import jax.numpy as jnp
+
+    from . import ops
+    from . import rows as rows_mod
+
+    name = op["op"]
+    if name == "groupby":
+        from .ops.groupby import GroupbyAgg
+
+        aggs = [GroupbyAgg(a["column"], a["agg"]) for a in op["aggs"]]
+        return ops.groupby_aggregate(table, op["by"], aggs)
+    if name == "sort_by":
+        keys = [
+            ops.SortKey(k["column"], ascending=k.get("ascending", True))
+            for k in op["keys"]
+        ]
+        return ops.sort_table(table, keys)
+    if name == "filter":
+        mask_idx = op["mask"]
+        mask = table.columns[mask_idx]
+        keep = [
+            c for i, c in enumerate(table.columns) if i != mask_idx
+        ]
+        return ops.filter_table(Table(keep), mask)
+    if name == "to_rows":
+        # device row transpose; result = one UINT8 column of the packed
+        # bytes (the LIST<INT8> child of row_conversion.cu:392-394)
+        batches = rows_mod.to_rows(table)
+        flat = np.concatenate(
+            [np.asarray(b.data).reshape(-1) for b in batches]
+        )
+        return Table([Column.from_numpy(flat, dtype=dt.UINT8)])
+    if name == "from_rows":
+        schema = [
+            dt.DType(dt.TypeId(t), s)
+            for t, s in zip(op["type_ids"], op["scales"])
+        ]
+        layout = rows_mod.compute_fixed_width_layout(schema)
+        n = int(op["num_rows"])
+        raw = np.asarray(table.columns[0].data).reshape(n, layout.row_size)
+        pr = rows_mod.PackedRows(jnp.asarray(raw), layout)
+        return rows_mod.from_rows(pr, schema)
+    raise ValueError(f"unknown table op {name!r}")
+
+
+def table_op_wire(
+    op_json: str,
+    type_ids: Sequence[int],
+    scales: Sequence[int],
+    datas: Sequence[Optional[bytes]],
+    valids: Sequence[Optional[bytes]],
+    num_rows: int,
+):
+    """C-ABI entry: bytes in, bytes out.
+
+    Returns (out_type_ids, out_scales, out_datas, out_valids, out_rows).
+    """
+    op = json.loads(op_json)
+    cols = [
+        _column_from_wire(t, s, d, v, num_rows)
+        for t, s, d, v in zip(type_ids, scales, datas, valids)
+    ]
+    result = _dispatch(op, Table(cols))
+    out_t, out_s, out_d, out_v = [], [], [], []
+    for c in result.columns:
+        t, s, d, v = _column_to_wire(c)
+        out_t.append(t)
+        out_s.append(s)
+        out_d.append(d)
+        out_v.append(v)
+    return out_t, out_s, out_d, out_v, int(result.row_count)
+
+
+def platform() -> str:
+    """Active XLA backend platform name."""
+    import jax
+
+    return jax.devices()[0].platform

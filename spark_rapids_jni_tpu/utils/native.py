@@ -73,6 +73,27 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srt_live_handle_count.restype = ctypes.c_int64
     lib.srt_leak_report.restype = ctypes.c_int64
     lib.srt_leak_report.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.srt_jax_available.restype = ctypes.c_int32
+    lib.srt_jax_init.restype = ctypes.c_int
+    lib.srt_jax_platform.restype = ctypes.c_int
+    lib.srt_jax_platform.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.srt_jax_table_op.restype = ctypes.c_int
+    lib.srt_jax_table_op.argtypes = [
+        ctypes.c_char_p,                     # op_json
+        ctypes.POINTER(ctypes.c_int32),      # type_ids
+        ctypes.POINTER(ctypes.c_int32),      # scales
+        ctypes.c_int32,                      # num_columns
+        ctypes.POINTER(ctypes.c_int64),      # col_data handles
+        ctypes.POINTER(ctypes.c_int64),      # col_valid handles
+        ctypes.c_int64,                      # num_rows
+        ctypes.c_int32,                      # max_out_columns
+        ctypes.POINTER(ctypes.c_int32),      # out_type_ids
+        ctypes.POINTER(ctypes.c_int32),      # out_scales
+        ctypes.POINTER(ctypes.c_int32),      # out_num_columns
+        ctypes.POINTER(ctypes.c_int64),      # out_col_data
+        ctypes.POINTER(ctypes.c_int64),      # out_col_valid
+        ctypes.POINTER(ctypes.c_int64),      # out_num_rows
+    ]
     return lib
 
 
@@ -285,3 +306,88 @@ def leak_report() -> str:
     buf = ctypes.create_string_buffer(int(needed))
     lib.srt_leak_report(buf, needed)
     return buf.value.decode()
+
+
+# ---------------------------------------------------------------------------
+# embedded JAX device runtime (src/cpp/jax_runtime.cpp)
+#
+# From this (Python) process the library JOINS the live interpreter, so
+# a ctypes round trip through these functions exercises the identical
+# native code path a JVM embedder takes — minus interpreter startup.
+# ---------------------------------------------------------------------------
+
+def jax_runtime_available() -> bool:
+    lib = load()
+    return lib is not None and lib.srt_jax_available() == 1
+
+
+def jax_init() -> None:
+    _check(_require().srt_jax_init())
+
+
+def jax_platform() -> str:
+    lib = _require()
+    buf = ctypes.create_string_buffer(64)
+    _check(lib.srt_jax_platform(buf, 64))
+    return buf.value.decode()
+
+
+def jax_table_op(
+    op_json: str,
+    type_ids: Sequence[int],
+    scales: Sequence[int],
+    col_data: Sequence[int],
+    col_valid: Sequence[Optional[int]],
+    num_rows: int,
+    max_out_columns: int = 64,
+):
+    """Dispatch a table op to the device runtime via registry handles.
+
+    -> (out_type_ids, out_scales, out_data_handles, out_valid_handles,
+    out_num_rows); output handles are owned by the caller.
+    """
+    lib = _require()
+    n = len(type_ids)
+    if not (len(scales) == len(col_data) == len(col_valid) == n):
+        # ctypes zero-fills short initializer lists, which would turn a
+        # caller bug into silently-wrong scales/validity
+        raise ValueError(
+            "jax_table_op: type_ids/scales/col_data/col_valid lengths "
+            f"differ ({n}/{len(scales)}/{len(col_data)}/{len(col_valid)})"
+        )
+    ids = (ctypes.c_int32 * n)(*type_ids)
+    scl = (ctypes.c_int32 * n)(*scales)
+    hd = (ctypes.c_int64 * n)(*col_data)
+    hv = (ctypes.c_int64 * n)(*[v or 0 for v in col_valid])
+    out_ids = (ctypes.c_int32 * max_out_columns)()
+    out_scl = (ctypes.c_int32 * max_out_columns)()
+    out_hd = (ctypes.c_int64 * max_out_columns)()
+    out_hv = (ctypes.c_int64 * max_out_columns)()
+    out_cols = ctypes.c_int32(0)
+    out_rows = ctypes.c_int64(0)
+    _check(
+        lib.srt_jax_table_op(
+            op_json.encode(),
+            ids,
+            scl,
+            n,
+            hd,
+            hv,
+            ctypes.c_int64(num_rows),
+            max_out_columns,
+            out_ids,
+            out_scl,
+            ctypes.byref(out_cols),
+            out_hd,
+            out_hv,
+            ctypes.byref(out_rows),
+        )
+    )
+    m = out_cols.value
+    return (
+        list(out_ids[:m]),
+        list(out_scl[:m]),
+        list(out_hd[:m]),
+        [h if h != 0 else None for h in out_hv[:m]],
+        out_rows.value,
+    )

@@ -1,0 +1,371 @@
+/* Embedded JAX device runtime: the native->TPU dispatch path.
+ *
+ * The reference's JNI entry points call straight into device kernels in
+ * the same address space (RowConversionJni.cpp:24-66 -> row_conversion.cu).
+ * A TPU has no CUDA-style in-process kernel launch for C++ callers, so
+ * this file gives native embedders the equivalent capability by hosting
+ * the JAX/XLA stack in an embedded CPython interpreter: a JVM (through
+ * src/jni/), a C program, or a Spark executor loads
+ * libspark_rapids_tpu.so and dispatches table ops that execute on the
+ * XLA backend (TPU when present).
+ *
+ * Two embedding modes, decided at srt_jax_init():
+ *   - JOIN: the calling process already runs Python (ctypes binding in
+ *     spark_rapids_jni_tpu/utils/native.py) — reuse its interpreter via
+ *     the GIL-state API.
+ *   - HOST: pure-native caller — initialize an interpreter, resolving
+ *     the Python home from $SRT_PYTHON_EXECUTABLE (venv aware), and add
+ *     $SRT_PYTHONPATH entries so the dev tree resolves.
+ *
+ * All compute goes through one Python call:
+ * spark_rapids_jni_tpu.runtime_bridge.table_op_wire (see its docstring
+ * for the wire format). Compiled only under SRT_EMBED_JAX; without it
+ * the entry points report the capability as absent.
+ */
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "error.hpp"
+#include "spark_rapids_tpu/c_api.h"
+
+#ifdef SRT_EMBED_JAX
+#include <Python.h>
+#endif
+
+using spark_rapids_tpu::expects;
+using spark_rapids_tpu::srt_error;
+using spark_rapids_tpu::translate;
+
+#ifndef SRT_EMBED_JAX
+
+extern "C" {
+int32_t srt_jax_available(void) { return 0; }
+srt_status srt_jax_init(void) {
+  return translate([] {
+    throw srt_error(SRT_ERR_INVALID,
+                    "built without SRT_EMBED_JAX: no device runtime");
+  });
+}
+srt_status srt_jax_platform(char*, int64_t) { return srt_jax_init(); }
+srt_status srt_jax_table_op(const char*, const int32_t*, const int32_t*,
+                            int32_t, const srt_handle*, const srt_handle*,
+                            int64_t, int32_t, int32_t*, int32_t*, int32_t*,
+                            srt_handle*, srt_handle*, int64_t*) {
+  return srt_jax_init();
+}
+}
+
+#else  // SRT_EMBED_JAX
+
+namespace {
+
+struct Runtime {
+  std::mutex mu;
+  bool initialized = false;
+  bool owns_interpreter = false;
+  PyObject* bridge = nullptr;  // spark_rapids_jni_tpu.runtime_bridge
+};
+
+Runtime& runtime() {
+  static Runtime r;
+  return r;
+}
+
+/* RAII GIL acquisition for entry points after init. */
+class GilGuard {
+ public:
+  GilGuard() : state_(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+/* Render the pending Python exception into an srt_error. */
+[[noreturn]] void throw_python_error(const char* where) {
+  std::string msg = std::string(where) + ": python error";
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = std::string(where) + ": " + c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  throw srt_error(SRT_ERR_UNKNOWN, msg);
+}
+
+void start_interpreter() {
+  PyConfig config;
+  PyConfig_InitPythonConfig(&config);
+  const char* exe = std::getenv("SRT_PYTHON_EXECUTABLE");
+#ifdef SRT_PYTHON_DEFAULT
+  if (exe == nullptr || exe[0] == '\0') exe = SRT_PYTHON_DEFAULT;
+#endif
+  if (exe != nullptr && exe[0] != '\0') {
+    PyConfig_SetBytesString(&config, &config.program_name, exe);
+  }
+  PyStatus status = Py_InitializeFromConfig(&config);
+  PyConfig_Clear(&config);
+  if (PyStatus_Exception(status)) {
+    throw srt_error(SRT_ERR_UNKNOWN,
+                    std::string("python init failed: ") +
+                        (status.err_msg ? status.err_msg : "?"));
+  }
+}
+
+void add_pythonpath_entries() {
+  const char* extra = std::getenv("SRT_PYTHONPATH");
+  if (extra == nullptr || extra[0] == '\0') return;
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  if (sys_path == nullptr) throw_python_error("sys.path");
+  std::string all(extra);
+  size_t start = 0;
+  while (start <= all.size()) {
+    size_t end = all.find(':', start);
+    if (end == std::string::npos) end = all.size();
+    std::string entry = all.substr(start, end - start);
+    if (!entry.empty()) {
+      PyObject* s = PyUnicode_FromString(entry.c_str());
+      if (s == nullptr) throw_python_error("path entry");
+      PyList_Insert(sys_path, 0, s);
+      Py_DECREF(s);
+    }
+    start = end + 1;
+  }
+}
+
+void ensure_init() {
+  Runtime& rt = runtime();
+  std::lock_guard<std::mutex> lock(rt.mu);
+  if (rt.initialized) return;
+  if (Py_IsInitialized() == 0) {
+    start_interpreter();
+    rt.owns_interpreter = true;
+    /* From here the GIL must be released on EVERY exit — a throw that
+     * kept it held would deadlock every later call on other threads
+     * (and a same-thread retry takes the JOIN branch below, whose
+     * GilGuard only balances its own Ensure). */
+    try {
+      add_pythonpath_entries();
+      PyObject* mod =
+          PyImport_ImportModule("spark_rapids_jni_tpu.runtime_bridge");
+      if (mod == nullptr) throw_python_error("import runtime_bridge");
+      rt.bridge = mod;
+      rt.initialized = true;
+    } catch (...) {
+      PyEval_SaveThread();
+      throw;
+    }
+    PyEval_SaveThread();
+  } else {
+    GilGuard gil;
+    add_pythonpath_entries();
+    PyObject* mod =
+        PyImport_ImportModule("spark_rapids_jni_tpu.runtime_bridge");
+    if (mod == nullptr) throw_python_error("import runtime_bridge");
+    rt.bridge = mod;
+    rt.initialized = true;
+  }
+}
+
+PyObject* bridge_attr(const char* name) {
+  PyObject* fn = PyObject_GetAttrString(runtime().bridge, name);
+  if (fn == nullptr) throw_python_error(name);
+  return fn;
+}
+
+/* bytes-or-None from a registry handle (0 = None). */
+PyObject* buffer_to_py(srt_handle h) {
+  if (h == 0) Py_RETURN_NONE;
+  void* data = srt_buffer_data(h);
+  int64_t size = srt_buffer_size(h);
+  expects(data != nullptr && size >= 0, SRT_ERR_HANDLE,
+          "unknown buffer handle in table op");
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(size));
+  if (bytes == nullptr) throw_python_error("buffer bytes");
+  return bytes;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t srt_jax_available(void) { return 1; }
+
+srt_status srt_jax_init(void) {
+  return translate([] { ensure_init(); });
+}
+
+srt_status srt_jax_platform(char* buf, int64_t buflen) {
+  return translate([&] {
+    expects(buf != nullptr && buflen > 0, SRT_ERR_NULLPTR, "null buffer");
+    ensure_init();
+    GilGuard gil;
+    PyObject* fn = bridge_attr("platform");
+    PyObject* res = PyObject_CallNoArgs(fn);
+    Py_DECREF(fn);
+    if (res == nullptr) throw_python_error("platform()");
+    const char* name = PyUnicode_AsUTF8(res);
+    if (name == nullptr) {
+      Py_DECREF(res);
+      throw_python_error("platform() result");
+    }
+    std::strncpy(buf, name, static_cast<size_t>(buflen - 1));
+    buf[buflen - 1] = '\0';
+    Py_DECREF(res);
+  });
+}
+
+srt_status srt_jax_table_op(
+    const char* op_json, const int32_t* type_ids, const int32_t* scales,
+    int32_t num_columns, const srt_handle* col_data,
+    const srt_handle* col_valid, int64_t num_rows, int32_t max_out_columns,
+    int32_t* out_type_ids, int32_t* out_scales, int32_t* out_num_columns,
+    srt_handle* out_col_data, srt_handle* out_col_valid,
+    int64_t* out_num_rows) {
+  return translate([&] {
+    expects(op_json != nullptr, SRT_ERR_NULLPTR, "null op_json");
+    expects(num_columns >= 0, SRT_ERR_INVALID, "negative column count");
+    expects(num_columns == 0 ||
+                (type_ids != nullptr && scales != nullptr &&
+                 col_data != nullptr && col_valid != nullptr),
+            SRT_ERR_NULLPTR, "null column arrays");
+    expects(out_type_ids != nullptr && out_scales != nullptr &&
+                out_num_columns != nullptr && out_col_data != nullptr &&
+                out_col_valid != nullptr && out_num_rows != nullptr,
+            SRT_ERR_NULLPTR, "null output arrays");
+    ensure_init();
+    GilGuard gil;
+
+    PyObject* t_ids = nullptr;
+    PyObject* t_scales = nullptr;
+    PyObject* datas = nullptr;
+    PyObject* valids = nullptr;
+    PyObject* res = nullptr;
+    try {
+      t_ids = PyList_New(num_columns);
+      t_scales = PyList_New(num_columns);
+      datas = PyList_New(num_columns);
+      valids = PyList_New(num_columns);
+      expects(t_ids != nullptr && t_scales != nullptr &&
+                  datas != nullptr && valids != nullptr,
+              SRT_ERR_UNKNOWN, "argument list allocation failed");
+      for (int32_t i = 0; i < num_columns; ++i) {
+        PyObject* id_obj = PyLong_FromLong(type_ids[i]);
+        PyObject* sc_obj = PyLong_FromLong(scales[i]);
+        expects(id_obj != nullptr && sc_obj != nullptr, SRT_ERR_UNKNOWN,
+                "int allocation failed");
+        PyList_SET_ITEM(t_ids, i, id_obj);
+        PyList_SET_ITEM(t_scales, i, sc_obj);
+        PyList_SET_ITEM(datas, i, buffer_to_py(col_data[i]));
+        PyList_SET_ITEM(valids, i, buffer_to_py(col_valid[i]));
+      }
+      PyObject* fn = bridge_attr("table_op_wire");
+      res = PyObject_CallFunction(
+          fn, "sOOOOL", op_json, t_ids, t_scales, datas, valids,
+          static_cast<long long>(num_rows));
+      Py_DECREF(fn);
+      if (res == nullptr) throw_python_error("table_op_wire");
+    } catch (...) {
+      Py_XDECREF(t_ids);
+      Py_XDECREF(t_scales);
+      Py_XDECREF(datas);
+      Py_XDECREF(valids);
+      if (PyErr_Occurred()) PyErr_Clear();
+      throw;
+    }
+    Py_DECREF(t_ids);
+    Py_DECREF(t_scales);
+    Py_DECREF(datas);
+    Py_DECREF(valids);
+
+    /* result: (type_ids, scales, datas, valids, num_rows) — validate
+     * the whole shape before touching anything, so a malformed bridge
+     * result is an error, never SRT_OK with garbage counts */
+    if (!PyTuple_Check(res) || PyTuple_GET_SIZE(res) != 5) {
+      Py_DECREF(res);
+      throw srt_error(SRT_ERR_UNKNOWN, "table_op_wire: bad result shape");
+    }
+    PyObject* r_ids = PyTuple_GET_ITEM(res, 0);
+    PyObject* r_scales = PyTuple_GET_ITEM(res, 1);
+    PyObject* r_datas = PyTuple_GET_ITEM(res, 2);
+    PyObject* r_valids = PyTuple_GET_ITEM(res, 3);
+    PyObject* r_rows = PyTuple_GET_ITEM(res, 4);
+    if (!PyList_Check(r_ids) || !PyList_Check(r_scales) ||
+        !PyList_Check(r_datas) || !PyList_Check(r_valids) ||
+        !PyLong_Check(r_rows)) {
+      Py_DECREF(res);
+      throw srt_error(SRT_ERR_UNKNOWN, "table_op_wire: bad result types");
+    }
+    Py_ssize_t n_out = PyList_GET_SIZE(r_ids);
+    if (PyList_GET_SIZE(r_scales) != n_out ||
+        PyList_GET_SIZE(r_datas) != n_out ||
+        PyList_GET_SIZE(r_valids) != n_out) {
+      Py_DECREF(res);
+      throw srt_error(SRT_ERR_UNKNOWN,
+                      "table_op_wire: ragged result lists");
+    }
+    if (n_out > max_out_columns) {
+      Py_DECREF(res);
+      throw srt_error(SRT_ERR_OVERFLOW,
+                      "result has more columns than max_out_columns");
+    }
+    /* Create all output buffers, releasing on partial failure so the
+     * registry never leaks (the RowConversion.java cleanup discipline). */
+    std::vector<srt_handle> created;
+    created.reserve(static_cast<size_t>(2 * n_out));
+    try {
+      for (Py_ssize_t i = 0; i < n_out; ++i) {
+        PyObject* d = PyList_GetItem(r_datas, i);
+        PyObject* v = PyList_GetItem(r_valids, i);
+        PyObject* id_obj = PyList_GetItem(r_ids, i);
+        PyObject* sc_obj = PyList_GetItem(r_scales, i);
+        expects(id_obj != nullptr && PyLong_Check(id_obj) &&
+                    sc_obj != nullptr && PyLong_Check(sc_obj),
+                SRT_ERR_UNKNOWN, "table_op_wire: non-int id/scale");
+        expects(d != nullptr && PyBytes_Check(d), SRT_ERR_UNKNOWN,
+                "table_op_wire: data not bytes");
+        srt_handle hd = srt_buffer_create(
+            PyBytes_AS_STRING(d), PyBytes_GET_SIZE(d), "jax-op-out");
+        expects(hd != 0, SRT_ERR_UNKNOWN, "buffer create failed");
+        created.push_back(hd);
+        srt_handle hv = 0;
+        if (v != nullptr && v != Py_None) {
+          expects(PyBytes_Check(v), SRT_ERR_UNKNOWN,
+                  "table_op_wire: validity not bytes");
+          hv = srt_buffer_create(PyBytes_AS_STRING(v),
+                                 PyBytes_GET_SIZE(v), "jax-op-out-valid");
+          expects(hv != 0, SRT_ERR_UNKNOWN, "buffer create failed");
+          created.push_back(hv);
+        }
+        out_type_ids[i] = static_cast<int32_t>(PyLong_AsLong(id_obj));
+        out_scales[i] = static_cast<int32_t>(PyLong_AsLong(sc_obj));
+        out_col_data[i] = hd;
+        out_col_valid[i] = hv;
+      }
+    } catch (...) {
+      for (srt_handle h : created) srt_buffer_release(h);
+      Py_DECREF(res);
+      throw;
+    }
+    *out_num_columns = static_cast<int32_t>(n_out);
+    *out_num_rows = static_cast<int64_t>(PyLong_AsLongLong(r_rows));
+    Py_DECREF(res);
+  });
+}
+
+}  // extern "C"
+
+#endif  // SRT_EMBED_JAX

@@ -127,6 +127,46 @@ SRT_EXPORT int64_t srt_live_handle_count(void);
  * buf; returns the number of bytes that would be required. */
 SRT_EXPORT int64_t srt_leak_report(char* buf, int64_t buflen);
 
+/* ---- embedded JAX device runtime --------------------------------------
+ * The device-dispatch layer the reference reaches through
+ * `cudf::jni::auto_set_device` + direct kernel calls
+ * (RowConversionJni.cpp:24-66). Here the native library hosts (or, when
+ * the calling process is already Python, joins) a CPython interpreter
+ * running the JAX/XLA compute stack, so ANY embedder — the JNI bridge, a
+ * C program, a Spark executor — can run table ops on the TPU through
+ * this .so. Available when built with SRT_EMBED_JAX (CMake finds
+ * libpython); otherwise these return SRT_ERR_INVALID. */
+
+/* Initialize the runtime (idempotent, thread-safe). Joins an existing
+ * in-process interpreter if one is live (ctypes embedders); otherwise
+ * starts one, resolving the Python home from SRT_PYTHON_EXECUTABLE or
+ * the build-time default. */
+SRT_EXPORT srt_status srt_jax_init(void);
+
+/* 1 when built with SRT_EMBED_JAX, else 0. */
+SRT_EXPORT int32_t srt_jax_available(void);
+
+/* Write the active JAX backend platform name ("tpu", "cpu", ...). */
+SRT_EXPORT srt_status srt_jax_platform(char* buf, int64_t buflen);
+
+/* Generic device table op. `op_json` selects and parameterizes the op
+ * (see spark_rapids_jni_tpu/runtime_bridge.py for the op vocabulary:
+ * groupby / sort_by / to_rows / from_rows / filter). Input columns
+ * arrive as registry handles over little-endian fixed-width host
+ * buffers (col_valid[i] = 0 for a non-null column; otherwise a handle
+ * to num_rows 0/1 bytes), with the (type id, scale) wire arrays of the
+ * reference JNI (RowConversionJni.cpp:56-61). Output columns are
+ * freshly created registry handles the CALLER owns; *out_num_columns
+ * reports how many were written (capacity: max_out_columns).
+ * out_col_valid[i] is 0 when the output column has no nulls. */
+SRT_EXPORT srt_status srt_jax_table_op(
+    const char* op_json, const int32_t* type_ids, const int32_t* scales,
+    int32_t num_columns, const srt_handle* col_data,
+    const srt_handle* col_valid, int64_t num_rows,
+    int32_t max_out_columns, int32_t* out_type_ids, int32_t* out_scales,
+    int32_t* out_num_columns, srt_handle* out_col_data,
+    srt_handle* out_col_valid, int64_t* out_num_rows);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

@@ -1,0 +1,138 @@
+/* JNI bridge: com.nvidia.spark.rapids.jni.DeviceTable native methods.
+ *
+ * The device-compute entry the reference exposes per-op
+ * (RowConversionJni.cpp:24-66 calling device kernels directly). Here one
+ * generic thunk carries every table op into the embedded JAX runtime
+ * (src/cpp/jax_runtime.cpp): the JVM passes registry buffer handles plus
+ * the (type id, scale) wire arrays, and receives freshly-owned handles
+ * for the result columns computed on the XLA backend — so a Spark
+ * executor thread reaches the TPU through this .so exactly the way a
+ * CUDA executor reaches the GPU through libspark_rapids_jni.so.
+ *
+ * Wire contract (see java/.../DeviceTable.java):
+ *   tableOpNative(String opJson, int[] typeIds, int[] scales,
+ *                 long[] colData, long[] colValid, long numRows)
+ *       -> long[]: [numOutCols, outNumRows,
+ *                   outTypeIds..., outScales...,
+ *                   outDataHandles..., outValidHandles...]
+ * (a single jlongArray return keeps the JNI surface one call; 0 in
+ * outValidHandles means the column has no nulls). Compiles only when
+ * CMake finds a JDK (SRT_HAVE_JNI). */
+
+#ifdef SRT_HAVE_JNI
+
+#include <jni.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "spark_rapids_tpu/c_api.h"
+
+namespace {
+
+void throw_java_dt(JNIEnv* env, const std::string& msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, msg.c_str());
+}
+
+constexpr int32_t kMaxOutColumns = 256;
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jboolean JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_isDeviceRuntimeAvailable(
+    JNIEnv*, jclass) {
+  return srt_jax_available() == 1 ? JNI_TRUE : JNI_FALSE;
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_initDeviceRuntime(
+    JNIEnv* env, jclass) {
+  if (srt_jax_init() != SRT_OK) throw_java_dt(env, srt_last_error());
+}
+
+JNIEXPORT jstring JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_devicePlatform(
+    JNIEnv* env, jclass) {
+  char buf[64] = {0};
+  if (srt_jax_platform(buf, sizeof buf) != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+    return nullptr;
+  }
+  return env->NewStringUTF(buf);
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+    JNIEnv* env, jclass, jstring op_json_j, jintArray type_ids_j,
+    jintArray scales_j, jlongArray col_data_j, jlongArray col_valid_j,
+    jlong num_rows) {
+  if (op_json_j == nullptr || type_ids_j == nullptr ||
+      scales_j == nullptr || col_data_j == nullptr ||
+      col_valid_j == nullptr) {
+    throw_java_dt(env, "null argument to tableOpNative");
+    return nullptr;
+  }
+  jsize num_cols = env->GetArrayLength(type_ids_j);
+  if (env->GetArrayLength(scales_j) != num_cols ||
+      env->GetArrayLength(col_data_j) != num_cols ||
+      env->GetArrayLength(col_valid_j) != num_cols) {
+    throw_java_dt(env, "column array length mismatch");
+    return nullptr;
+  }
+  std::vector<int32_t> type_ids(num_cols), scales(num_cols);
+  std::vector<int64_t> col_data(num_cols), col_valid(num_cols);
+  env->GetIntArrayRegion(type_ids_j, 0, num_cols, type_ids.data());
+  env->GetIntArrayRegion(scales_j, 0, num_cols, scales.data());
+  env->GetLongArrayRegion(col_data_j, 0, num_cols, col_data.data());
+  env->GetLongArrayRegion(col_valid_j, 0, num_cols, col_valid.data());
+
+  const char* op_json = env->GetStringUTFChars(op_json_j, nullptr);
+  if (op_json == nullptr) return nullptr; /* OOM already thrown */
+
+  int32_t out_ids[kMaxOutColumns];
+  int32_t out_scales[kMaxOutColumns];
+  srt_handle out_data[kMaxOutColumns];
+  srt_handle out_valid[kMaxOutColumns];
+  int32_t out_cols = 0;
+  int64_t out_rows = 0;
+  srt_status s = srt_jax_table_op(
+      op_json, type_ids.data(), scales.data(), num_cols, col_data.data(),
+      col_valid.data(), num_rows, kMaxOutColumns, out_ids, out_scales,
+      &out_cols, out_data, out_valid, &out_rows);
+  env->ReleaseStringUTFChars(op_json_j, op_json);
+  if (s != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+    return nullptr;
+  }
+
+  /* [numOutCols, outNumRows, ids..., scales..., data..., valid...] */
+  std::vector<jlong> packed(2 + 4 * static_cast<size_t>(out_cols));
+  packed[0] = out_cols;
+  packed[1] = out_rows;
+  for (int32_t i = 0; i < out_cols; ++i) {
+    packed[2 + i] = out_ids[i];
+    packed[2 + out_cols + i] = out_scales[i];
+    packed[2 + 2 * out_cols + i] = out_data[i];
+    packed[2 + 3 * out_cols + i] = out_valid[i];
+  }
+  jlongArray result = env->NewLongArray(static_cast<jsize>(packed.size()));
+  if (result == nullptr) {
+    /* allocation failed: the result handles would leak in the registry */
+    for (int32_t i = 0; i < out_cols; ++i) {
+      srt_buffer_release(out_data[i]);
+      if (out_valid[i] != 0) srt_buffer_release(out_valid[i]);
+    }
+    return nullptr;
+  }
+  env->SetLongArrayRegion(result, 0, static_cast<jsize>(packed.size()),
+                          packed.data());
+  return result;
+}
+
+}  // extern "C"
+
+#endif /* SRT_HAVE_JNI */

@@ -1,0 +1,64 @@
+/* Minimal clean-room JNI ABI surface for SYNTAX-CHECKING the bridge
+ * sources in images without a JDK (tests/test_jni_compile.py).
+ *
+ * This is NOT a JNI implementation and is never linked into anything:
+ * it declares just enough of the stable JNI ABI (types + the JNIEnv
+ * member functions the bridge files call) for `g++ -fsyntax-only` to
+ * typecheck the src/jni sources. Real builds use the JDK's jni.h (CMake's
+ * find_package(JNI)); this stub is deliberately last on the include
+ * path and guarded so it can never shadow a real JDK header.
+ *
+ * Written from the public JNI specification's type/function list; no
+ * JDK header text was copied. */
+#ifndef SRT_JNI_STUB_H
+#define SRT_JNI_STUB_H
+
+#ifdef __cplusplus
+
+#include <cstdint>
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+#define JNI_TRUE 1
+#define JNI_FALSE 0
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef uint8_t jboolean;
+typedef jint jsize;
+
+class _jobject {};
+typedef _jobject* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jbyteArray;
+typedef jarray jintArray;
+typedef jarray jlongArray;
+typedef jobject jthrowable;
+
+struct JNIEnv {
+  jclass FindClass(const char* name);
+  jint ThrowNew(jclass cls, const char* msg);
+  jsize GetArrayLength(jarray array);
+  void GetByteArrayRegion(jbyteArray array, jsize start, jsize len,
+                          jbyte* buf);
+  void GetIntArrayRegion(jintArray array, jsize start, jsize len,
+                         jint* buf);
+  void GetLongArrayRegion(jlongArray array, jsize start, jsize len,
+                          jlong* buf);
+  void SetByteArrayRegion(jbyteArray array, jsize start, jsize len,
+                          const jbyte* buf);
+  void SetLongArrayRegion(jlongArray array, jsize start, jsize len,
+                          const jlong* buf);
+  jbyteArray NewByteArray(jsize len);
+  jlongArray NewLongArray(jsize len);
+  jstring NewStringUTF(const char* utf);
+  const char* GetStringUTFChars(jstring str, jboolean* is_copy);
+  void ReleaseStringUTFChars(jstring str, const char* chars);
+};
+
+#endif /* __cplusplus */
+
+#endif /* SRT_JNI_STUB_H */

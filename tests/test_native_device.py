@@ -1,0 +1,181 @@
+"""Native -> device compute path tests (VERDICT r1 item 1).
+
+The reference's whole purpose is foreign callers reaching device
+kernels through the native library (RowConversionJni.cpp:24-66). These
+tests drive that path here: the C ABI's embedded JAX runtime
+(src/cpp/jax_runtime.cpp) dispatching table ops to the XLA backend —
+once through ctypes (the library JOINS this interpreter: identical
+native code to a JVM call, minus startup), and once as a PURE NATIVE
+process (build/native_demo, C++ with no Python until the library hosts
+one — the RowConversionTest.java analog for the native->TPU stack).
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available() or not native.jax_runtime_available(),
+    reason="native library with embedded JAX runtime not built",
+)
+
+
+def _wire(arr: np.ndarray) -> int:
+    return native.buffer_create(arr.tobytes(), "test-in")
+
+
+class TestCtypesDeviceDispatch:
+    def test_init_and_platform(self):
+        native.jax_init()
+        assert native.jax_platform() in ("cpu", "tpu", "axon")
+
+    def test_groupby_on_device_matches_oracle(self):
+        rng = np.random.default_rng(11)
+        n = 500
+        k = rng.integers(0, 20, n).astype(np.int64)
+        v = rng.standard_normal(n)
+        hk, hv = _wire(k), _wire(v)
+        try:
+            op = json.dumps(
+                {
+                    "op": "groupby",
+                    "by": [0],
+                    "aggs": [
+                        {"column": 1, "agg": "sum"},
+                        {"column": 1, "agg": "count"},
+                    ],
+                }
+            )
+            ids = [dt.TypeId.INT64.value, dt.TypeId.FLOAT64.value]
+            out_ids, out_s, out_d, out_v, out_n = native.jax_table_op(
+                op, ids, [0, 0], [hk, hv], [None, None], n
+            )
+            assert out_n == len(np.unique(k))
+            keys = np.frombuffer(
+                native.buffer_bytes(out_d[0]), np.int64, out_n
+            )
+            sums = np.frombuffer(
+                native.buffer_bytes(out_d[1]), np.float64, out_n
+            )
+            got = dict(zip(keys.tolist(), sums.tolist()))
+            want = {int(u): float(v[k == u].sum()) for u in np.unique(k)}
+            assert set(got) == set(want)
+            for u in want:
+                assert got[u] == pytest.approx(want[u], rel=1e-12)
+        finally:
+            for h in [hk, hv, *out_d, *[x for x in out_v if x]]:
+                native.buffer_release(h)
+
+    def test_row_roundtrip_through_device(self):
+        """to_rows on device -> from_rows on device -> original columns,
+        all initiated through the C ABI."""
+        n = 96
+        a = np.arange(n, dtype=np.int64) * 3 - 7
+        b = (np.arange(n) % 2).astype(np.int32)
+        bv = (np.arange(n) % 5 != 0).astype(np.uint8)
+        ids = [dt.TypeId.INT64.value, dt.TypeId.INT32.value]
+        ha, hb, hbv = _wire(a), _wire(b), _wire(bv)
+        handles = [ha, hb, hbv]
+        try:
+            _, _, rd, rv, nbytes = native.jax_table_op(
+                json.dumps({"op": "to_rows"}),
+                ids,
+                [0, 0],
+                [ha, hb],
+                [None, hbv],
+                n,
+            )
+            handles += [rd[0], *[x for x in rv if x]]
+            back_op = json.dumps(
+                {
+                    "op": "from_rows",
+                    "type_ids": ids,
+                    "scales": [0, 0],
+                    "num_rows": n,
+                }
+            )
+            out_ids, _, od, ov, on = native.jax_table_op(
+                back_op,
+                [dt.TypeId.UINT8.value],
+                [0],
+                [rd[0]],
+                [None],
+                nbytes,
+            )
+            handles += [*od, *[x for x in ov if x]]
+            assert on == n and out_ids == ids
+            aa = np.frombuffer(native.buffer_bytes(od[0]), np.int64, n)
+            bb = np.frombuffer(native.buffer_bytes(od[1]), np.int32, n)
+            np.testing.assert_array_equal(aa, a)
+            vb = np.frombuffer(native.buffer_bytes(ov[1]), np.uint8, n)
+            np.testing.assert_array_equal(vb, bv)
+            np.testing.assert_array_equal(bb[vb == 1], b[bv == 1])
+        finally:
+            for h in handles:
+                native.buffer_release(h)
+
+    def test_sort_on_device(self):
+        rng = np.random.default_rng(5)
+        x = rng.permutation(200).astype(np.int64)
+        hx = _wire(x)
+        try:
+            _, _, od, ov, on = native.jax_table_op(
+                json.dumps(
+                    {"op": "sort_by", "keys": [{"column": 0}]}
+                ),
+                [dt.TypeId.INT64.value],
+                [0],
+                [hx],
+                [None],
+                200,
+            )
+            got = np.frombuffer(native.buffer_bytes(od[0]), np.int64, on)
+            np.testing.assert_array_equal(got, np.sort(x))
+        finally:
+            for h in [hx, *od, *[v for v in ov if v]]:
+                native.buffer_release(h)
+
+    def test_bad_op_reports_error(self):
+        hx = _wire(np.arange(4, dtype=np.int64))
+        try:
+            with pytest.raises(RuntimeError, match="unknown table op"):
+                native.jax_table_op(
+                    json.dumps({"op": "nonsense"}),
+                    [dt.TypeId.INT64.value],
+                    [0],
+                    [hx],
+                    [None],
+                    4,
+                )
+        finally:
+            native.buffer_release(hx)
+
+
+class TestPureNativeCaller:
+    def test_native_demo_binary(self):
+        """C++ process with no Python: the library hosts the interpreter
+        and runs groupby + device row transpose on the XLA backend."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        demo = os.path.join(repo, "build", "native_demo")
+        if not os.path.exists(demo):
+            pytest.skip("native_demo not built")
+        env = dict(os.environ)
+        env["SRT_PYTHONPATH"] = repo
+        # the subprocess owns its interpreter; keep it on the CPU backend
+        # (tiny shapes, no TPU contention from the test tier)
+        env["JAX_PLATFORMS"] = "cpu"
+        res = subprocess.run(
+            [demo],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "native_demo: ok" in res.stdout
