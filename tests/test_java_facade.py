@@ -108,7 +108,9 @@ def test_every_native_method_has_a_bridge_symbol():
     jni_src = ""
     jni_dir = os.path.join(REPO, "src", "jni")
     for f in os.listdir(jni_dir):
-        jni_src += _read(os.path.join(jni_dir, f))
+        path = os.path.join(jni_dir, f)
+        if os.path.isfile(path):
+            jni_src += _read(path)
     natives = _native_methods()
     assert natives, "no native methods found in the Java tree"
     for fqcn, method in natives:
