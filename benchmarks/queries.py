@@ -74,9 +74,9 @@ def q5_distributed(tables: dict, mesh, date_lo=100, date_hi=200):
     joined = ops.inner_join(allsales, tables["item"], ["item_sk"])
     rev = ops.mul(joined["quantity"], joined["sales_price"])
     with_rev = Table([*joined.columns, rev], [*joined.names, "revenue"])
-    # pad rows to a multiple of the mesh size for sharding; capacity =
-    # a full local shard (12 categories over the mesh is maximally
-    # skewed: one destination may receive everything a device holds)
+    # pad rows to a multiple of the mesh size for sharding; the
+    # ragged-compact exchange auto-plans its buffer from the real
+    # per-destination totals (12 categories = maximal skew is fine)
     padded = _pad_to_mesh(with_rev, mesh)
     return distributed_groupby(
         padded,
@@ -87,7 +87,6 @@ def q5_distributed(tables: dict, mesh, date_lo=100, date_hi=200):
             GroupbyAgg("revenue", "count"),
         ],
         mesh,
-        capacity=_full_shard_capacity(padded, mesh),
     )
 
 
@@ -121,7 +120,6 @@ def q23_distributed(tables: dict, mesh, min_count: int = 4):
         ["item_sk"],
         [GroupbyAgg("item_sk", "count")],
         mesh,
-        capacity=_full_shard_capacity(sales_padded, mesh),
     )
     # gather the (small) hot-item list to every chip, host-side finish
     freq = _unpad_groupby(freq_padded, counts)
@@ -215,14 +213,6 @@ def q64_distributed(tables: dict, mesh, max_price: float = 150.0):
 # ---------------------------------------------------------------------------
 
 _PAD_KEY = np.int64(-(2**62))
-
-
-def _full_shard_capacity(padded: Table, mesh) -> int:
-    """Per-(src,dst) exchange capacity that can never overflow: one
-    device's whole local shard (the worst case when hash partitioning is
-    fully skewed to a single destination)."""
-    num = int(np.prod(list(mesh.shape.values())))
-    return max(padded.row_count // num, 1)
 
 
 def _pad_to_mesh(table: Table, mesh) -> Table:

@@ -11,9 +11,12 @@ from .mesh import make_mesh, shard_table, replicate_table, local_shards
 from .shuffle import (
     ShuffleOverflowError,
     exchange,
+    exchange_ragged,
     partition_counts,
     plan_capacity,
     shuffle_table,
+    shuffle_table_compact,
+    total_recv_capacity,
 )
 from .distributed import (
     GroupOverflowError,
@@ -28,9 +31,12 @@ __all__ = [
     "replicate_table",
     "local_shards",
     "exchange",
+    "exchange_ragged",
     "partition_counts",
     "plan_capacity",
     "shuffle_table",
+    "shuffle_table_compact",
+    "total_recv_capacity",
     "ShuffleOverflowError",
     "GroupOverflowError",
     "JoinOverflowError",

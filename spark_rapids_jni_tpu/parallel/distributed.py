@@ -32,10 +32,12 @@ from ..ops.groupby import GroupbyAgg, groupby_aggregate_capped
 from ..ops.join import inner_join_capped, inner_join_count
 from .mesh import SHUFFLE_AXIS, shard_map, shard_table
 from .shuffle import (
+    _ragged_impl,
     _round_capacity,
-    check_overflow,
-    exchange_by_hash,
-    plan_capacity,
+    check_overflow_compact,
+    exchange_ragged_by_hash,
+    partition_counts,
+    total_recv_capacity,
     validate_on_overflow,
 )
 
@@ -66,20 +68,27 @@ def distributed_groupby(
 
     Returns (sharded padded result table, per-device group counts (P,),
     per-device shuffle overflow (P,)). Groups are complete: each key lives
-    on exactly one device, by Spark hash partitioning. ``capacity=None``
-    auto-plans from the real partition counts (lossless); an explicit
-    undersized ``capacity`` or ``groups_per_device`` raises unless
+    on exactly one device, by Spark hash partitioning. The exchange is
+    ragged-compact (shuffle.exchange_ragged): each device materializes
+    ``capacity`` rows total — the hottest destination's real row count —
+    not P x the hottest (src, dst) pair. ``capacity=None`` auto-plans
+    from the real partition counts (lossless); an explicit undersized
+    ``capacity`` or ``groups_per_device`` raises unless
     ``on_overflow="allow"``.
     """
     validate_on_overflow(on_overflow)
-    num = int(mesh.shape[axis])
+    impl = _ragged_impl(None)
     sharded = shard_table(table, mesh, axis)
-    cap = capacity or plan_capacity(sharded, by, mesh, axis)
+    counts = partition_counts(sharded, by, mesh, axis)
+    cap = capacity or total_recv_capacity(counts)
+    pair_cap = _round_capacity(int(jnp.max(counts)))
     # a device can't see more groups than the rows it receives
-    seg_cap = groups_per_device or num * cap
+    seg_cap = groups_per_device or cap
 
-    def body(local: Table):
-        shuffled, occ, overflow = exchange_by_hash(local, by, num, cap, axis)
+    def body(local: Table, C):
+        shuffled, occ, overflow = exchange_ragged_by_hash(
+            local, by, C, cap, axis, impl, pair_capacity=pair_cap
+        )
         agg, ngroups = groupby_aggregate_capped(
             shuffled, by, aggs, num_segments=seg_cap, row_valid=occ
         )
@@ -88,13 +97,13 @@ def distributed_groupby(
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=P(axis),
+        in_specs=(P(axis), P()),
         out_specs=P(axis),
         check_vma=False,
     )
-    agg, ngroups, overflow = fn(sharded)
+    agg, ngroups, overflow = fn(sharded, counts)
     if on_overflow == "raise":
-        check_overflow(overflow, cap, "groupby")
+        check_overflow_compact(overflow, cap, "groupby")
         worst_groups = int(jnp.max(ngroups))
         if worst_groups > seg_cap:
             raise GroupOverflowError(
@@ -121,24 +130,35 @@ def distributed_inner_join(
     each chip joins its partitions locally. Returns (sharded padded join
     output, per-device match counts, left/right shuffle overflows).
 
-    ``capacity=None`` plans both exchanges exactly; ``out_capacity=None``
-    counts matches on the co-partitioned shards and sizes the output to
-    the real per-device maximum (two-phase sizing). Each side crosses the
-    ICI exactly once — the count pass and the materialize pass share the
-    shuffled, device-resident shards. Explicit undersized values raise
-    unless ``on_overflow="allow"``.
+    ``capacity=None`` plans both exchanges exactly (ragged-compact:
+    per-device buffers are the real received row totals, and the planning
+    bincount doubles as the ragged-offset table — one planning pass per
+    side, not two); ``out_capacity=None`` counts matches on the
+    co-partitioned shards and sizes the output to the real per-device
+    maximum (two-phase sizing). Each side crosses the ICI exactly once —
+    the count pass and the materialize pass share the shuffled,
+    device-resident shards. Explicit undersized values raise unless
+    ``on_overflow="allow"``.
     """
     validate_on_overflow(on_overflow)
-    num = int(mesh.shape[axis])
+    impl = _ragged_impl(None)
     lsh = shard_table(left, mesh, axis)
     rsh = shard_table(right, mesh, axis)
-    lcap = capacity or plan_capacity(lsh, on, mesh, axis)
-    rcap = capacity or plan_capacity(rsh, on, mesh, axis)
+    lcounts = partition_counts(lsh, on, mesh, axis)
+    rcounts = partition_counts(rsh, on, mesh, axis)
+    lcap = capacity or total_recv_capacity(lcounts)
+    rcap = capacity or total_recv_capacity(rcounts)
+    lpair = _round_capacity(int(jnp.max(lcounts)))
+    rpair = _round_capacity(int(jnp.max(rcounts)))
     count_pass = out_capacity is None
 
-    def exchange_body(l_local: Table, r_local: Table):
-        ls, locc, lov = exchange_by_hash(l_local, on, num, lcap, axis)
-        rs, rocc, rov = exchange_by_hash(r_local, on, num, rcap, axis)
+    def exchange_body(l_local: Table, r_local: Table, lC, rC):
+        ls, locc, lov = exchange_ragged_by_hash(
+            l_local, on, lC, lcap, axis, impl, pair_capacity=lpair
+        )
+        rs, rocc, rov = exchange_ragged_by_hash(
+            r_local, on, rC, rcap, axis, impl, pair_capacity=rpair
+        )
         cnt = (
             inner_join_count(ls, rs, on, left_valid=locc, right_valid=rocc)
             if count_pass
@@ -149,14 +169,16 @@ def distributed_inner_join(
     ex_fn = shard_map(
         exchange_body,
         mesh=mesh,
-        in_specs=P(axis),
+        in_specs=(P(axis), P(axis), P(), P()),
         out_specs=P(axis),
         check_vma=False,
     )
-    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = ex_fn(lsh, rsh)
+    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = ex_fn(
+        lsh, rsh, lcounts, rcounts
+    )
     if on_overflow == "raise":
-        check_overflow(lov, lcap, "left join")
-        check_overflow(rov, rcap, "right join")
+        check_overflow_compact(lov, lcap, "left join")
+        check_overflow_compact(rov, rcap, "right join")
     ocap = (
         _round_capacity(int(jnp.max(cnts))) if count_pass else out_capacity
     )

@@ -46,14 +46,32 @@ def validate_on_overflow(on_overflow: str) -> None:
         )
 
 
-def check_overflow(overflow, capacity: int, what: str) -> None:
+def check_overflow(
+    overflow,
+    capacity: int,
+    what: str,
+    unit: str = "rows per (src, dst) pair",
+    remedy: str = "pass capacity=None to auto-plan",
+) -> None:
     """Raise ``ShuffleOverflowError`` if any device reported overflow."""
     worst = int(jnp.max(overflow))
     if worst > 0:
         raise ShuffleOverflowError(
             f"{what} exchange capacity {capacity} undersized by {worst} "
-            f"rows per (src, dst) pair; pass capacity=None to auto-plan"
+            f"{unit}; {remedy}"
         )
+
+
+def check_overflow_compact(overflow, out_size: int, what: str) -> None:
+    """Overflow check for the ragged-compact exchange, whose capacity is
+    the TOTAL per-device receive buffer (not a per-pair slot count)."""
+    check_overflow(
+        overflow,
+        out_size,
+        what,
+        unit="rows in the per-device receive buffer",
+        remedy="pass out_size=None / capacity=None to auto-plan",
+    )
 
 
 def partition_counts(
@@ -171,6 +189,220 @@ def exchange_by_hash(
     """exchange() keyed by Spark hash partitioning of ``columns``."""
     dest = partition_ids_hash(local, columns, num_partitions)
     return exchange(local, dest, num_partitions, capacity, axis, row_valid)
+
+
+def total_recv_capacity(counts) -> int:
+    """Per-device compact-exchange buffer size: the max over destinations
+    of the TOTAL rows received (host sync), rounded. This is the SPMD
+    floor — under a static-shape SPMD program every device materializes
+    the same output shape, so the best possible per-device buffer is the
+    hottest destination's actual row total, NOT num_partitions x the
+    hottest (src, dst) pair (the round-2 skew-OOM failure mode)."""
+    return _round_capacity(int(jnp.max(jnp.sum(counts, axis=0))))
+
+
+def _ragged_impl(impl: Optional[str]) -> str:
+    """Resolve the exchange implementation for the active backend.
+
+    ``ragged`` is the TPU path: one ``jax.lax.ragged_all_to_all``
+    collective moving exactly the real rows over ICI. XLA:CPU does not
+    implement ragged-all-to-all, so the virtual-mesh test tier uses
+    ``dense_compact``: a uniform ``all_to_all`` at per-pair capacity
+    followed by an on-device compaction to the identical ragged layout
+    (same rows, same order — the impls are interchangeable oracle-wise).
+    """
+    if impl is not None:
+        if impl not in ("ragged", "dense_compact"):
+            raise ValueError(f"unknown exchange impl {impl!r}")
+        return impl
+    platform = jax.devices()[0].platform
+    return "ragged" if platform in ("tpu", "axon") else "dense_compact"
+
+
+def exchange_ragged(
+    local: Table,
+    dest: jax.Array,
+    counts: jax.Array,
+    out_size: int,
+    axis: str = SHUFFLE_AXIS,
+    impl: str = "dense_compact",
+    row_valid: Optional[jax.Array] = None,
+    pair_capacity: Optional[int] = None,
+):
+    """Compact all-to-all: each device receives exactly its real rows.
+
+    Must run inside ``shard_map`` over ``axis``. ``counts`` is the global
+    (P, P) per-(src, dst) row-count matrix from :func:`partition_counts`
+    (replicated). The received layout is ragged-compact: ``[src-0 rows |
+    src-1 rows | ...]`` with all padding at the tail — so the per-device
+    buffer is ``out_size`` rows total (sized by
+    :func:`total_recv_capacity`), not ``P x pair_capacity``. Returns
+    (compact table padded to ``out_size`` rows, occupancy mask,
+    overflow = rows received beyond ``out_size``).
+    """
+    num = counts.shape[0]
+    s = jax.lax.axis_index(axis)
+    n = local.row_count
+    ok = (
+        row_valid
+        if row_valid is not None
+        else jnp.ones((n,), dtype=jnp.bool_)
+    )
+    dest = jnp.where(ok, dest, num).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True).astype(jnp.int32)
+    csort = jax.tree_util.tree_map(
+        lambda x: None if x is None else x[order], local
+    )
+
+    C = counts.astype(jnp.int32)
+    send_sizes = C[s]  # (P,)
+    input_offsets = jnp.cumsum(send_sizes) - send_sizes
+    # receiver d lays out sender blocks in src order: sender s's block
+    # starts at sum_{s'<s} C[s', d]
+    output_offsets_all = jnp.cumsum(C, axis=0) - C  # (src, dst)
+    output_offsets = output_offsets_all[s]
+    recv_sizes = C[:, s]
+    n_recv = jnp.sum(recv_sizes)
+
+    if impl == "ragged":
+        # clamp so an explicit undersized out_size can never write out of
+        # bounds; the dropped tail is reported via overflow and raised by
+        # the host wrappers
+        off_c = jnp.minimum(output_offsets, out_size)
+        send_c = jnp.minimum(send_sizes, jnp.maximum(out_size - off_c, 0))
+        recv_off = jnp.minimum(output_offsets_all[:, s], out_size)
+        recv_c = jnp.minimum(
+            recv_sizes, jnp.maximum(out_size - recv_off, 0)
+        )
+
+        def ex(x):
+            if x is None:
+                return None
+            wire = x.astype(jnp.uint8) if x.dtype == jnp.bool_ else x
+            out = jnp.zeros((out_size,) + wire.shape[1:], wire.dtype)
+            r = jax.lax.ragged_all_to_all(
+                wire, out, input_offsets, send_c, off_c, recv_c,
+                axis_name=axis,
+            )
+            return r.astype(x.dtype) if x.dtype == jnp.bool_ else r
+
+        out_tbl = jax.tree_util.tree_map(ex, csort)
+        occupancy = jnp.arange(out_size, dtype=jnp.int32) < n_recv
+        overflow = n_recv - out_size
+        return out_tbl, occupancy, overflow
+
+    # dense_compact: uniform all_to_all at per-pair capacity, then an
+    # on-device compaction to the identical ragged layout (CPU test
+    # tier). The transient (P, pair_cap) buffers shrink to the real
+    # hottest-pair count when the host wrapper threads it through
+    # (pair_capacity from the planning counts); out_size is only the
+    # always-correct fallback bound.
+    pair_cap = min(pair_capacity or out_size, out_size)
+    j = jnp.arange(pair_cap, dtype=jnp.int32)
+    start = input_offsets
+    flat_idx = jnp.clip(start[:, None] + j[None, :], 0, max(n - 1, 0))
+    idx = order[flat_idx]
+    slot_valid = j[None, :] < jnp.minimum(send_sizes[:, None], pair_cap)
+
+    def pack(x):
+        if x is None:
+            return None
+        return x[idx]
+
+    send = jax.tree_util.tree_map(pack, local)
+    recv = jax.tree_util.tree_map(
+        lambda x: None if x is None else jax.lax.all_to_all(x, axis, 0, 0),
+        send,
+    )
+    recv_valid = jax.lax.all_to_all(slot_valid, axis, 0, 0)  # (P, cap)
+    # compact: flatten in src order, stable-partition valid slots first.
+    # With a tight pair_capacity the slot grid (num * pair_cap) can be
+    # SMALLER than out_size — pad the index; the padded tail is masked
+    # to zeros by occupancy below (n_recv <= num * pair_cap always).
+    flat_valid = recv_valid.reshape(-1)
+    comp = jnp.argsort(~flat_valid, stable=True).astype(jnp.int32)
+    slots = num * pair_cap
+    if slots < out_size:
+        comp = jnp.pad(comp, (0, out_size - slots))
+    else:
+        comp = comp[:out_size]
+    occupancy = jnp.arange(out_size, dtype=jnp.int32) < n_recv
+
+    def compact(x):
+        if x is None:
+            return None
+        flat = x.reshape((num * pair_cap,) + x.shape[2:])
+        g = flat[comp]
+        pad_shape = (1,) * (g.ndim - 1)
+        m = occupancy.reshape((out_size,) + pad_shape)
+        return jnp.where(m, g, jnp.zeros_like(g))
+
+    out_tbl = jax.tree_util.tree_map(compact, recv)
+    overflow = n_recv - out_size
+    return out_tbl, occupancy, overflow
+
+
+def exchange_ragged_by_hash(
+    local: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    counts: jax.Array,
+    out_size: int,
+    axis: str = SHUFFLE_AXIS,
+    impl: str = "dense_compact",
+    row_valid: Optional[jax.Array] = None,
+    pair_capacity: Optional[int] = None,
+):
+    """:func:`exchange_ragged` keyed by Spark hash partitioning."""
+    dest = partition_ids_hash(local, columns, counts.shape[0])
+    return exchange_ragged(
+        local, dest, counts, out_size, axis, impl, row_valid,
+        pair_capacity,
+    )
+
+
+def shuffle_table_compact(
+    table: Table,
+    columns: Optional[Sequence[Union[int, str]]],
+    mesh: Mesh,
+    out_size: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+    impl: Optional[str] = None,
+    on_overflow: str = "raise",
+):
+    """Host-level compact shuffle: plan counts, ragged-exchange the rows.
+
+    Unlike :func:`shuffle_table` (uniform per-pair capacity, received
+    shape ``P x capacity``), the received buffer is ``out_size`` rows
+    total per device — the hottest destination's REAL row total (rounded)
+    — so correlated skew (e.g. pre-sorted input where one source feeds
+    one destination) no longer inflates every device's allocation by a
+    factor of P. Returns (sharded compact table, occupancy, overflow).
+    """
+    validate_on_overflow(on_overflow)
+    impl = _ragged_impl(impl)
+    sharded = shard_table(table, mesh, axis)
+    counts = partition_counts(sharded, columns, mesh, axis)
+    size = out_size or total_recv_capacity(counts)
+    pair_cap = _round_capacity(int(jnp.max(counts)))
+
+    def run(local, C):
+        out, occ, overflow = exchange_ragged_by_hash(
+            local, columns, C, size, axis, impl,
+            pair_capacity=pair_cap,
+        )
+        return out, occ, overflow[None]
+
+    fn = shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    out, occ, overflow = fn(sharded, counts)
+    if on_overflow == "raise":
+        check_overflow_compact(overflow, size, "compact shuffle")
+    return out, occ, overflow
 
 
 def shuffle_table(
