@@ -22,7 +22,14 @@ from .gather import gather_table, gather_column
 from .sort import sort_table, argsort_table, SortKey
 from .hashing import murmur3_column, murmur3_table
 from .groupby import groupby_aggregate, GroupbyAgg
-from .join import inner_join, left_join, semi_join, anti_join
+from .join import (
+    inner_join,
+    left_join,
+    right_join,
+    full_join,
+    semi_join,
+    anti_join,
+)
 from .partition import hash_partition, round_robin_partition
 from .rounding import round_column
 from . import datetime, replace, rounding
@@ -85,6 +92,8 @@ __all__ = [
     "GroupbyAgg",
     "inner_join",
     "left_join",
+    "right_join",
+    "full_join",
     "semi_join",
     "anti_join",
     "hash_partition",

@@ -274,3 +274,158 @@ def anti_join(left, right, on, right_on=None) -> Table:
 
     has = _membership(left, right, on, right_on)
     return filter_table(left, Column(jnp.logical_not(has), dt.BOOL8, None))
+
+
+# ---------------------------------------------------------------------------
+# full / right outer joins (round 3: VERDICT item 7)
+# ---------------------------------------------------------------------------
+
+def _resolve_col(table: Table, c: Union[int, str]) -> int:
+    if isinstance(c, str):
+        if table.names is None:
+            raise ValueError(f"column name {c!r} on an unnamed table")
+        return table.names.index(c)
+    return c
+
+
+def _coalesce_key(
+    lc: Column, rc: Column, left_idx, right_idx, left_ok, right_ok
+) -> Column:
+    """Output key column under USING semantics: ``coalesce(l.k, r.k)`` —
+    left's key for pair / left-unmatched rows, right's for
+    right-unmatched rows (where no left row exists)."""
+    if lc.dtype != rc.dtype:
+        raise TypeError(
+            f"outer-join key dtypes differ: {lc.dtype} vs {rc.dtype}"
+        )
+    lg = gather_table(Table([lc]), left_idx).columns[0]
+    rg = gather_table(Table([rc]), right_idx).columns[0]
+    m = left_ok.reshape(left_ok.shape + (1,) * (lg.data.ndim - 1))
+    data = jnp.where(m, lg.data, rg.data)
+    lval = jnp.logical_and(compute.valid_mask(lg), left_ok)
+    rval = jnp.logical_and(compute.valid_mask(rg), right_ok)
+    valid = jnp.where(left_ok, lval, rval)
+    lengths = None
+    if lg.lengths is not None or rg.lengths is not None:
+        ll = lg.lengths if lg.lengths is not None else jnp.zeros_like(right_idx)
+        rl = rg.lengths if rg.lengths is not None else jnp.zeros_like(right_idx)
+        lengths = jnp.where(left_ok, ll, rl)
+    return Column(data, lc.dtype, valid, lengths)
+
+
+def _outer_output(
+    left: Table,
+    right: Table,
+    left_on: Sequence[Union[int, str]],
+    right_on: Sequence[Union[int, str]],
+    left_idx,
+    right_idx,
+    left_ok,
+    right_ok,
+) -> Table:
+    """Unified outer-join materialization: key columns coalesced, left
+    non-keys masked by ``left_ok``, right non-keys (minus its join keys,
+    like Spark USING) masked by ``right_ok``."""
+    lkeys = [_resolve_col(left, c) for c in left_on]
+    rkeys = [_resolve_col(right, c) for c in right_on]
+    rkey_of = dict(zip(lkeys, rkeys))
+    out_cols: list[Column] = []
+    out_names: list[str] = []
+    lnames = (
+        list(left.names)
+        if left.names
+        else [f"l{i}" for i in range(left.num_columns)]
+    )
+    for j, c in enumerate(left.columns):
+        if j in rkey_of:
+            out_cols.append(
+                _coalesce_key(
+                    c, right.columns[rkey_of[j]],
+                    left_idx, right_idx, left_ok, right_ok,
+                )
+            )
+        else:
+            out_cols.append(
+                gather_table(Table([c]), left_idx, left_ok).columns[0]
+            )
+        out_names.append(lnames[j])
+    for j, c in enumerate(right.columns):
+        if j in rkeys:
+            continue
+        out_cols.append(
+            gather_table(Table([c]), right_idx, right_ok).columns[0]
+        )
+        out_names.append(right.names[j] if right.names else f"r{j}")
+    return Table(out_cols, out_names)
+
+
+def _unmatched_right(left, right, on, right_on):
+    """Bool mask over right rows with NO match in left (probe reversed).
+    Null/invalid right keys never match, so they are unmatched — exactly
+    the rows a FULL/RIGHT OUTER join must still emit."""
+    _, _, counts, _ = _match_ranges(right, left, right_on, on)
+    return counts == 0
+
+
+def right_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+) -> Table:
+    """Eager RIGHT OUTER equi-join: inner pairs + unmatched right rows
+    with a null left side (keys coalesced from the right)."""
+    right_on = right_on or on
+    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    total_in = int(jnp.sum(counts))
+    run = _unmatched_right(left, right, on, right_on)
+    n_run = int(jnp.sum(run))
+    left_idx, right_idx, matched, _ = _expand(
+        perm_r, lo, counts, total_in, left_outer=False
+    )
+    run_idx = jnp.nonzero(run, size=n_run)[0].astype(jnp.int32)
+    left_idx = jnp.concatenate(
+        [left_idx, jnp.zeros((n_run,), jnp.int32)]
+    )
+    right_idx = jnp.concatenate([right_idx, run_idx])
+    left_ok = jnp.concatenate(
+        [jnp.ones((total_in,), jnp.bool_), jnp.zeros((n_run,), jnp.bool_)]
+    )
+    right_ok = jnp.concatenate(
+        [jnp.ones((total_in,), jnp.bool_), jnp.ones((n_run,), jnp.bool_)]
+    )
+    return _outer_output(
+        left, right, on, right_on, left_idx, right_idx, left_ok, right_ok
+    )
+
+
+def full_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+) -> Table:
+    """Eager FULL OUTER equi-join: inner pairs + unmatched left rows
+    (null right side) + unmatched right rows (null left side)."""
+    right_on = right_on or on
+    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    total_pairs = int(jnp.sum(jnp.maximum(counts, 1)))  # inner + left-unmatched
+    run = _unmatched_right(left, right, on, right_on)
+    n_run = int(jnp.sum(run))
+    left_idx, right_idx, matched, _ = _expand(
+        perm_r, lo, counts, total_pairs, left_outer=True
+    )
+    run_idx = jnp.nonzero(run, size=n_run)[0].astype(jnp.int32)
+    left_idx = jnp.concatenate(
+        [left_idx, jnp.zeros((n_run,), jnp.int32)]
+    )
+    right_idx = jnp.concatenate([right_idx, run_idx])
+    left_ok = jnp.concatenate(
+        [jnp.ones((total_pairs,), jnp.bool_), jnp.zeros((n_run,), jnp.bool_)]
+    )
+    right_ok = jnp.concatenate(
+        [matched, jnp.ones((n_run,), jnp.bool_)]
+    )
+    return _outer_output(
+        left, right, on, right_on, left_idx, right_idx, left_ok, right_ok
+    )

@@ -526,3 +526,101 @@ class TestStrings:
         b = Column.from_strings(["apricot", "fig", "aa"])
         assert ops.binary_op("lt", a, b).to_pylist() == [True, False, False]
         assert ops.binary_op("eq", a, b).to_pylist() == [False, True, False]
+
+
+class TestOuterJoins:
+    """Round-3: FULL/RIGHT OUTER (VERDICT item 7), pandas oracles."""
+
+    def _tables(self, rng, nl=300, nr=200, keyspace=40):
+        lk = rng.integers(0, keyspace, nl, dtype=np.int64)
+        rk = rng.integers(0, keyspace, nr, dtype=np.int64)
+        lv = np.arange(nl, dtype=np.int64)
+        rv = np.arange(nr, dtype=np.int64)
+        left = Table(
+            [Column.from_numpy(lk), Column.from_numpy(lv)], ["k", "lv"]
+        )
+        right = Table(
+            [Column.from_numpy(rk), Column.from_numpy(rv)], ["k", "rv"]
+        )
+        return left, right, lk, rk, lv, rv
+
+    @staticmethod
+    def _rows(out):
+        return sorted(
+            zip(
+                out["k"].to_pylist(),
+                out["lv"].to_pylist(),
+                out["rv"].to_pylist(),
+            ),
+            key=lambda r: tuple((x is None, x) for x in r),
+        )
+
+    @staticmethod
+    def _pandas_rows(pd, lk, rk, lv, rv, how):
+        want = pd.merge(
+            pd.DataFrame({"k": lk, "lv": lv}),
+            pd.DataFrame({"k": rk, "rv": rv}),
+            on="k",
+            how=how,
+        )
+        rows = [
+            (
+                None if pd.isna(k) else int(k),
+                None if pd.isna(a) else int(a),
+                None if pd.isna(b) else int(b),
+            )
+            for k, a, b in zip(want["k"], want["lv"], want["rv"])
+        ]
+        return sorted(
+            rows, key=lambda r: tuple((x is None, x) for x in r)
+        )
+
+    def test_right_join_vs_pandas(self, rng):
+        pd = pytest.importorskip("pandas")
+        left, right, lk, rk, lv, rv = self._tables(rng)
+        out = ops.right_join(left, right, ["k"])
+        assert self._rows(out) == self._pandas_rows(pd, lk, rk, lv, rv, "right")
+
+    def test_full_join_vs_pandas(self, rng):
+        pd = pytest.importorskip("pandas")
+        # disjoint-ish keyspaces so both sides have unmatched rows
+        left, right, lk, rk, lv, rv = self._tables(rng, keyspace=60)
+        out = ops.full_join(left, right, ["k"])
+        assert self._rows(out) == self._pandas_rows(pd, lk, rk, lv, rv, "outer")
+
+    def test_full_join_null_keys_both_sides(self):
+        left = Table.from_pydict({"k": [1, None, 3], "lv": [10, 20, 30]})
+        right = Table.from_pydict({"k": [1, None], "rv": [100, 200]})
+        out = ops.full_join(left, right, ["k"])
+        rows = self._rows(out)
+        # null keys never match but still appear, one row each
+        assert rows == [
+            (1, 10, 100),
+            (3, 30, None),
+            (None, 20, None),
+            (None, None, 200),
+        ]
+
+    def test_right_join_null_keys(self):
+        left = Table.from_pydict({"k": [1, 2], "lv": [10, 20]})
+        right = Table.from_pydict({"k": [1, None, 9], "rv": [100, 200, 300]})
+        out = ops.right_join(left, right, ["k"])
+        rows = self._rows(out)
+        assert rows == [
+            (1, 10, 100),
+            (9, None, 300),
+            (None, None, 200),
+        ]
+
+    def test_full_join_no_matches(self):
+        left = Table.from_pydict({"k": [1, 2], "lv": [10, 20]})
+        right = Table.from_pydict({"k": [8, 9], "rv": [100, 200]})
+        out = ops.full_join(left, right, ["k"])
+        assert out.row_count == 4
+        rows = self._rows(out)
+        assert rows == [
+            (1, 10, None),
+            (2, 20, None),
+            (8, None, 100),
+            (9, None, 200),
+        ]
