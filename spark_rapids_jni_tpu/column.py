@@ -127,7 +127,12 @@ class Column:
         representation the reference round-trips in RowConversionTest.java:37-38).
         """
         arr = np.asarray(arr)
-        if arr.ndim != 1:
+        if dtype is not None and dtype.id == dt.TypeId.DECIMAL128:
+            if arr.ndim != 2 or arr.shape[1] != 2:
+                raise ValueError(
+                    "DECIMAL128 expects (n, 2) uint64 limbs [lo, hi]"
+                )
+        elif arr.ndim != 1:
             raise ValueError("expected 1-D host array")
         if dtype is None:
             dtype = dt.from_numpy_dtype(arr.dtype)
@@ -137,9 +142,25 @@ class Column:
         valid = None
         if validity is not None:
             valid = jnp.asarray(np.asarray(validity, dtype=np.bool_))
-            if valid.shape != dev.shape:
+            if valid.shape != dev.shape[:1]:
                 raise ValueError("validity shape mismatch")
         return Column(data=dev, dtype=dtype, validity=valid)
+
+    @staticmethod
+    def from_decimal128(
+        values: Sequence[Optional[int]], scale: int = 0
+    ) -> "Column":
+        """Build a DECIMAL128 column from Python ints (unscaled values;
+        None = null). Device layout: (n, 2) uint64 limbs [lo, hi]."""
+        from .ops.int128 import from_py_ints
+
+        limbs = from_py_ints(values)
+        valid = np.array([v is not None for v in values], dtype=np.bool_)
+        return Column.from_numpy(
+            limbs,
+            validity=None if valid.all() else valid,
+            dtype=dt.DType(dt.TypeId.DECIMAL128, scale),
+        )
 
     @staticmethod
     def from_strings(
@@ -214,6 +235,14 @@ class Column:
                 bytes(mat[i, : lens[i]]).decode("utf-8", "surrogateescape")
                 if valid[i]
                 else None
+                for i in range(self.row_count)
+            ]
+        if self.dtype.id == dt.TypeId.DECIMAL128:
+            from .ops.int128 import to_py_ints
+
+            ints = to_py_ints(np.asarray(self.data))
+            return [
+                ints[i] if valid[i] else None
                 for i in range(self.row_count)
             ]
         arr = self.to_numpy()

@@ -222,7 +222,10 @@ class DType:
     def device_dtype(self):
         """The *logical* jnp dtype of this column's values."""
         if self.id == TypeId.DECIMAL128:
-            raise TypeError("DECIMAL128 has no native device dtype on TPU")
+            raise TypeError(
+                "DECIMAL128 has no scalar device dtype: columns are "
+                "(n, 2) uint64 little-endian limb buffers (ops/int128.py)"
+            )
         try:
             return _DEVICE_DTYPES[self.id]
         except KeyError:
@@ -242,6 +245,10 @@ class DType:
         the order-preserving bit trick instead of decoding.
         """
         if self.id == TypeId.FLOAT64:
+            return jnp.uint64
+        if self.id == TypeId.DECIMAL128:
+            # (n, 2) little-endian u64 limbs [lo, hi]; TPU has no native
+            # int128, so 128-bit values are limb vectors (ops/int128.py)
             return jnp.uint64
         return self.device_dtype
 
@@ -291,6 +298,10 @@ def decimal32(scale: int) -> DType:
 
 def decimal64(scale: int) -> DType:
     return DType(TypeId.DECIMAL64, scale)
+
+
+def decimal128(scale: int) -> DType:
+    return DType(TypeId.DECIMAL128, scale)
 
 
 _NP_TO_TYPEID = {

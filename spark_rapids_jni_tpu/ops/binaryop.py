@@ -70,6 +70,12 @@ def binary_op(op: str, a: Column, b: Column) -> Column:
 
         return strings.binary_op(op, a, b)
 
+    if (
+        a.dtype.id == dt.TypeId.DECIMAL128
+        or b.dtype.id == dt.TypeId.DECIMAL128
+    ):
+        return _binary_op_decimal128(op, a, b)
+
     valid = compute.merge_validity(a, b)
 
     if op in _LOGICAL_OPS:
@@ -240,3 +246,66 @@ def gt(a, b):
 
 def ge(a, b):
     return binary_op("ge", a, b)
+
+
+def _limbs_at_scale(col: Column, to_scale: int):
+    """A column's values as (lo, hi) u64 limbs rescaled to ``to_scale``.
+    Rescaling to the smaller (more negative) scale multiplies, so the
+    common-scale alignment below is exact."""
+    from . import int128
+
+    if col.dtype.id == dt.TypeId.DECIMAL128:
+        lo, hi = col.data[:, 0], col.data[:, 1]
+        return int128.rescale(lo, hi, col.dtype.scale, to_scale)
+    if col.dtype.is_decimal or col.dtype.is_integer:
+        lo, hi = int128.from_signed_int(col.data)
+        return int128.rescale(lo, hi, col.dtype.scale, to_scale)
+    raise TypeError(
+        f"decimal128 binary ops require decimal/integer operands, "
+        f"got {col.dtype}"
+    )
+
+
+def _binary_op_decimal128(op: str, a: Column, b: Column) -> Column:
+    """DECIMAL128 arithmetic/comparisons over two-u64-limb vectors
+    (ops/int128.py). add/sub/neg-style ops and every comparison; mul/div
+    between two 128-bit operands is not yet supported (raise, never
+    silently truncate)."""
+    import jax.numpy as jnp
+
+    from . import int128
+
+    valid = compute.merge_validity(a, b)
+    scale = min(
+        a.dtype.scale if a.dtype.is_decimal else 0,
+        b.dtype.scale if b.dtype.is_decimal else 0,
+    )
+    al, ah = _limbs_at_scale(a, scale)
+    bl, bh = _limbs_at_scale(b, scale)
+
+    if op in _CMP_OPS:
+        is_eq = int128.eq(al, ah, bl, bh)
+        is_lt = int128.lt_signed(al, ah, bl, bh)
+        out = {
+            "eq": lambda: is_eq,
+            "ne": lambda: ~is_eq,
+            "lt": lambda: is_lt,
+            "le": lambda: is_lt | is_eq,
+            "gt": lambda: ~(is_lt | is_eq),
+            "ge": lambda: ~is_lt,
+            "null_safe_eq": lambda: is_eq,
+        }[op]()
+        if op == "null_safe_eq":
+            va, vb = compute.valid_mask(a), compute.valid_mask(b)
+            out = jnp.where(va & vb, out, jnp.logical_and(~va, ~vb))
+            return Column(out, dt.BOOL8, None)
+        return Column(out, dt.BOOL8, valid)
+
+    if op == "add":
+        lo, hi = int128.add(al, ah, bl, bh)
+    elif op == "sub":
+        lo, hi = int128.sub(al, ah, bl, bh)
+    else:
+        raise TypeError(f"decimal128 op {op!r} not supported")
+    data = jnp.stack([lo, hi], axis=1)
+    return Column(data, dt.DType(dt.TypeId.DECIMAL128, scale), valid)

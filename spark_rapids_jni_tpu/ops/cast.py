@@ -19,6 +19,9 @@ def cast(col: Column, to: dt.DType) -> Column:
 
         return strings.cast(col, to)
 
+    if col.dtype.id == dt.TypeId.DECIMAL128 or to.id == dt.TypeId.DECIMAL128:
+        return _cast_decimal128(col, to)
+
     vals = compute.values(col)
 
     if col.dtype.is_decimal and to.is_decimal:
@@ -51,3 +54,41 @@ def _rescale(vals, from_scale: int, to_scale: int):
     if to_scale < from_scale:
         return vals * (10 ** (from_scale - to_scale))
     return vals // (10 ** (to_scale - from_scale))
+
+
+def _cast_decimal128(col: Column, to: dt.DType) -> Column:
+    """Casts touching DECIMAL128 (two-u64-limb columns, ops/int128.py):
+    widen from any decimal/integer, rescale within decimal128, narrow to
+    decimal64/32 (wrapping like Spark non-ANSI), or approximate to
+    float."""
+    from . import int128
+
+    if to.id == dt.TypeId.DECIMAL128:
+        if col.dtype.id == dt.TypeId.DECIMAL128:
+            lo, hi = int128.rescale(
+                col.data[:, 0], col.data[:, 1], col.dtype.scale, to.scale
+            )
+        elif col.dtype.is_decimal or col.dtype.is_integer:
+            lo, hi = int128.from_signed_int(col.data)
+            lo, hi = int128.rescale(lo, hi, col.dtype.scale, to.scale)
+        else:
+            raise TypeError(f"cannot cast {col.dtype} to DECIMAL128")
+        return Column(
+            jnp.stack([lo, hi], axis=1), to, col.validity
+        )
+
+    # from DECIMAL128
+    lo, hi = col.data[:, 0], col.data[:, 1]
+    if to.is_decimal:
+        lo, hi = int128.rescale(lo, hi, col.dtype.scale, to.scale)
+        return compute.from_values(lo.astype(jnp.int64), to, col.validity)
+    if to.is_floating:
+        scaled = int128.to_float64(lo, hi) * (10.0 ** col.dtype.scale)
+        return compute.from_values(scaled, to, col.validity)
+    if to.is_integer or to.is_boolean:
+        lo, hi = int128.rescale(lo, hi, col.dtype.scale, 0)
+        v = lo.astype(jnp.int64)
+        if to.is_boolean:
+            return Column((lo != 0) | (hi != 0), dt.BOOL8, col.validity)
+        return compute.from_values(v, to, col.validity)
+    raise TypeError(f"cannot cast DECIMAL128 to {to}")

@@ -1,0 +1,187 @@
+"""Vectorized 128-bit integer arithmetic over two-u64-limb arrays.
+
+DECIMAL128 device representation (round-3 VERDICT item 6): a column of n
+128-bit unscaled values is a ``(n, 2)`` uint64 buffer of little-endian
+limbs ``[lo, hi]`` (two's-complement; the sign lives in hi's top bit).
+TPU has no native int128, but limb arithmetic is pure vector ops — adds
+with carry, 32-bit-half multiplies — which XLA fuses well, the same way
+the reference gets int128 from CUDA's __int128 emulation in libcudf
+(reference surface: decimal128 round-trips in the vendored cudf Java
+tests, spark-rapids-cudf/pom.xml:207-217).
+
+All functions take/return (lo, hi) pairs of uint64 arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U64 = jnp.uint64
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+def from_signed_int(v: jax.Array):
+    """Sign-extend an int64 (or narrower) array to (lo, hi)."""
+    v64 = v.astype(jnp.int64)
+    lo = v64.astype(jnp.uint64)
+    hi = (v64 >> jnp.int64(63)).astype(jnp.uint64)  # 0 or all-ones
+    return lo, hi
+
+
+def from_py_ints(values, n=None) -> np.ndarray:
+    """Host helper: iterable of Python ints -> (n, 2) uint64 limbs."""
+    vals = list(values)
+    out = np.zeros((len(vals) if n is None else n, 2), dtype=np.uint64)
+    mask = (1 << 64) - 1
+    for i, v in enumerate(vals):
+        if v is None:
+            continue
+        u = v & ((1 << 128) - 1)  # two's complement
+        out[i, 0] = u & mask
+        out[i, 1] = (u >> 64) & mask
+    return out
+
+
+def to_py_ints(limbs: np.ndarray) -> list:
+    """Host helper: (n, 2) uint64 limbs -> Python ints (signed)."""
+    out = []
+    for lo, hi in np.asarray(limbs, dtype=np.uint64):
+        u = (int(hi) << 64) | int(lo)
+        out.append(u - (1 << 128) if u >= (1 << 127) else u)
+    return out
+
+
+def add(a_lo, a_hi, b_lo, b_hi):
+    """128-bit add (wrapping)."""
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(_U64)
+    return lo, a_hi + b_hi + carry
+
+
+def negate(lo, hi):
+    """Two's-complement negation: ~x + 1, where the +1 carries into hi
+    exactly when lo == 0."""
+    carry = (lo == jnp.uint64(0)).astype(_U64)
+    return ~lo + jnp.uint64(1), ~hi + carry
+
+
+def sub(a_lo, a_hi, b_lo, b_hi):
+    nb_lo, nb_hi = negate(b_lo, b_hi)
+    return add(a_lo, a_hi, nb_lo, nb_hi)
+
+
+def _mul_u64(a, b):
+    """64x64 -> 128 unsigned multiply via 32-bit halves."""
+    a_lo = a & _MASK32
+    a_hi = a >> jnp.uint64(32)
+    b_lo = b & _MASK32
+    b_hi = b >> jnp.uint64(32)
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    mid = (ll >> jnp.uint64(32)) + (lh & _MASK32) + (hl & _MASK32)
+    lo = (ll & _MASK32) | (mid << jnp.uint64(32))
+    hi = hh + (lh >> jnp.uint64(32)) + (hl >> jnp.uint64(32)) + (
+        mid >> jnp.uint64(32)
+    )
+    return lo, hi
+
+
+def mul_u64(lo, hi, m):
+    """128-bit x u64 scalar multiply (wrapping) — the rescale primitive
+    (x * 10**k when widening a decimal scale)."""
+    m = jnp.uint64(m)
+    p_lo, p_hi = _mul_u64(lo, m)
+    return p_lo, p_hi + hi * m
+
+
+def lt_signed(a_lo, a_hi, b_lo, b_hi):
+    """Signed 128-bit a < b."""
+    ah = a_hi.astype(jnp.int64)
+    bh = b_hi.astype(jnp.int64)
+    return (ah < bh) | ((ah == bh) & (a_lo < b_lo))
+
+
+def eq(a_lo, a_hi, b_lo, b_hi):
+    return (a_lo == b_lo) & (a_hi == b_hi)
+
+
+def to_float64(lo, hi):
+    """Approximate float64 value (for mean/float casts)."""
+    neg = (hi >> jnp.uint64(63)) != 0
+    nlo, nhi = negate(lo, hi)
+    ulo = jnp.where(neg, nlo, lo)
+    uhi = jnp.where(neg, nhi, hi)
+    mag = uhi.astype(jnp.float64) * np.float64(2.0**64) + ulo.astype(
+        jnp.float64
+    )
+    return jnp.where(neg, -mag, mag)
+
+
+def order_key_words(limbs: jax.Array):
+    """(n, 2) limbs -> [hi ^ signbit, lo] u64 words whose lexicographic
+    unsigned order equals signed 128-bit order (keys.py convention)."""
+    sign = np.uint64(1) << np.uint64(63)
+    return [limbs[:, 1] ^ sign, limbs[:, 0]]
+
+
+def pow10_limbs(k: int):
+    """(lo, hi) host limbs of 10**k, 0 <= k <= 38."""
+    if not 0 <= k <= 38:
+        raise ValueError(f"10**{k} out of decimal128 range")
+    u = 10**k
+    return np.uint64(u & ((1 << 64) - 1)), np.uint64(u >> 64)
+
+
+def divmod_u32(lo, hi, d: int):
+    """128-bit unsigned division by a u32 constant via base-2^32 long
+    division (d < 2**32). Returns (q_lo, q_hi); remainder discarded."""
+    if not 0 < d < 2**32:
+        raise ValueError("divisor must fit in u32")
+    dd = jnp.uint64(d)
+    digits = [
+        hi >> jnp.uint64(32),
+        hi & _MASK32,
+        lo >> jnp.uint64(32),
+        lo & _MASK32,
+    ]
+    r = jnp.zeros_like(lo)
+    q = []
+    for dig in digits:
+        cur = (r << jnp.uint64(32)) | dig
+        q.append(cur // dd)
+        r = cur % dd
+    q_hi = (q[0] << jnp.uint64(32)) | (q[1] & _MASK32)
+    q_lo = (q[2] << jnp.uint64(32)) | (q[3] & _MASK32)
+    return q_lo, q_hi
+
+
+def rescale(lo, hi, from_scale: int, to_scale: int):
+    """Change a decimal's scale: multiply (scale down) or divide
+    (scale up) by the power of ten, chunked so every step fits the limb
+    primitives. Division truncates toward zero (magnitude divide), the
+    cudf fixed_point convention."""
+    if from_scale == to_scale:
+        return lo, hi
+    if to_scale < from_scale:
+        k = from_scale - to_scale
+        while k > 0:
+            step = min(k, 19)
+            lo, hi = mul_u64(lo, hi, np.uint64(10**step))
+            k -= step
+        return lo, hi
+    # divide by 10^k on magnitudes, then restore the sign
+    k = to_scale - from_scale
+    neg = (hi >> jnp.uint64(63)) != 0
+    nlo, nhi = negate(lo, hi)
+    mlo = jnp.where(neg, nlo, lo)
+    mhi = jnp.where(neg, nhi, hi)
+    while k > 0:
+        step = min(k, 9)
+        mlo, mhi = divmod_u32(mlo, mhi, 10**step)
+        k -= step
+    rlo, rhi = negate(mlo, mhi)
+    return jnp.where(neg, rlo, mlo), jnp.where(neg, rhi, mhi)

@@ -51,6 +51,12 @@ def column_order_keys(col: Column) -> list[jax.Array]:
     data = col.data
     if d.is_string:
         return _string_order_keys(col)
+    if d.id == dt.TypeId.DECIMAL128:
+        # (n, 2) u64 limbs: sign-flipped hi word then lo word — the
+        # 128-bit instance of the signed sign-flip rule below
+        from .int128 import order_key_words
+
+        return order_key_words(data)
     if d.id == dt.TypeId.FLOAT64:
         return [_float_bits_order(data, 64)]
     if d.id == dt.TypeId.FLOAT32:

@@ -165,6 +165,10 @@ def _column_bytes(col: Column) -> jax.Array:
     if col.dtype.is_boolean:
         # BOOL8 is one byte in the row format.
         return data.astype(jnp.uint8)[:, None]
+    if col.dtype.id == dt.TypeId.DECIMAL128:
+        # (n, 2) u64 limbs [lo, hi] -> 16 little-endian bytes
+        b = jax.lax.bitcast_convert_type(data, jnp.uint8)  # (n, 2, 8)
+        return b.reshape(b.shape[0], 16)
     b = jax.lax.bitcast_convert_type(data, jnp.uint8)
     if b.ndim == 1:  # 1-byte dtypes keep their shape
         b = b[:, None]
@@ -309,6 +313,12 @@ def column_bytes_to_storage(raw: jax.Array, d) -> jax.Array:
     storage-dtype rules can never diverge."""
     if d.is_boolean:
         return raw[:, 0] != 0
+    if d.id == dt.TypeId.DECIMAL128:
+        # 16 little-endian bytes -> (n, 2) u64 limbs [lo, hi]
+        n = raw.shape[0]
+        return jax.lax.bitcast_convert_type(
+            raw.reshape(n, 2, 8), jnp.uint64
+        )
     target = np.dtype(d.storage_dtype)
     if target.itemsize == 1:
         return jax.lax.bitcast_convert_type(raw[:, 0], target)

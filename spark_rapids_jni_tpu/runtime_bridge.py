@@ -19,9 +19,20 @@ row_conversion.cu:514-516.
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional, Sequence
 
 import numpy as np
+
+# Backend selection for embedded callers: the axon TPU plugin re-appends
+# itself even when JAX_PLATFORMS is set in the environment (see
+# tests/conftest.py), so tests that must keep a native embedder off the
+# tunneled chip set SRT_JAX_PLATFORMS and we apply it through the config
+# API before the first backend touch.
+if os.environ.get("SRT_JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["SRT_JAX_PLATFORMS"])
 
 from . import dtype as dt
 from .column import Column, Table
@@ -43,7 +54,13 @@ def _column_from_wire(
     valid: Optional[bytes], num_rows: int,
 ) -> Column:
     d = dt.DType(dt.TypeId(type_id), scale)
-    arr = np.frombuffer(data, dtype=_wire_np(d), count=num_rows)
+    if d.id == dt.TypeId.DECIMAL128:
+        # 16 little-endian bytes/value on the wire -> (n, 2) u64 limbs
+        arr = np.frombuffer(
+            data, dtype=np.uint64, count=2 * num_rows
+        ).reshape(num_rows, 2)
+    else:
+        arr = np.frombuffer(data, dtype=_wire_np(d), count=num_rows)
     v = (
         None
         if valid is None
