@@ -28,7 +28,14 @@ typedef int8_t jbyte;
 typedef uint8_t jboolean;
 typedef jint jsize;
 
-class _jobject {};
+class _jobject {
+ public:
+  /* polymorphic so the mock-JNIEnv test harness (src/jni_mock/) can
+   * dynamic_cast its concrete array/string objects; a real JDK header
+   * also declares _jobject as a class type, so bridge code can't
+   * observe the difference */
+  virtual ~_jobject() = default;
+};
 typedef _jobject* jobject;
 typedef jobject jclass;
 typedef jobject jstring;

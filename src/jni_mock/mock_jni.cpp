@@ -1,0 +1,182 @@
+/* Definitions of the JNIEnv member functions declared in
+ * src/jni/jni_stub/jni.h, backed by arena-owned host objects — see
+ * mock_jni.hpp for why this exists. Semantics follow the JNI spec for
+ * the subset the bridge uses: region copies, UTF strings, pending
+ * exceptions (recorded, not raised), nullptr on allocation failure. */
+
+#include "mock_jni.hpp"
+
+#include <cstring>
+#include <memory>
+
+namespace srt_mock {
+
+namespace {
+
+std::vector<std::unique_ptr<_jobject>> g_arena;
+bool g_pending = false;
+std::string g_message;
+bool g_fail_next_alloc = false;
+
+template <class T>
+T* make() {
+  auto p = std::make_unique<T>();
+  T* raw = p.get();
+  g_arena.push_back(std::move(p));
+  return raw;
+}
+
+}  // namespace
+
+jstring make_string(const std::string& s) {
+  auto* o = make<MockString>();
+  o->s = s;
+  return o;
+}
+
+jbyteArray make_byte_array(const std::vector<jbyte>& v) {
+  auto* o = make<MockByteArray>();
+  o->v = v;
+  return o;
+}
+
+jintArray make_int_array(const std::vector<jint>& v) {
+  auto* o = make<MockIntArray>();
+  o->v = v;
+  return o;
+}
+
+jlongArray make_long_array(const std::vector<jlong>& v) {
+  auto* o = make<MockLongArray>();
+  o->v = v;
+  return o;
+}
+
+std::vector<jlong> long_array_values(jlongArray a) {
+  auto* o = dynamic_cast<MockLongArray*>(a);
+  return o != nullptr ? o->v : std::vector<jlong>{};
+}
+
+std::vector<jbyte> byte_array_values(jbyteArray a) {
+  auto* o = dynamic_cast<MockByteArray*>(a);
+  return o != nullptr ? o->v : std::vector<jbyte>{};
+}
+
+bool exception_pending() { return g_pending; }
+std::string exception_message() { return g_message; }
+void clear_exception() {
+  g_pending = false;
+  g_message.clear();
+}
+
+void fail_next_array_alloc() { g_fail_next_alloc = true; }
+
+void reset() {
+  g_arena.clear();
+  clear_exception();
+  g_fail_next_alloc = false;
+}
+
+}  // namespace srt_mock
+
+/* ---- JNIEnv member definitions -------------------------------------- */
+
+using srt_mock::MockByteArray;
+using srt_mock::MockClass;
+using srt_mock::MockIntArray;
+using srt_mock::MockLongArray;
+using srt_mock::MockString;
+
+jclass JNIEnv::FindClass(const char* name) {
+  auto* c = srt_mock::make<MockClass>();
+  c->name = name != nullptr ? name : "";
+  return c;
+}
+
+jint JNIEnv::ThrowNew(jclass, const char* msg) {
+  srt_mock::g_pending = true;
+  srt_mock::g_message = msg != nullptr ? msg : "";
+  return 0;
+}
+
+jsize JNIEnv::GetArrayLength(jarray array) {
+  if (auto* b = dynamic_cast<MockByteArray*>(array))
+    return static_cast<jsize>(b->v.size());
+  if (auto* i = dynamic_cast<MockIntArray*>(array))
+    return static_cast<jsize>(i->v.size());
+  if (auto* l = dynamic_cast<MockLongArray*>(array))
+    return static_cast<jsize>(l->v.size());
+  return 0;
+}
+
+void JNIEnv::GetByteArrayRegion(jbyteArray array, jsize start, jsize len,
+                                jbyte* buf) {
+  auto* o = dynamic_cast<MockByteArray*>(array);
+  if (o != nullptr && start >= 0 &&
+      start + len <= static_cast<jsize>(o->v.size()))
+    std::memcpy(buf, o->v.data() + start, static_cast<size_t>(len));
+}
+
+void JNIEnv::GetIntArrayRegion(jintArray array, jsize start, jsize len,
+                               jint* buf) {
+  auto* o = dynamic_cast<MockIntArray*>(array);
+  if (o != nullptr && start >= 0 &&
+      start + len <= static_cast<jsize>(o->v.size()))
+    std::memcpy(buf, o->v.data() + start, sizeof(jint) * len);
+}
+
+void JNIEnv::GetLongArrayRegion(jlongArray array, jsize start, jsize len,
+                                jlong* buf) {
+  auto* o = dynamic_cast<MockLongArray*>(array);
+  if (o != nullptr && start >= 0 &&
+      start + len <= static_cast<jsize>(o->v.size()))
+    std::memcpy(buf, o->v.data() + start, sizeof(jlong) * len);
+}
+
+void JNIEnv::SetByteArrayRegion(jbyteArray array, jsize start, jsize len,
+                                const jbyte* buf) {
+  auto* o = dynamic_cast<MockByteArray*>(array);
+  if (o != nullptr && start >= 0 &&
+      start + len <= static_cast<jsize>(o->v.size()))
+    std::memcpy(o->v.data() + start, buf, static_cast<size_t>(len));
+}
+
+void JNIEnv::SetLongArrayRegion(jlongArray array, jsize start, jsize len,
+                                const jlong* buf) {
+  auto* o = dynamic_cast<MockLongArray*>(array);
+  if (o != nullptr && start >= 0 &&
+      start + len <= static_cast<jsize>(o->v.size()))
+    std::memcpy(o->v.data() + start, buf, sizeof(jlong) * len);
+}
+
+jbyteArray JNIEnv::NewByteArray(jsize len) {
+  if (srt_mock::g_fail_next_alloc) {
+    srt_mock::g_fail_next_alloc = false;
+    return nullptr;
+  }
+  auto* o = srt_mock::make<MockByteArray>();
+  o->v.resize(static_cast<size_t>(len));
+  return o;
+}
+
+jlongArray JNIEnv::NewLongArray(jsize len) {
+  if (srt_mock::g_fail_next_alloc) {
+    srt_mock::g_fail_next_alloc = false;
+    return nullptr;
+  }
+  auto* o = srt_mock::make<MockLongArray>();
+  o->v.resize(static_cast<size_t>(len));
+  return o;
+}
+
+jstring JNIEnv::NewStringUTF(const char* utf) {
+  return srt_mock::make_string(utf != nullptr ? utf : "");
+}
+
+const char* JNIEnv::GetStringUTFChars(jstring str, jboolean* is_copy) {
+  if (is_copy != nullptr) *is_copy = JNI_FALSE;
+  auto* o = dynamic_cast<MockString*>(str);
+  return o != nullptr ? o->s.c_str() : nullptr;
+}
+
+void JNIEnv::ReleaseStringUTFChars(jstring, const char*) {}

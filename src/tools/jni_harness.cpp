@@ -1,0 +1,279 @@
+/* Executes the REAL JNI bridge entry points end-to-end against the mock
+ * JNIEnv (src/jni_mock/) and the embedded JAX runtime — the no-JVM
+ * equivalent of the reference running RowConversionTest through a live
+ * JVM on GPU CI (RowConversionJni.cpp:24-66, ci/premerge-build.sh:22-28).
+ *
+ * Covers, through the actual Java_com_nvidia_spark_rapids_jni_* symbols:
+ *   1. DeviceTable: runtime availability/init/platform
+ *   2. DeviceTable.tableOpNative groupby on the XLA backend vs an oracle
+ *   3. RowConversion.convertToRowsNative vs the host codec, then
+ *      convertFromRowsNative round-trip (HostBuffer handles throughout)
+ *   4. Error paths: null args, length mismatches, bad batch ranges,
+ *      stale handles — each must record a pending Java exception
+ *   5. Cleanup paths: allocation-failure fault injection must release
+ *      every registry handle (the RowConversion.java:56 discipline)
+ *   6. Zero leaked handles at exit (refcount-debug analog)
+ *
+ * Exit 0 on success; prints the failing check otherwise. */
+
+#include <jni.h>
+
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../jni_mock/mock_jni.hpp"
+#include "spark_rapids_tpu/c_api.h"
+
+/* The bridge's exported JNI symbols (declared here rather than via a
+ * generated header; signatures must match src/jni/ *.cpp). */
+extern "C" {
+jboolean Java_com_nvidia_spark_rapids_jni_DeviceTable_isDeviceRuntimeAvailable(
+    JNIEnv*, jclass);
+void Java_com_nvidia_spark_rapids_jni_DeviceTable_initDeviceRuntime(
+    JNIEnv*, jclass);
+jstring Java_com_nvidia_spark_rapids_jni_DeviceTable_devicePlatform(
+    JNIEnv*, jclass);
+jlongArray Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+    JNIEnv*, jclass, jstring, jintArray, jintArray, jlongArray, jlongArray,
+    jlong);
+jlong Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
+    JNIEnv*, jclass, jlong, jintArray, jlong, jlong, jlong);
+jlongArray Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(
+    JNIEnv*, jclass, jlong, jintArray, jintArray, jlong);
+jlong Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferCreate(
+    JNIEnv*, jclass, jbyteArray, jstring);
+jbyteArray Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferGet(
+    JNIEnv*, jclass, jlong);
+void Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferRelease(
+    JNIEnv*, jclass, jlong);
+jint Java_com_nvidia_spark_rapids_jni_RowConversion_rowSize(
+    JNIEnv*, jclass, jintArray);
+}
+
+namespace {
+
+constexpr int32_t kInt64 = 4;    /* TypeId.INT64 */
+constexpr int32_t kFloat64 = 10; /* TypeId.FLOAT64 */
+
+#define CHECK(cond, msg)                                          \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      std::fprintf(stderr, "FAIL: %s (last error: %s; jexc: %s)\n", \
+                   msg, srt_last_error(),                         \
+                   srt_mock::exception_message().c_str());        \
+      return 1;                                                   \
+    }                                                             \
+  } while (0)
+
+#define CHECK_THROWS(expr, msg)                          \
+  do {                                                   \
+    srt_mock::clear_exception();                         \
+    (void)(expr);                                        \
+    CHECK(srt_mock::exception_pending(), msg);           \
+    srt_mock::clear_exception();                         \
+  } while (0)
+
+}  // namespace
+
+int main() {
+  JNIEnv env_obj;
+  JNIEnv* env = &env_obj;
+  jclass cls = env->FindClass("mock/Cls");
+
+  /* -- 1. runtime lifecycle through the DeviceTable entry points ----- */
+  CHECK(Java_com_nvidia_spark_rapids_jni_DeviceTable_isDeviceRuntimeAvailable(
+            env, cls) == JNI_TRUE,
+        "device runtime not built in");
+  Java_com_nvidia_spark_rapids_jni_DeviceTable_initDeviceRuntime(env, cls);
+  CHECK(!srt_mock::exception_pending(), "initDeviceRuntime threw");
+  jstring plat =
+      Java_com_nvidia_spark_rapids_jni_DeviceTable_devicePlatform(env, cls);
+  CHECK(plat != nullptr, "devicePlatform returned null");
+  const char* plat_c = env->GetStringUTFChars(plat, nullptr);
+  std::printf("jni_harness: platform = %s\n", plat_c);
+
+  /* -- table data: k int64 (one null), v float64 --------------------- */
+  const int64_t n = 64;
+  std::vector<int64_t> k(n);
+  std::vector<double> v(n);
+  std::vector<uint8_t> k_valid(n, 1);
+  for (int64_t i = 0; i < n; ++i) {
+    k[i] = i % 5;
+    v[i] = static_cast<double>(i) * 0.5;
+  }
+  k_valid[9] = 0;
+
+  srt_handle hk = srt_buffer_create(k.data(), n * 8, "h-k");
+  srt_handle hv = srt_buffer_create(v.data(), n * 8, "h-v");
+  srt_handle hkv = srt_buffer_create(k_valid.data(), n, "h-kv");
+  CHECK(hk != 0 && hv != 0 && hkv != 0, "buffer create");
+
+  /* -- 2. groupby through tableOpNative ------------------------------ */
+  jstring op = srt_mock::make_string(
+      "{\"op\": \"groupby\", \"by\": [0], "
+      "\"aggs\": [{\"column\": 1, \"agg\": \"sum\"}]}");
+  jintArray ids = srt_mock::make_int_array({kInt64, kFloat64});
+  jintArray scales = srt_mock::make_int_array({0, 0});
+  jlongArray data = srt_mock::make_long_array({hk, hv});
+  jlongArray valid = srt_mock::make_long_array({hkv, 0});
+  jlongArray packed = Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+      env, cls, op, ids, scales, data, valid, n);
+  CHECK(!srt_mock::exception_pending(), "tableOpNative threw");
+  CHECK(packed != nullptr, "tableOpNative returned null");
+  std::vector<jlong> pk = srt_mock::long_array_values(packed);
+  CHECK(pk.size() >= 2, "packed result too short");
+  const int64_t out_cols = pk[0];
+  const int64_t out_rows = pk[1];
+  CHECK(out_cols == 2, "groupby output arity");
+  CHECK(pk.size() == 2 + 4 * static_cast<size_t>(out_cols),
+        "packed result length");
+
+  std::map<int64_t, double> want;
+  double null_sum = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (k_valid[i]) {
+      want[k[i]] += v[i];
+    } else {
+      null_sum += v[i];
+    }
+  }
+  CHECK(out_rows == static_cast<int64_t>(want.size()) + 1,
+        "groupby group count (null key group included)");
+  srt_handle gk = pk[2 + 2 * out_cols + 0];
+  srt_handle gs = pk[2 + 2 * out_cols + 1];
+  srt_handle gkv = pk[2 + 3 * out_cols + 0];
+  const int64_t* got_k = static_cast<const int64_t*>(srt_buffer_data(gk));
+  const double* got_s = static_cast<const double*>(srt_buffer_data(gs));
+  const uint8_t* got_kv =
+      gkv == 0 ? nullptr : static_cast<const uint8_t*>(srt_buffer_data(gkv));
+  CHECK(got_k != nullptr && got_s != nullptr, "groupby output buffers");
+  for (int64_t i = 0; i < out_rows; ++i) {
+    if (got_kv != nullptr && got_kv[i] == 0) {
+      CHECK(got_s[i] == null_sum, "null-group sum");
+      continue;
+    }
+    auto it = want.find(got_k[i]);
+    CHECK(it != want.end() && it->second == got_s[i], "group sum");
+  }
+  std::printf("jni_harness: tableOpNative groupby %" PRId64
+              " rows -> %" PRId64 " groups ok\n", n, out_rows);
+
+  /* -- 3. RowConversion round trip over HostBuffer handles ----------- */
+  /* table buffer = col buffers back-to-back, then validity vectors */
+  std::vector<jbyte> tbl_bytes;
+  auto append = [&tbl_bytes](const void* p, size_t nbytes) {
+    const auto* b = static_cast<const jbyte*>(p);
+    tbl_bytes.insert(tbl_bytes.end(), b, b + nbytes);
+  };
+  append(k.data(), n * 8);
+  append(v.data(), n * 8);
+  append(k_valid.data(), n);
+  std::vector<uint8_t> all_valid(n, 1);
+  append(all_valid.data(), n);
+
+  jlong th = Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferCreate(
+      env, cls, srt_mock::make_byte_array(tbl_bytes),
+      srt_mock::make_string("tbl"));
+  CHECK(!srt_mock::exception_pending() && th != 0, "bufferCreate");
+
+  jint row_size = Java_com_nvidia_spark_rapids_jni_RowConversion_rowSize(
+      env, cls, ids);
+  CHECK(row_size > 0, "rowSize");
+  jlong rows_h =
+      Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
+          env, cls, th, ids, n, 0, n);
+  CHECK(!srt_mock::exception_pending() && rows_h != 0, "convertToRows");
+
+  /* byte-exact vs the host codec (the golden row-format check) */
+  std::vector<uint8_t> want_rows(static_cast<size_t>(n) * row_size);
+  const int32_t tids[2] = {kInt64, kFloat64};
+  const void* cols[2] = {k.data(), v.data()};
+  const uint8_t* valids[2] = {k_valid.data(), nullptr};
+  CHECK(srt_pack_rows(tids, 2, cols, valids, n, want_rows.data()) == SRT_OK,
+        "host pack");
+  CHECK(srt_buffer_size(rows_h) == static_cast<int64_t>(want_rows.size()),
+        "rows size");
+  CHECK(std::memcmp(srt_buffer_data(rows_h), want_rows.data(),
+                    want_rows.size()) == 0,
+        "bridge rows != host codec rows");
+
+  jlongArray back =
+      Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(
+          env, cls, rows_h, ids, scales, n);
+  CHECK(!srt_mock::exception_pending() && back != nullptr,
+        "convertFromRows");
+  std::vector<jlong> bh = srt_mock::long_array_values(back);
+  CHECK(bh.size() == 4, "convertFromRows handle count");
+  CHECK(std::memcmp(srt_buffer_data(bh[0]), k.data(), n * 8) == 0,
+        "k column round trip");
+  CHECK(std::memcmp(srt_buffer_data(bh[1]), v.data(), n * 8) == 0,
+        "v column round trip");
+  CHECK(std::memcmp(srt_buffer_data(bh[2]), k_valid.data(), n) == 0,
+        "k validity round trip");
+  std::printf("jni_harness: RowConversion round trip ok (%d B/row)\n",
+              row_size);
+
+  /* -- 4. error paths must record pending Java exceptions ------------ */
+  CHECK_THROWS(
+      Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+          env, cls, nullptr, ids, scales, data, valid, n),
+      "null op_json must throw");
+  CHECK_THROWS(
+      Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+          env, cls, op, ids, srt_mock::make_int_array({0}), data, valid, n),
+      "length mismatch must throw");
+  CHECK_THROWS(
+      Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
+          env, cls, th, ids, n, n - 4, 8),
+      "out-of-bounds batch must throw");
+  CHECK_THROWS(
+      Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
+          env, cls, 0, ids, n, 0, n),
+      "null table handle must throw");
+  CHECK_THROWS(
+      Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+          env, cls, srt_mock::make_string("{\"op\": \"nope\"}"), ids,
+          scales, data, valid, n),
+      "unknown op must surface the runtime error");
+
+  /* -- 5. allocation-failure cleanup paths --------------------------- */
+  int64_t live_before = srt_live_handle_count();
+  srt_mock::fail_next_array_alloc();
+  jlongArray r1 = Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+      env, cls, op, ids, scales, data, valid, n);
+  CHECK(r1 == nullptr, "tableOpNative must fail on alloc failure");
+  CHECK(srt_live_handle_count() == live_before,
+        "tableOpNative leaked handles on alloc failure");
+  srt_mock::fail_next_array_alloc();
+  jlongArray r2 =
+      Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(
+          env, cls, rows_h, ids, scales, n);
+  CHECK(r2 == nullptr, "convertFromRows must fail on alloc failure");
+  CHECK(srt_live_handle_count() == live_before,
+        "convertFromRows leaked handles on alloc failure");
+  srt_mock::clear_exception();
+  std::printf("jni_harness: error + cleanup paths ok\n");
+
+  /* -- 6. release everything; registry must be empty ------------------ */
+  for (int64_t i = 0; i < out_cols; ++i) {
+    srt_buffer_release(pk[2 + 2 * out_cols + i]);
+    if (pk[2 + 3 * out_cols + i] != 0)
+      srt_buffer_release(pk[2 + 3 * out_cols + i]);
+  }
+  for (jlong h : bh) srt_buffer_release(h);
+  Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferRelease(env, cls,
+                                                            rows_h);
+  Java_com_nvidia_spark_rapids_jni_HostBuffer_bufferRelease(env, cls, th);
+  srt_buffer_release(hk);
+  srt_buffer_release(hv);
+  srt_buffer_release(hkv);
+  CHECK(srt_live_handle_count() == 0, "handle leak at exit");
+  srt_mock::reset();
+  std::printf("jni_harness: ok\n");
+  return 0;
+}
