@@ -1,40 +1,117 @@
-"""Benchmark: hash groupby-sum, 1M int64 rows (BASELINE.json config 1).
+"""Benchmark ladder: BASELINE.json configs 1-3 on the attached device.
 
-Measures the device groupby (sort-based, jitted, capped variant — no host
-syncs inside the timed region) against the CPU Arrow reference
-(pyarrow.Table.group_by), the baseline named in BASELINE.json. Prints one
-JSON line:
-  {"metric": ..., "value": rows/sec on device, "unit": "rows/s",
-   "vs_baseline": device_throughput / arrow_throughput}
+Prints ONE JSON line whose primary metric is the 100M-row groupby-sum
+(config 1 at scale) and whose `configs` array carries the full measured
+ladder:
+
+  config 1  hash groupby-sum at 1M / 16M / 100M int64 rows, vs CPU Arrow
+  config 2  row<->columnar transpose + cast/binaryop round trip
+  config 3  100M-row hash inner join (two-phase) + 100M-row sort
+
+Methodology (hardened per round-2 review — and corrected):
+  - SYNC BY HOST FETCH: on the tunneled TPU platform ("axon"),
+    ``jax.block_until_ready`` returns before the computation finishes
+    (measured: a 16M-row u64 sort "completes" in 30us by
+    block_until_ready but takes ~60ms to produce its first byte). The
+    r1/r2 headline (13.2G/11.1G rows/s, 92x/84x Arrow) timed async
+    ENQUEUE, not compute — that is the real story behind the apparent
+    r1->r2 "regression": both numbers were noise around dispatch
+    latency. Every timed region here ends with a one-element host fetch
+    that forces the computation (and pays one ~30-60ms tunnel
+    round-trip, which a real Spark driver would also pay).
+  - FRESH inputs per repetition where feasible (cycled tables), median +
+    min + spread over all reps, not best-of-N alone.
+  - every entry carries achieved bytes/s against the HBM peak
+    (v5e ~819 GB/s) as a bandwidth sanity line.
+  - numerical sanity asserts per config (sums match numpy oracles).
 """
 
 import json
+import statistics
+import sys
 import time
 
 import numpy as np
 
 
-def main():
+def _progress(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+HBM_PEAK_GBPS = {"tpu": 819.0, "axon": 819.0}  # v5e HBM bandwidth
+
+
+def _sync(out):
+    """Force completion: fetch ONE element of the first array leaf.
+
+    All outputs of a jitted call belong to one executable, so fetching
+    any element of any output waits for the whole computation. A full
+    np.asarray(out) would instead time the tunnel transfer of the
+    entire result."""
     import jax
 
-    import spark_rapids_jni_tpu as srt
+    leaves = [l for l in jax.tree.leaves(out) if hasattr(l, "dtype")]
+    if leaves:
+        np.asarray(leaves[0].ravel()[-1])
+    return out
+
+
+def _timeit(fn, inputs, reps_per_input=3):
+    """Time fn over (cycled) inputs; returns (median, min, std, last_out)."""
+    out = _sync(fn(*inputs[0]))  # compile/warmup
+    times = []
+    for _ in range(reps_per_input):
+        for inp in inputs:
+            t0 = time.perf_counter()
+            out = _sync(fn(*inp))
+            times.append(time.perf_counter() - t0)
+    return (
+        statistics.median(times),
+        min(times),
+        statistics.pstdev(times),
+        out,
+    )
+
+
+def _entry(config, name, rows, med, mn, std, bytes_moved, platform):
+    peak = HBM_PEAK_GBPS.get(platform)
+    gbps = bytes_moved / med / 1e9
+    e = {
+        "config": config,
+        "name": name,
+        "rows": rows,
+        "seconds_median": round(med, 6),
+        "seconds_min": round(mn, 6),
+        "spread": round(std / med, 3) if med else None,
+        "rows_per_s": round(rows / med, 1),
+        "achieved_gbps": round(gbps, 2),
+    }
+    if peak:
+        e["hbm_peak_gbps"] = peak
+        e["hbm_frac"] = round(gbps / peak, 4)
+    return e
+
+
+def bench_groupby(platform, n, n_inputs=2):
+    import jax
+
     from spark_rapids_jni_tpu.column import Column, Table
     from spark_rapids_jni_tpu.ops.groupby import (
         GroupbyAgg,
         groupby_aggregate_capped,
     )
 
-    n = 1_000_000
     n_keys = 10_000
     rng = np.random.default_rng(42)
-    k = rng.integers(0, n_keys, n, dtype=np.int64)
-    v = rng.integers(-1000, 1000, n, dtype=np.int64)
-
-    table = Table(
-        [Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"]
-    )
-    # materialize on device before timing
-    jax.block_until_ready(table.columns[0].data)
+    hosts = []
+    inputs = []
+    for _ in range(n_inputs):
+        k = rng.integers(0, n_keys, n, dtype=np.int64)
+        v = rng.integers(-1000, 1000, n, dtype=np.int64)
+        hosts.append((k, v))
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        jax.block_until_ready(t.columns[0].data)
+        inputs.append((t,))
 
     step = jax.jit(
         lambda t: groupby_aggregate_capped(
@@ -44,48 +121,234 @@ def main():
             num_segments=n_keys,
         )
     )
-    # warmup/compile
-    out = step(table)
-    jax.block_until_ready(out)
-
-    reps = 10
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = step(table)
-        jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
-    device_rows_per_s = n / best
-
-    # CPU Arrow baseline
-    try:
-        import pyarrow as pa
-
-        atbl = pa.table({"k": k, "v": v})
-        # warmup
-        atbl.group_by("k").aggregate([("v", "sum"), ("v", "count")])
-        abest = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            atbl.group_by("k").aggregate([("v", "sum"), ("v", "count")])
-            abest = min(abest, time.perf_counter() - t0)
-        arrow_rows_per_s = n / abest
-        vs = device_rows_per_s / arrow_rows_per_s
-    except ImportError:  # pragma: no cover
-        vs = float("nan")
-
-    # sanity: totals must agree
+    med, mn, std, out = _timeit(step, inputs)
+    # sanity: last-run totals must match numpy on the last-cycled input
     agg, ngroups = out
     total = int(np.asarray(agg["sum_v"].data)[: int(ngroups)].sum())
-    assert total == int(v.sum()), "groupby-sum mismatch vs numpy"
+    assert total == int(hosts[-1][1].sum()), "groupby-sum mismatch vs numpy"
+    return _entry(1, f"groupby_sum_{n // 1_000_000}M", n, med, mn, std,
+                  n * 16, platform), med
+
+
+def arrow_baseline(n):
+    """CPU Arrow groupby throughput (rows/s) on the config-1 shape."""
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover
+        return None
+    rng = np.random.default_rng(7)
+    k = rng.integers(0, 10_000, n, dtype=np.int64)
+    v = rng.integers(-1000, 1000, n, dtype=np.int64)
+    atbl = pa.table({"k": k, "v": v})
+    atbl.group_by("k").aggregate([("v", "sum"), ("v", "count")])  # warmup
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        atbl.group_by("k").aggregate([("v", "sum"), ("v", "count")])
+        best = min(best, time.perf_counter() - t0)
+    return n / best
+
+
+def bench_transpose(platform, n=4_000_000, n_inputs=2):
+    """Config 2: to_rows -> from_rows -> cast+binaryop on the result.
+
+    The CudfColumnVector round-trip shape: an 8-column fixed-width table
+    (the reference round-trip test schema, RowConversionTest.java:30-39)
+    packed to Spark UnsafeRow bytes and back, then a cast and an add to
+    stand in for the CudfColumnVector compute step.
+    """
+    import jax
+
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import rows as rows_mod
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops import binaryop
+    from spark_rapids_jni_tpu.ops.cast import cast as cast_fn
+
+    rng = np.random.default_rng(3)
+    schema = [
+        dt.INT64, dt.FLOAT64, dt.INT32, dt.BOOL8,
+        dt.FLOAT32, dt.INT8, dt.DType(dt.TypeId.DECIMAL32, -3),
+        dt.DType(dt.TypeId.DECIMAL64, -8),
+    ]
+    layout = rows_mod.compute_fixed_width_layout(schema)
+
+    def make_table():
+        cols = []
+        for d in schema:
+            npdt = np.dtype(d.storage_dtype)
+            if d.is_boolean:
+                arr = rng.integers(0, 2, n).astype(np.bool_)
+            elif d.is_floating:
+                arr = rng.standard_normal(n).astype(npdt)
+            else:
+                info = np.iinfo(npdt)
+                arr = rng.integers(
+                    info.min // 2, info.max // 2, n, dtype=npdt
+                )
+            valid = rng.random(n) > 0.1
+            cols.append(Column.from_numpy(arr, validity=valid, dtype=d))
+        t = Table(cols)
+        jax.block_until_ready(t.columns[0].data)
+        return t
+
+    inputs = [(make_table(),) for _ in range(n_inputs)]
+
+    def round_trip(t):
+        batches = rows_mod.to_rows(t, split=False)
+        back = rows_mod.from_rows(batches, schema)
+        c = cast_fn(back.columns[0], dt.FLOAT64)
+        return binaryop.add(c, back.columns[1])
+
+    med, mn, std, out = _timeit(round_trip, inputs)
+    # pack writes + unpack reads the packed bytes, plus column reads/writes
+    bytes_moved = n * layout.row_size * 2
+    return _entry(2, "transpose_cast_round_trip", n, med, mn, std,
+                  bytes_moved, platform)
+
+
+def bench_join(platform, n=100_000_000):
+    """Config 3: two-phase hash inner join + global sort at 100M rows."""
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.join import (
+        inner_join_capped,
+        inner_join_count,
+    )
+    from spark_rapids_jni_tpu.ops.sort import SortKey, sort_table
+    from spark_rapids_jni_tpu.parallel.shuffle import _round_capacity
+
+    rng = np.random.default_rng(11)
+    kl = rng.integers(0, n, n, dtype=np.int64)
+    kr = rng.integers(0, n, n, dtype=np.int64)
+    vl = rng.integers(-100, 100, n, dtype=np.int64)
+    vr = rng.integers(-100, 100, n, dtype=np.int64)
+    left = Table(
+        [Column.from_numpy(kl), Column.from_numpy(vl)], ["k", "lv"]
+    )
+    right = Table(
+        [Column.from_numpy(kr), Column.from_numpy(vr)], ["k", "rv"]
+    )
+    jax.block_until_ready(left.columns[0].data)
+    jax.block_until_ready(right.columns[0].data)
+
+    count_fn = jax.jit(lambda l, r: inner_join_count(l, r, ["k"]))
+    total = int(count_fn(left, right))
+    cap = _round_capacity(total)
+    join_fn = jax.jit(
+        lambda l, r: inner_join_capped(l, r, ["k"], capacity=cap)
+    )
+
+    def two_phase(l, r):
+        c = int(count_fn(l, r))  # phase 1 + the real host sync it implies
+        out, cnt = join_fn(l, r)
+        return out
+
+    med, mn, std, out = _timeit(
+        two_phase, [(left, right)], reps_per_input=2
+    )
+    # both sides read (16B/row each) + output written (3 int64 cols)
+    bytes_moved = 2 * n * 16 + total * 24
+    e1 = _entry(3, "inner_join_100M_two_phase", 2 * n, med, mn, std,
+                bytes_moved, platform)
+    e1["matches"] = total
+
+    sort_fn = jax.jit(lambda t: sort_table(t, [SortKey("k")]))
+    med, mn, std, _ = _timeit(sort_fn, [(left,)], reps_per_input=2)
+    e2 = _entry(3, "sort_100M_int64", n, med, mn, std, n * 16 * 2,
+                platform)
+    return [e1, e2]
+
+
+def bench_distributed_skew():
+    """Config 4 shape at 1e7 rows: zipf-skew distributed groupby through
+    the ragged-compact exchange on the virtual 8-device CPU mesh (the
+    multi-chip path; numbers are CPU-simulation, labeled as such)."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    # benchmarks.run sees the host-device-count flag + --devices and
+    # forces jax_platforms=cpu through the config API itself (env
+    # JAX_PLATFORMS alone is ineffective against the axon plugin)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "benchmarks.run", "--configs", "skew",
+             "--devices", "8", "--rows", "10000000"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        _progress(f"skew run produced no JSON: {out.stderr[-500:]}")
+    except Exception as e:  # pragma: no cover
+        _progress(f"skew run failed: {e}")
+    return None
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    entries = []
+
+    med_big = None
+    for n in (1_000_000, 16_000_000, 100_000_000):
+        _progress(f"config 1: groupby {n}")
+        e, med = bench_groupby(platform, n)
+        _progress(f"  {e}")
+        entries.append(e)
+        if n == 100_000_000:
+            med_big = med
+    _progress("config 2: transpose round trip")
+    e2 = bench_transpose(platform)
+    _progress(f"  {e2}")
+    entries.append(e2)
+    _progress("config 3: join + sort")
+    e3 = bench_join(platform)
+    _progress(f"  {e3}")
+    entries.extend(e3)
+
+    _progress("config 4: distributed zipf skew, 8-device CPU mesh")
+    e4 = bench_distributed_skew()
+    if e4:
+        _progress(f"  {e4}")
+        entries.append(e4)
+
+    _progress("arrow baseline 100M")
+    arrow = arrow_baseline(100_000_000)
+    device_rows_per_s = 100_000_000 / med_big
+    vs = device_rows_per_s / arrow if arrow else float("nan")
 
     print(
         json.dumps(
             {
-                "metric": "groupby_sum_1M_int64",
+                "metric": "groupby_sum_100M_int64",
                 "value": round(device_rows_per_s, 1),
                 "unit": "rows/s",
                 "vs_baseline": round(vs, 3),
+                "platform": platform,
+                "configs": entries,
+                "note": (
+                    "METRIC CHANGED from groupby_sum_1M_int64: r1/r2 "
+                    "timed async enqueue (block_until_ready does not "
+                    "wait on the tunneled 'axon' platform), so 13.2G/"
+                    "11.1G rows/s and the 92x->84x 'regression' were "
+                    "dispatch-latency noise, not compute. This round "
+                    "syncs by host fetch and reports the 100M-row shape "
+                    "where compute dominates the ~30-60ms tunnel "
+                    "round-trip; vs_baseline is CPU Arrow on the SAME "
+                    "100M shape. configs[] carries the full ladder "
+                    "with achieved GB/s vs HBM peak."
+                ),
             }
         )
     )

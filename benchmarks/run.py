@@ -44,13 +44,16 @@ def main():
     ap.add_argument("--repeats", type=int, default=1)
     args = ap.parse_args()
     configs = {c.strip() for c in args.configs.split(",")}
-    unknown = configs - {"3", "4"}
+    unknown = configs - {"3", "4", "skew"}
     if unknown:
         raise SystemExit(
             f"unknown configs {sorted(unknown)}: this runner implements 3 "
-            "(single-chip) and 4 (distributed); config 5 is config 4 at "
-            "full scale on real hardware"
+            "(single-chip), 4 (distributed) and skew (distributed zipf "
+            "groupby at 1e7 rows); config 5 is config 4 at full scale on "
+            "real hardware"
         )
+    if "skew" in configs and not args.devices:
+        raise SystemExit("--configs skew needs --devices N")
     if "4" in configs and not args.devices:
         raise SystemExit("--configs 4 needs --devices N")
 
@@ -87,6 +90,48 @@ def main():
                 "rows_per_sec": round(args.rows / secs),
                 "platform": platform,
             }))
+
+    if "skew" in configs:
+        # Round-3 VERDICT item 5: the r2 skew-OOM shape at real size.
+        # Zipf(1.3) keys over >=1e7 rows through the ragged-compact
+        # exchange; records wall-clock, the per-device received-buffer
+        # rows (must track the hot partition's REAL total, not
+        # P x the hottest pair), and peak RSS.
+        import resource
+
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+        from spark_rapids_jni_tpu.parallel import distributed_groupby
+        from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+        n = max(args.rows, 10_000_000)
+        n -= n % args.devices
+        rng = np.random.default_rng(5)
+        k = np.minimum(rng.zipf(1.3, n), 100_000).astype(np.int64)
+        v = rng.integers(-100, 100, n, dtype=np.int64)
+        t = Table.from_pydict({"k": k, "v": v})
+        mesh = make_mesh(args.devices)
+
+        aggs = [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")]
+        distributed_groupby(t, ["k"], aggs, mesh)  # compile warmup
+        t0 = time.perf_counter()
+        agg, ngroups, overflow = distributed_groupby(t, ["k"], aggs, mesh)
+        total_groups = int(np.asarray(ngroups).sum())
+        secs = time.perf_counter() - t0
+        hot = int(np.asarray(agg["count_v"].data).max())
+        buf_rows = int(agg["k"].data.shape[0]) // args.devices
+        peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+        assert int(np.asarray(overflow).max()) <= 0
+        want_groups = len(np.unique(k))
+        assert total_groups == want_groups, (total_groups, want_groups)
+        print(json.dumps({
+            "config": "4-skew", "rows": n, "devices": args.devices,
+            "seconds": round(secs, 3), "groups": total_groups,
+            "hot_key_rows": hot, "recv_buffer_rows_per_device": buf_rows,
+            "peak_rss_mb": peak_mb, "platform": platform,
+        }))
 
     if "4" in configs and args.devices:
         from spark_rapids_jni_tpu.parallel.mesh import make_mesh
