@@ -261,6 +261,76 @@ def bench_join(platform, n=100_000_000):
     return [e1, e2]
 
 
+def bench_resident_chain(platform, n=4_000_000):
+    """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
+    through device-RESIDENT table handles vs the bytes-wire path that
+    round-trips every op's inputs/outputs through host memory."""
+    import time as _time
+
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import runtime_bridge as rb
+
+    rng = np.random.default_rng(9)
+    k = rng.integers(0, 1000, n, dtype=np.int64)
+    v = rng.integers(-100, 100, n, dtype=np.int64)
+    mask = (v > 0).astype(np.uint8)
+    i64 = int(dt.TypeId.INT64)
+    b8 = int(dt.TypeId.BOOL8)
+    op_filter = json.dumps({"op": "filter", "mask": 2})
+    op_sort = json.dumps({"op": "sort_by", "keys": [{"column": 0}]})
+    op_group = json.dumps(
+        {"op": "groupby", "by": [0],
+         "aggs": [{"column": 1, "agg": "sum"}]}
+    )
+
+    def wire_chain():
+        t1 = rb.table_op_wire(
+            op_filter, [i64, i64, b8], [0, 0, 0],
+            [k.tobytes(), v.tobytes(), mask.tobytes()],
+            [None, None, None], n,
+        )
+        t2 = rb.table_op_wire(op_sort, t1[0], t1[1], t1[2], t1[3], t1[4])
+        t3 = rb.table_op_wire(op_group, t2[0], t2[1], t2[2], t2[3], t2[4])
+        return t3
+
+    def resident_chain():
+        tid = rb.table_upload_wire(
+            [i64, i64, b8], [0, 0, 0],
+            [k.tobytes(), v.tobytes(), mask.tobytes()],
+            [None, None, None], n,
+        )
+        f = rb.table_op_resident(op_filter, [tid])
+        s = rb.table_op_resident(op_sort, [f])
+        g = rb.table_op_resident(op_group, [s])
+        out = rb.table_download_wire(g)
+        for t in (tid, f, s, g):
+            rb.table_free(t)
+        return out
+
+    def best_of(fn, reps=3):
+        out = fn()  # warm/compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            out = fn()
+            best = min(best, _time.perf_counter() - t0)
+        return best, out
+
+    wire_s, wire_out = best_of(wire_chain)
+    res_s, res_out = best_of(resident_chain)
+    assert wire_out[4] == res_out[4], "chain row counts differ"
+    assert wire_out[2][1] == res_out[2][1], "chain sums differ"
+    return {
+        "config": "resident-chain",
+        "name": "filter_sort_groupby_3op_chain",
+        "rows": n,
+        "wire_seconds": round(wire_s, 4),
+        "resident_seconds": round(res_s, 4),
+        "speedup": round(wire_s / res_s, 2),
+        "platform": platform,
+    }
+
+
 def bench_distributed_skew():
     """Config 4 shape at 1e7 rows: zipf-skew distributed groupby through
     the ragged-compact exchange on the virtual 8-device CPU mesh (the
@@ -316,6 +386,11 @@ def main():
     e3 = bench_join(platform)
     _progress(f"  {e3}")
     entries.extend(e3)
+
+    _progress("resident chain vs wire (3-op)")
+    ec = bench_resident_chain(platform)
+    _progress(f"  {ec}")
+    entries.append(ec)
 
     _progress("config 4: distributed zipf skew, 8-device CPU mesh")
     e4 = bench_distributed_skew()

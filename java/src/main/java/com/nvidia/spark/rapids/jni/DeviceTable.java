@@ -85,6 +85,17 @@ public class DeviceTable {
     }
     long[] packed = tableOpNative(opJson, typeIds, scales, dataHandles,
                                   validHandles, numRows);
+    return wrapPacked(packed);
+  }
+
+  /**
+   * Wrap the packed [numCols, numRows, ids..., scales..., data...,
+   * valid...] long array into a Result. On a wrap failure mid-loop,
+   * closes the wrappers that exist and releases the raw handles never
+   * wrapped (the RowConversion cleanup discipline — registry buffers
+   * must not leak).
+   */
+  private static Result wrapPacked(long[] packed) {
     int outCols = (int) packed[0];
     long outRows = packed[1];
     int[] outIds = new int[outCols];
@@ -102,9 +113,6 @@ public class DeviceTable {
         outValid[i] = vh == 0 ? null : new HostBuffer(vh);
       }
     } catch (RuntimeException e) {
-      // wrap failure mid-loop: close the wrappers that exist, then
-      // release the raw handles never wrapped (the RowConversion
-      // cleanup discipline — registry buffers must not leak)
       for (int j = 0; j < outCols; j++) {
         if (outData[j] != null) {
           outData[j].close();
@@ -131,4 +139,59 @@ public class DeviceTable {
   private static native long[] tableOpNative(String opJson, int[] typeIds,
                                              int[] scales, long[] colData,
                                              long[] colValid, long numRows);
+
+  /*
+   * Device-resident table chaining: the reference passes jlong pointers
+   * to DEVICE-resident tables between calls with no host copy between
+   * ops (RowConversionJni.cpp:31,54). These methods mirror that model:
+   * upload once, chain ops over opaque table ids, download once. A
+   * Spark stage chaining filter -> join -> groupby pays the host<->device
+   * wire cost twice total instead of twice per op.
+   */
+
+  /** Upload host column buffers to a device-resident table; returns its
+   * id. Free with {@link #tableFree}. */
+  public static long tableUpload(int[] typeIds, int[] scales,
+                                 HostBuffer[] colData, HostBuffer[] colValid,
+                                 long numRows) {
+    int n = typeIds.length;
+    long[] dataHandles = new long[n];
+    long[] validHandles = new long[n];
+    for (int i = 0; i < n; i++) {
+      dataHandles[i] = colData[i].getHandle();
+      validHandles[i] = colValid[i] == null ? 0 : colValid[i].getHandle();
+    }
+    return tableUploadNative(typeIds, scales, dataHandles, validHandles,
+                             numRows);
+  }
+
+  /** Run one op over resident tables; the result STAYS resident (op
+   * "join": inputs[0] = left, inputs[1] = right; "concat": all). */
+  public static long tableOpResident(String opJson, long[] inputs) {
+    return tableOpResidentNative(opJson, inputs);
+  }
+
+  /** Download a resident table into caller-owned host buffers (same
+   * Result contract as {@link #tableOp}). */
+  public static Result tableDownload(long table) {
+    return wrapPacked(tableDownloadNative(table));
+  }
+
+  /** Rows in a resident table. */
+  public static native long tableNumRows(long table);
+
+  /** Drop a resident table (its device buffers become collectable). */
+  public static native void tableFree(long table);
+
+  /** Live resident tables — the device-table leak report. */
+  public static native long residentTableCount();
+
+  private static native long tableUploadNative(int[] typeIds, int[] scales,
+                                               long[] colData,
+                                               long[] colValid, long numRows);
+
+  private static native long tableOpResidentNative(String opJson,
+                                                   long[] inputs);
+
+  private static native long[] tableDownloadNative(long table);
 }

@@ -87,14 +87,36 @@ def _column_to_wire(c: Column):
     )
 
 
-def _dispatch(op: dict, table: Table) -> Table:
-    """Run one op on device; returns the result Table."""
+def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
+    """Run one op on device; returns the result Table.
+
+    ``rest`` carries additional input tables for multi-table ops
+    (``join`` takes the probe side as ``table`` and the build side as
+    ``rest[0]``; ``concat`` appends every table in ``rest``).
+    """
     import jax.numpy as jnp
 
     from . import ops
     from . import rows as rows_mod
 
     name = op["op"]
+    if name == "join":
+        how = op.get("how", "inner")
+        fn = {
+            "inner": ops.inner_join,
+            "left": ops.left_join,
+            "right": ops.right_join,
+            "full": ops.full_join,
+            "semi": ops.semi_join,
+            "anti": ops.anti_join,
+        }.get(how)
+        if fn is None:
+            raise ValueError(f"unknown join how={how!r}")
+        if not rest:
+            raise ValueError("join needs two input tables")
+        return fn(table, rest[0], op["on"])
+    if name == "concat":
+        return ops.concatenate([table, *rest])
     if name == "groupby":
         from .ops.groupby import GroupbyAgg
 
@@ -167,3 +189,101 @@ def platform() -> str:
     import jax
 
     return jax.devices()[0].platform
+
+
+# ---------------------------------------------------------------------------
+# Device-resident table handles (round-3 VERDICT item 4)
+#
+# The reference passes jlong pointers to DEVICE-resident cudf tables
+# between JNI calls with no host copy in between
+# (RowConversionJni.cpp:31,54). The wire path above copies host->device
+# per op; these functions give native callers the same chaining
+# capability: a table id maps to a Table whose buffers stay on the XLA
+# backend, ops consume and produce ids, and bytes only cross the
+# boundary at upload/download.
+# ---------------------------------------------------------------------------
+
+import itertools
+import threading
+
+_RESIDENT: dict = {}
+# Lock + atomic counter: Spark executors call through the JNI bridge
+# from many threads (the GilGuard path), and the GIL can switch between
+# a read-increment pair — an unsynchronized counter could hand two
+# threads the same table id.
+_RESIDENT_LOCK = threading.Lock()
+_NEXT_TABLE_ID = itertools.count(1)
+
+
+def _resident_get(table_id: int) -> Table:
+    with _RESIDENT_LOCK:
+        t = _RESIDENT.get(int(table_id))
+    if t is None:
+        raise KeyError(f"unknown device table id {table_id}")
+    return t
+
+
+def _resident_put(t: Table) -> int:
+    tid = next(_NEXT_TABLE_ID)
+    with _RESIDENT_LOCK:
+        _RESIDENT[tid] = t
+    return tid
+
+
+def table_upload_wire(
+    type_ids: Sequence[int],
+    scales: Sequence[int],
+    datas: Sequence[Optional[bytes]],
+    valids: Sequence[Optional[bytes]],
+    num_rows: int,
+) -> int:
+    """Host bytes -> device-resident table; returns its id."""
+    cols = [
+        _column_from_wire(t, s, d, v, num_rows)
+        for t, s, d, v in zip(type_ids, scales, datas, valids)
+    ]
+    return _resident_put(Table(cols))
+
+
+def table_op_resident(op_json: str, table_ids: Sequence[int]) -> int:
+    """Run one op over resident tables; the result STAYS resident.
+
+    No host transfer happens here — chaining filter -> join -> groupby
+    costs upload + download once, not per op.
+    """
+    if not table_ids:
+        raise ValueError("table_op_resident needs at least one input")
+    op = json.loads(op_json)
+    tables = [_resident_get(t) for t in table_ids]
+    out = _dispatch(op, tables[0], tables[1:])
+    return _resident_put(out)
+
+
+def table_download_wire(table_id: int):
+    """Resident table -> the wire 5-tuple of table_op_wire."""
+    t = _resident_get(table_id)
+    out_t, out_s, out_d, out_v = [], [], [], []
+    for c in t.columns:
+        ti, s, d, v = _column_to_wire(c)
+        out_t.append(ti)
+        out_s.append(s)
+        out_d.append(d)
+        out_v.append(v)
+    return out_t, out_s, out_d, out_v, int(t.row_count)
+
+
+def table_num_rows(table_id: int) -> int:
+    return int(_resident_get(table_id).row_count)
+
+
+def table_free(table_id: int) -> None:
+    with _RESIDENT_LOCK:
+        gone = _RESIDENT.pop(int(table_id), None) is None
+    if gone:
+        raise KeyError(f"unknown device table id {table_id}")
+
+
+def resident_table_count() -> int:
+    """Live resident tables (leak-report analog for device tables)."""
+    with _RESIDENT_LOCK:
+        return len(_RESIDENT)

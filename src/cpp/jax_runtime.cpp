@@ -57,6 +57,27 @@ srt_status srt_jax_table_op(const char*, const int32_t*, const int32_t*,
                             srt_handle*, srt_handle*, int64_t*) {
   return srt_jax_init();
 }
+srt_status srt_jax_table_upload(const int32_t*, const int32_t*, int32_t,
+                                const srt_handle*, const srt_handle*,
+                                int64_t, srt_table*) {
+  return srt_jax_init();
+}
+srt_status srt_jax_table_op_resident(const char*, const srt_table*,
+                                     int32_t, srt_table*) {
+  return srt_jax_init();
+}
+srt_status srt_jax_table_download(srt_table, int32_t, int32_t*, int32_t*,
+                                  int32_t*, srt_handle*, srt_handle*,
+                                  int64_t*) {
+  return srt_jax_init();
+}
+srt_status srt_jax_table_num_rows(srt_table, int64_t*) {
+  return srt_jax_init();
+}
+srt_status srt_jax_table_free(srt_table) { return srt_jax_init(); }
+srt_status srt_jax_resident_table_count(int64_t*) {
+  return srt_jax_init();
+}
 }
 
 #else  // SRT_EMBED_JAX
@@ -198,6 +219,129 @@ PyObject* buffer_to_py(srt_handle h) {
   return bytes;
 }
 
+/* Build the four wire argument lists (ids, scales, datas, valids) from
+ * registry handles; throws with everything released on failure. */
+struct WireArgs {
+  PyObject* ids = nullptr;
+  PyObject* scales = nullptr;
+  PyObject* datas = nullptr;
+  PyObject* valids = nullptr;
+
+  ~WireArgs() {
+    Py_XDECREF(ids);
+    Py_XDECREF(scales);
+    Py_XDECREF(datas);
+    Py_XDECREF(valids);
+  }
+};
+
+void build_wire_args(WireArgs& w, const int32_t* type_ids,
+                     const int32_t* scales, int32_t num_columns,
+                     const srt_handle* col_data,
+                     const srt_handle* col_valid) {
+  w.ids = PyList_New(num_columns);
+  w.scales = PyList_New(num_columns);
+  w.datas = PyList_New(num_columns);
+  w.valids = PyList_New(num_columns);
+  expects(w.ids != nullptr && w.scales != nullptr && w.datas != nullptr &&
+              w.valids != nullptr,
+          SRT_ERR_UNKNOWN, "argument list allocation failed");
+  for (int32_t i = 0; i < num_columns; ++i) {
+    PyObject* id_obj = PyLong_FromLong(type_ids[i]);
+    PyObject* sc_obj = PyLong_FromLong(scales[i]);
+    expects(id_obj != nullptr && sc_obj != nullptr, SRT_ERR_UNKNOWN,
+            "int allocation failed");
+    PyList_SET_ITEM(w.ids, i, id_obj);
+    PyList_SET_ITEM(w.scales, i, sc_obj);
+    PyList_SET_ITEM(w.datas, i, buffer_to_py(col_data[i]));
+    PyList_SET_ITEM(w.valids, i, buffer_to_py(col_valid[i]));
+  }
+}
+
+/* Validate + unpack a (type_ids, scales, datas, valids, num_rows) wire
+ * result into freshly created registry handles. Borrows `res`; on any
+ * failure every handle created so far is released and an srt_error is
+ * thrown — the registry can never leak (RowConversion.java cleanup
+ * discipline). */
+void unpack_wire_result(PyObject* res, int32_t max_out_columns,
+                        int32_t* out_type_ids, int32_t* out_scales,
+                        int32_t* out_num_columns, srt_handle* out_col_data,
+                        srt_handle* out_col_valid, int64_t* out_num_rows) {
+  if (!PyTuple_Check(res) || PyTuple_GET_SIZE(res) != 5) {
+    throw srt_error(SRT_ERR_UNKNOWN, "wire result: bad shape");
+  }
+  PyObject* r_ids = PyTuple_GET_ITEM(res, 0);
+  PyObject* r_scales = PyTuple_GET_ITEM(res, 1);
+  PyObject* r_datas = PyTuple_GET_ITEM(res, 2);
+  PyObject* r_valids = PyTuple_GET_ITEM(res, 3);
+  PyObject* r_rows = PyTuple_GET_ITEM(res, 4);
+  if (!PyList_Check(r_ids) || !PyList_Check(r_scales) ||
+      !PyList_Check(r_datas) || !PyList_Check(r_valids) ||
+      !PyLong_Check(r_rows)) {
+    throw srt_error(SRT_ERR_UNKNOWN, "wire result: bad types");
+  }
+  Py_ssize_t n_out = PyList_GET_SIZE(r_ids);
+  if (PyList_GET_SIZE(r_scales) != n_out ||
+      PyList_GET_SIZE(r_datas) != n_out ||
+      PyList_GET_SIZE(r_valids) != n_out) {
+    throw srt_error(SRT_ERR_UNKNOWN, "wire result: ragged lists");
+  }
+  if (n_out > max_out_columns) {
+    throw srt_error(SRT_ERR_OVERFLOW,
+                    "result has more columns than max_out_columns");
+  }
+  std::vector<srt_handle> created;
+  created.reserve(static_cast<size_t>(2 * n_out));
+  try {
+    for (Py_ssize_t i = 0; i < n_out; ++i) {
+      PyObject* d = PyList_GetItem(r_datas, i);
+      PyObject* v = PyList_GetItem(r_valids, i);
+      PyObject* id_obj = PyList_GetItem(r_ids, i);
+      PyObject* sc_obj = PyList_GetItem(r_scales, i);
+      expects(id_obj != nullptr && PyLong_Check(id_obj) &&
+                  sc_obj != nullptr && PyLong_Check(sc_obj),
+              SRT_ERR_UNKNOWN, "wire result: non-int id/scale");
+      expects(d != nullptr && PyBytes_Check(d), SRT_ERR_UNKNOWN,
+              "wire result: data not bytes");
+      srt_handle hd = srt_buffer_create(
+          PyBytes_AS_STRING(d), PyBytes_GET_SIZE(d), "jax-op-out");
+      expects(hd != 0, SRT_ERR_UNKNOWN, "buffer create failed");
+      created.push_back(hd);
+      srt_handle hv = 0;
+      if (v != nullptr && v != Py_None) {
+        expects(PyBytes_Check(v), SRT_ERR_UNKNOWN,
+                "wire result: validity not bytes");
+        hv = srt_buffer_create(PyBytes_AS_STRING(v), PyBytes_GET_SIZE(v),
+                               "jax-op-out-valid");
+        expects(hv != 0, SRT_ERR_UNKNOWN, "buffer create failed");
+        created.push_back(hv);
+      }
+      out_type_ids[i] = static_cast<int32_t>(PyLong_AsLong(id_obj));
+      out_scales[i] = static_cast<int32_t>(PyLong_AsLong(sc_obj));
+      out_col_data[i] = hd;
+      out_col_valid[i] = hv;
+    }
+  } catch (...) {
+    for (srt_handle h : created) srt_buffer_release(h);
+    throw;
+  }
+  *out_num_columns = static_cast<int32_t>(n_out);
+  *out_num_rows = static_cast<int64_t>(PyLong_AsLongLong(r_rows));
+}
+
+/* Call a bridge function returning an int64 (table ids, counts). */
+int64_t call_int64(PyObject* res, const char* where) {
+  if (res == nullptr) throw_python_error(where);
+  if (!PyLong_Check(res)) {
+    Py_DECREF(res);
+    throw srt_error(SRT_ERR_UNKNOWN,
+                    std::string(where) + ": non-int result");
+  }
+  int64_t out = static_cast<int64_t>(PyLong_AsLongLong(res));
+  Py_DECREF(res);
+  return out;
+}
+
 }  // namespace
 
 extern "C" {
@@ -249,120 +393,159 @@ srt_status srt_jax_table_op(
     ensure_init();
     GilGuard gil;
 
-    PyObject* t_ids = nullptr;
-    PyObject* t_scales = nullptr;
-    PyObject* datas = nullptr;
-    PyObject* valids = nullptr;
     PyObject* res = nullptr;
     try {
-      t_ids = PyList_New(num_columns);
-      t_scales = PyList_New(num_columns);
-      datas = PyList_New(num_columns);
-      valids = PyList_New(num_columns);
-      expects(t_ids != nullptr && t_scales != nullptr &&
-                  datas != nullptr && valids != nullptr,
-              SRT_ERR_UNKNOWN, "argument list allocation failed");
-      for (int32_t i = 0; i < num_columns; ++i) {
-        PyObject* id_obj = PyLong_FromLong(type_ids[i]);
-        PyObject* sc_obj = PyLong_FromLong(scales[i]);
-        expects(id_obj != nullptr && sc_obj != nullptr, SRT_ERR_UNKNOWN,
-                "int allocation failed");
-        PyList_SET_ITEM(t_ids, i, id_obj);
-        PyList_SET_ITEM(t_scales, i, sc_obj);
-        PyList_SET_ITEM(datas, i, buffer_to_py(col_data[i]));
-        PyList_SET_ITEM(valids, i, buffer_to_py(col_valid[i]));
-      }
+      WireArgs w;
+      build_wire_args(w, type_ids, scales, num_columns, col_data,
+                      col_valid);
       PyObject* fn = bridge_attr("table_op_wire");
       res = PyObject_CallFunction(
-          fn, "sOOOOL", op_json, t_ids, t_scales, datas, valids,
+          fn, "sOOOOL", op_json, w.ids, w.scales, w.datas, w.valids,
           static_cast<long long>(num_rows));
       Py_DECREF(fn);
       if (res == nullptr) throw_python_error("table_op_wire");
     } catch (...) {
-      Py_XDECREF(t_ids);
-      Py_XDECREF(t_scales);
-      Py_XDECREF(datas);
-      Py_XDECREF(valids);
       if (PyErr_Occurred()) PyErr_Clear();
       throw;
     }
-    Py_DECREF(t_ids);
-    Py_DECREF(t_scales);
-    Py_DECREF(datas);
-    Py_DECREF(valids);
-
-    /* result: (type_ids, scales, datas, valids, num_rows) — validate
-     * the whole shape before touching anything, so a malformed bridge
-     * result is an error, never SRT_OK with garbage counts */
-    if (!PyTuple_Check(res) || PyTuple_GET_SIZE(res) != 5) {
-      Py_DECREF(res);
-      throw srt_error(SRT_ERR_UNKNOWN, "table_op_wire: bad result shape");
-    }
-    PyObject* r_ids = PyTuple_GET_ITEM(res, 0);
-    PyObject* r_scales = PyTuple_GET_ITEM(res, 1);
-    PyObject* r_datas = PyTuple_GET_ITEM(res, 2);
-    PyObject* r_valids = PyTuple_GET_ITEM(res, 3);
-    PyObject* r_rows = PyTuple_GET_ITEM(res, 4);
-    if (!PyList_Check(r_ids) || !PyList_Check(r_scales) ||
-        !PyList_Check(r_datas) || !PyList_Check(r_valids) ||
-        !PyLong_Check(r_rows)) {
-      Py_DECREF(res);
-      throw srt_error(SRT_ERR_UNKNOWN, "table_op_wire: bad result types");
-    }
-    Py_ssize_t n_out = PyList_GET_SIZE(r_ids);
-    if (PyList_GET_SIZE(r_scales) != n_out ||
-        PyList_GET_SIZE(r_datas) != n_out ||
-        PyList_GET_SIZE(r_valids) != n_out) {
-      Py_DECREF(res);
-      throw srt_error(SRT_ERR_UNKNOWN,
-                      "table_op_wire: ragged result lists");
-    }
-    if (n_out > max_out_columns) {
-      Py_DECREF(res);
-      throw srt_error(SRT_ERR_OVERFLOW,
-                      "result has more columns than max_out_columns");
-    }
-    /* Create all output buffers, releasing on partial failure so the
-     * registry never leaks (the RowConversion.java cleanup discipline). */
-    std::vector<srt_handle> created;
-    created.reserve(static_cast<size_t>(2 * n_out));
     try {
-      for (Py_ssize_t i = 0; i < n_out; ++i) {
-        PyObject* d = PyList_GetItem(r_datas, i);
-        PyObject* v = PyList_GetItem(r_valids, i);
-        PyObject* id_obj = PyList_GetItem(r_ids, i);
-        PyObject* sc_obj = PyList_GetItem(r_scales, i);
-        expects(id_obj != nullptr && PyLong_Check(id_obj) &&
-                    sc_obj != nullptr && PyLong_Check(sc_obj),
-                SRT_ERR_UNKNOWN, "table_op_wire: non-int id/scale");
-        expects(d != nullptr && PyBytes_Check(d), SRT_ERR_UNKNOWN,
-                "table_op_wire: data not bytes");
-        srt_handle hd = srt_buffer_create(
-            PyBytes_AS_STRING(d), PyBytes_GET_SIZE(d), "jax-op-out");
-        expects(hd != 0, SRT_ERR_UNKNOWN, "buffer create failed");
-        created.push_back(hd);
-        srt_handle hv = 0;
-        if (v != nullptr && v != Py_None) {
-          expects(PyBytes_Check(v), SRT_ERR_UNKNOWN,
-                  "table_op_wire: validity not bytes");
-          hv = srt_buffer_create(PyBytes_AS_STRING(v),
-                                 PyBytes_GET_SIZE(v), "jax-op-out-valid");
-          expects(hv != 0, SRT_ERR_UNKNOWN, "buffer create failed");
-          created.push_back(hv);
-        }
-        out_type_ids[i] = static_cast<int32_t>(PyLong_AsLong(id_obj));
-        out_scales[i] = static_cast<int32_t>(PyLong_AsLong(sc_obj));
-        out_col_data[i] = hd;
-        out_col_valid[i] = hv;
-      }
+      unpack_wire_result(res, max_out_columns, out_type_ids, out_scales,
+                         out_num_columns, out_col_data, out_col_valid,
+                         out_num_rows);
     } catch (...) {
-      for (srt_handle h : created) srt_buffer_release(h);
       Py_DECREF(res);
       throw;
     }
-    *out_num_columns = static_cast<int32_t>(n_out);
-    *out_num_rows = static_cast<int64_t>(PyLong_AsLongLong(r_rows));
     Py_DECREF(res);
+  });
+}
+
+srt_status srt_jax_table_upload(
+    const int32_t* type_ids, const int32_t* scales, int32_t num_columns,
+    const srt_handle* col_data, const srt_handle* col_valid,
+    int64_t num_rows, srt_table* out_table) {
+  return translate([&] {
+    expects(num_columns > 0 && type_ids != nullptr && scales != nullptr &&
+                col_data != nullptr && col_valid != nullptr,
+            SRT_ERR_NULLPTR, "null column arrays");
+    expects(out_table != nullptr, SRT_ERR_NULLPTR, "null out_table");
+    ensure_init();
+    GilGuard gil;
+    PyObject* res = nullptr;
+    try {
+      WireArgs w;
+      build_wire_args(w, type_ids, scales, num_columns, col_data,
+                      col_valid);
+      PyObject* fn = bridge_attr("table_upload_wire");
+      res = PyObject_CallFunction(
+          fn, "OOOOL", w.ids, w.scales, w.datas, w.valids,
+          static_cast<long long>(num_rows));
+      Py_DECREF(fn);
+    } catch (...) {
+      if (PyErr_Occurred()) PyErr_Clear();
+      throw;
+    }
+    *out_table = call_int64(res, "table_upload_wire");
+  });
+}
+
+srt_status srt_jax_table_op_resident(
+    const char* op_json, const srt_table* inputs, int32_t num_inputs,
+    srt_table* out_table) {
+  return translate([&] {
+    expects(op_json != nullptr, SRT_ERR_NULLPTR, "null op_json");
+    expects(inputs != nullptr && num_inputs > 0, SRT_ERR_NULLPTR,
+            "null inputs");
+    expects(out_table != nullptr, SRT_ERR_NULLPTR, "null out_table");
+    ensure_init();
+    GilGuard gil;
+    PyObject* res = nullptr;
+    PyObject* ids = nullptr;
+    try {
+      ids = PyList_New(num_inputs);
+      expects(ids != nullptr, SRT_ERR_UNKNOWN, "list allocation failed");
+      for (int32_t i = 0; i < num_inputs; ++i) {
+        PyObject* v = PyLong_FromLongLong(inputs[i]);
+        expects(v != nullptr, SRT_ERR_UNKNOWN, "int allocation failed");
+        PyList_SET_ITEM(ids, i, v);
+      }
+      PyObject* fn = bridge_attr("table_op_resident");
+      res = PyObject_CallFunction(fn, "sO", op_json, ids);
+      Py_DECREF(fn);
+      Py_DECREF(ids);
+    } catch (...) {
+      Py_XDECREF(ids);
+      if (PyErr_Occurred()) PyErr_Clear();
+      throw;
+    }
+    *out_table = call_int64(res, "table_op_resident");
+  });
+}
+
+srt_status srt_jax_table_download(
+    srt_table table, int32_t max_out_columns, int32_t* out_type_ids,
+    int32_t* out_scales, int32_t* out_num_columns,
+    srt_handle* out_col_data, srt_handle* out_col_valid,
+    int64_t* out_num_rows) {
+  return translate([&] {
+    expects(out_type_ids != nullptr && out_scales != nullptr &&
+                out_num_columns != nullptr && out_col_data != nullptr &&
+                out_col_valid != nullptr && out_num_rows != nullptr,
+            SRT_ERR_NULLPTR, "null output arrays");
+    ensure_init();
+    GilGuard gil;
+    PyObject* fn = bridge_attr("table_download_wire");
+    PyObject* res =
+        PyObject_CallFunction(fn, "L", static_cast<long long>(table));
+    Py_DECREF(fn);
+    if (res == nullptr) throw_python_error("table_download_wire");
+    try {
+      unpack_wire_result(res, max_out_columns, out_type_ids, out_scales,
+                         out_num_columns, out_col_data, out_col_valid,
+                         out_num_rows);
+    } catch (...) {
+      Py_DECREF(res);
+      throw;
+    }
+    Py_DECREF(res);
+  });
+}
+
+srt_status srt_jax_table_num_rows(srt_table table, int64_t* out_num_rows) {
+  return translate([&] {
+    expects(out_num_rows != nullptr, SRT_ERR_NULLPTR, "null out");
+    ensure_init();
+    GilGuard gil;
+    PyObject* fn = bridge_attr("table_num_rows");
+    PyObject* res =
+        PyObject_CallFunction(fn, "L", static_cast<long long>(table));
+    Py_DECREF(fn);
+    *out_num_rows = call_int64(res, "table_num_rows");
+  });
+}
+
+srt_status srt_jax_table_free(srt_table table) {
+  return translate([&] {
+    ensure_init();
+    GilGuard gil;
+    PyObject* fn = bridge_attr("table_free");
+    PyObject* res =
+        PyObject_CallFunction(fn, "L", static_cast<long long>(table));
+    Py_DECREF(fn);
+    if (res == nullptr) throw_python_error("table_free");
+    Py_DECREF(res);
+  });
+}
+
+srt_status srt_jax_resident_table_count(int64_t* out_count) {
+  return translate([&] {
+    expects(out_count != nullptr, SRT_ERR_NULLPTR, "null out");
+    ensure_init();
+    GilGuard gil;
+    PyObject* fn = bridge_attr("resident_table_count");
+    PyObject* res = PyObject_CallNoArgs(fn);
+    Py_DECREF(fn);
+    *out_count = call_int64(res, "resident_table_count");
   });
 }
 

@@ -167,6 +167,44 @@ SRT_EXPORT srt_status srt_jax_table_op(
     int32_t* out_num_columns, srt_handle* out_col_data,
     srt_handle* out_col_valid, int64_t* out_num_rows);
 
+/* ---- device-resident table chaining -----------------------------------
+ * The reference chains ops by passing jlong pointers to DEVICE-resident
+ * cudf tables between calls (RowConversionJni.cpp:31,54 — no host copy
+ * between ops). srt_jax_table_op round-trips every input/output through
+ * host bytes; these functions keep tables resident on the XLA backend
+ * between ops: upload once, chain ops over srt_table ids, download once.
+ * A Spark stage chaining filter -> join -> groupby pays the wire cost
+ * twice total instead of twice per op. */
+
+typedef int64_t srt_table;
+
+/* Host buffers (wire format of srt_jax_table_op) -> resident table. */
+SRT_EXPORT srt_status srt_jax_table_upload(
+    const int32_t* type_ids, const int32_t* scales, int32_t num_columns,
+    const srt_handle* col_data, const srt_handle* col_valid,
+    int64_t num_rows, srt_table* out_table);
+
+/* One op over resident inputs; the result stays resident. Multi-table
+ * ops (op "join": inputs[0] = left/probe, inputs[1] = right/build;
+ * op "concat": all inputs in order). */
+SRT_EXPORT srt_status srt_jax_table_op_resident(
+    const char* op_json, const srt_table* inputs, int32_t num_inputs,
+    srt_table* out_table);
+
+/* Resident table -> freshly created host buffer handles (same output
+ * contract as srt_jax_table_op; caller owns the handles). */
+SRT_EXPORT srt_status srt_jax_table_download(
+    srt_table table, int32_t max_out_columns, int32_t* out_type_ids,
+    int32_t* out_scales, int32_t* out_num_columns,
+    srt_handle* out_col_data, srt_handle* out_col_valid,
+    int64_t* out_num_rows);
+
+SRT_EXPORT srt_status srt_jax_table_num_rows(srt_table table,
+                                             int64_t* out_num_rows);
+SRT_EXPORT srt_status srt_jax_table_free(srt_table table);
+/* Live resident tables (leak tracking for the device-table registry). */
+SRT_EXPORT srt_status srt_jax_resident_table_count(int64_t* out_count);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

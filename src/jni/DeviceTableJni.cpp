@@ -133,6 +133,131 @@ Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
   return result;
 }
 
+/* ---- device-resident table chaining (srt_jax_table_* C ABI) --------- */
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_tableUploadNative(
+    JNIEnv* env, jclass, jintArray type_ids_j, jintArray scales_j,
+    jlongArray col_data_j, jlongArray col_valid_j, jlong num_rows) {
+  if (type_ids_j == nullptr || scales_j == nullptr ||
+      col_data_j == nullptr || col_valid_j == nullptr) {
+    throw_java_dt(env, "null argument to tableUploadNative");
+    return 0;
+  }
+  jsize num_cols = env->GetArrayLength(type_ids_j);
+  if (env->GetArrayLength(scales_j) != num_cols ||
+      env->GetArrayLength(col_data_j) != num_cols ||
+      env->GetArrayLength(col_valid_j) != num_cols) {
+    throw_java_dt(env, "column array length mismatch");
+    return 0;
+  }
+  std::vector<int32_t> type_ids(num_cols), scales(num_cols);
+  std::vector<int64_t> col_data(num_cols), col_valid(num_cols);
+  env->GetIntArrayRegion(type_ids_j, 0, num_cols, type_ids.data());
+  env->GetIntArrayRegion(scales_j, 0, num_cols, scales.data());
+  env->GetLongArrayRegion(col_data_j, 0, num_cols, col_data.data());
+  env->GetLongArrayRegion(col_valid_j, 0, num_cols, col_valid.data());
+  srt_table out = 0;
+  if (srt_jax_table_upload(type_ids.data(), scales.data(), num_cols,
+                           col_data.data(), col_valid.data(), num_rows,
+                           &out) != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+    return 0;
+  }
+  return out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpResidentNative(
+    JNIEnv* env, jclass, jstring op_json_j, jlongArray inputs_j) {
+  if (op_json_j == nullptr || inputs_j == nullptr) {
+    throw_java_dt(env, "null argument to tableOpResidentNative");
+    return 0;
+  }
+  jsize n = env->GetArrayLength(inputs_j);
+  std::vector<int64_t> inputs(static_cast<size_t>(n));
+  env->GetLongArrayRegion(inputs_j, 0, n, inputs.data());
+  const char* op_json = env->GetStringUTFChars(op_json_j, nullptr);
+  if (op_json == nullptr) return 0;
+  srt_table out = 0;
+  srt_status s =
+      srt_jax_table_op_resident(op_json, inputs.data(), n, &out);
+  env->ReleaseStringUTFChars(op_json_j, op_json);
+  if (s != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+    return 0;
+  }
+  return out;
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_tableDownloadNative(
+    JNIEnv* env, jclass, jlong table) {
+  int32_t out_ids[kMaxOutColumns];
+  int32_t out_scales[kMaxOutColumns];
+  srt_handle out_data[kMaxOutColumns];
+  srt_handle out_valid[kMaxOutColumns];
+  int32_t out_cols = 0;
+  int64_t out_rows = 0;
+  if (srt_jax_table_download(table, kMaxOutColumns, out_ids, out_scales,
+                             &out_cols, out_data, out_valid,
+                             &out_rows) != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+    return nullptr;
+  }
+  std::vector<jlong> packed(2 + 4 * static_cast<size_t>(out_cols));
+  packed[0] = out_cols;
+  packed[1] = out_rows;
+  for (int32_t i = 0; i < out_cols; ++i) {
+    packed[2 + i] = out_ids[i];
+    packed[2 + out_cols + i] = out_scales[i];
+    packed[2 + 2 * out_cols + i] = out_data[i];
+    packed[2 + 3 * out_cols + i] = out_valid[i];
+  }
+  jlongArray result = env->NewLongArray(static_cast<jsize>(packed.size()));
+  if (result == nullptr) {
+    for (int32_t i = 0; i < out_cols; ++i) {
+      srt_buffer_release(out_data[i]);
+      if (out_valid[i] != 0) srt_buffer_release(out_valid[i]);
+    }
+    return nullptr;
+  }
+  env->SetLongArrayRegion(result, 0, static_cast<jsize>(packed.size()),
+                          packed.data());
+  return result;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_tableNumRows(JNIEnv* env,
+                                                          jclass,
+                                                          jlong table) {
+  int64_t out = 0;
+  if (srt_jax_table_num_rows(table, &out) != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+    return 0;
+  }
+  return out;
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(JNIEnv* env, jclass,
+                                                       jlong table) {
+  if (srt_jax_table_free(table) != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+  }
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_residentTableCount(JNIEnv* env,
+                                                                jclass) {
+  int64_t out = 0;
+  if (srt_jax_resident_table_count(&out) != SRT_OK) {
+    throw_java_dt(env, srt_last_error());
+    return 0;
+  }
+  return out;
+}
+
 }  // extern "C"
 
 #endif /* SRT_HAVE_JNI */

@@ -41,6 +41,18 @@ jstring Java_com_nvidia_spark_rapids_jni_DeviceTable_devicePlatform(
 jlongArray Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
     JNIEnv*, jclass, jstring, jintArray, jintArray, jlongArray, jlongArray,
     jlong);
+jlong Java_com_nvidia_spark_rapids_jni_DeviceTable_tableUploadNative(
+    JNIEnv*, jclass, jintArray, jintArray, jlongArray, jlongArray, jlong);
+jlong Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpResidentNative(
+    JNIEnv*, jclass, jstring, jlongArray);
+jlongArray Java_com_nvidia_spark_rapids_jni_DeviceTable_tableDownloadNative(
+    JNIEnv*, jclass, jlong);
+jlong Java_com_nvidia_spark_rapids_jni_DeviceTable_tableNumRows(
+    JNIEnv*, jclass, jlong);
+void Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(
+    JNIEnv*, jclass, jlong);
+jlong Java_com_nvidia_spark_rapids_jni_DeviceTable_residentTableCount(
+    JNIEnv*, jclass);
 jlong Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
     JNIEnv*, jclass, jlong, jintArray, jlong, jlong, jlong);
 jlongArray Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(
@@ -217,6 +229,72 @@ int main() {
         "k validity round trip");
   std::printf("jni_harness: RowConversion round trip ok (%d B/row)\n",
               row_size);
+
+  /* -- 3b. device-resident chaining through the JNI entry points ----- */
+  {
+    jlong sales_t = Java_com_nvidia_spark_rapids_jni_DeviceTable_tableUploadNative(
+        env, cls, ids, scales, data, valid, n);
+    CHECK(!srt_mock::exception_pending() && sales_t != 0, "tableUpload");
+    CHECK(Java_com_nvidia_spark_rapids_jni_DeviceTable_tableNumRows(
+              env, cls, sales_t) == n,
+          "tableNumRows");
+    jlong sorted_t =
+        Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpResidentNative(
+            env, cls,
+            srt_mock::make_string(
+                "{\"op\": \"sort_by\", \"keys\": [{\"column\": 0}]}"),
+            srt_mock::make_long_array({sales_t}));
+    CHECK(!srt_mock::exception_pending() && sorted_t != 0,
+          "tableOpResident");
+    jlong agg_t =
+        Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpResidentNative(
+            env, cls,
+            srt_mock::make_string(
+                "{\"op\": \"groupby\", \"by\": [0], "
+                "\"aggs\": [{\"column\": 1, \"agg\": \"sum\"}]}"),
+            srt_mock::make_long_array({sorted_t}));
+    CHECK(!srt_mock::exception_pending() && agg_t != 0,
+          "chained tableOpResident");
+    jlongArray dl =
+        Java_com_nvidia_spark_rapids_jni_DeviceTable_tableDownloadNative(
+            env, cls, agg_t);
+    CHECK(!srt_mock::exception_pending() && dl != nullptr,
+          "tableDownload");
+    std::vector<jlong> dlv = srt_mock::long_array_values(dl);
+    CHECK(dlv.size() >= 2 && dlv[0] == 2 && dlv[1] == out_rows,
+          "resident chain result shape");
+    /* chained groupby over sorted input must equal the wire groupby */
+    const int64_t dcols = dlv[0];
+    const double* ds =
+        static_cast<const double*>(srt_buffer_data(dlv[2 + 2 * dcols + 1]));
+    CHECK(ds != nullptr, "download buffers");
+    double total_direct = 0.0;
+    double total_res = 0.0;
+    for (int64_t i = 0; i < out_rows; ++i) {
+      total_direct += got_s[i];
+      total_res += ds[i];
+    }
+    CHECK(total_direct == total_res, "resident chain sum mismatch");
+    for (int64_t i = 0; i < dcols; ++i) {
+      srt_buffer_release(dlv[2 + 2 * dcols + i]);
+      if (dlv[2 + 3 * dcols + i] != 0)
+        srt_buffer_release(dlv[2 + 3 * dcols + i]);
+    }
+    Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(env, cls,
+                                                           sales_t);
+    Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(env, cls,
+                                                           sorted_t);
+    Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(env, cls, agg_t);
+    CHECK(Java_com_nvidia_spark_rapids_jni_DeviceTable_residentTableCount(
+              env, cls) == 0,
+          "resident table leak");
+    /* freeing twice / unknown id must raise */
+    CHECK_THROWS(
+        Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(env, cls,
+                                                               agg_t),
+        "double free must throw");
+    std::printf("jni_harness: resident-table chaining ok\n");
+  }
 
   /* -- 4. error paths must record pending Java exceptions ------------ */
   CHECK_THROWS(
