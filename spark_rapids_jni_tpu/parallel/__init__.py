@@ -23,6 +23,7 @@ from .distributed import (
     JoinOverflowError,
     distributed_groupby,
     distributed_inner_join,
+    distributed_sort,
 )
 
 __all__ = [
@@ -42,4 +43,5 @@ __all__ = [
     "JoinOverflowError",
     "distributed_groupby",
     "distributed_inner_join",
+    "distributed_sort",
 ]

@@ -25,6 +25,7 @@ from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..column import Table
@@ -206,3 +207,116 @@ def distributed_inner_join(
                 f"auto-size"
             )
     return out, count, lov, rov
+
+
+def distributed_sort(
+    table: Table,
+    sort_keys,
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+    sample_size: int = 8192,
+    axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
+):
+    """Distributed ORDER BY: sample -> range partition -> local sort.
+
+    The global sort the GPU stack gets from Spark's range-partitioned
+    TotalOrderSort over the shuffle manager: P-1 splitters come from a
+    host-side sample of the sort-key order words, every row is
+    range-partitioned to the device owning its key range (ragged-compact
+    exchange, so buffers track real range sizes), and each device sorts
+    its range locally. Reading devices in mesh order (valid prefixes,
+    per the occupancy column) yields the total order.
+
+    Returns (sharded sorted padded table, occupancy, overflow).
+    """
+    from ..ops import keys as keys_mod
+    from ..ops.sort import SortKey, _key_words
+
+    validate_on_overflow(on_overflow)
+    impl = _ragged_impl(None)
+    num = int(mesh.shape[axis])
+    sort_keys = [
+        k if isinstance(k, SortKey) else SortKey(k) for k in sort_keys
+    ]
+    if num == 1:
+        # one device: the range partition is trivial — local sort
+        from ..ops.sort import sort_table
+
+        out = shard_table(sort_table(table, sort_keys), mesh, axis)
+        occ = jnp.ones((table.row_count,), jnp.bool_)
+        return out, occ, jnp.zeros((1,), jnp.int64)
+    sharded = shard_table(table, mesh, axis)
+
+    # splitters from a deterministic host-side sample of the key words
+    words = []
+    for k in sort_keys:
+        words.extend(_key_words(table.column(k.column), k))
+    n = table.row_count
+    stride = max(n // max(sample_size, 1), 1)
+    samp = [np.asarray(w[::stride]) for w in words]
+    order = np.lexsort(samp[::-1])
+    m = order.shape[0]
+    cut = [order[(i * m) // num] for i in range(1, num)]
+    splitters = [
+        jnp.asarray(np.stack([s[cut_i] for cut_i in cut]))
+        for s in samp
+    ]  # per word: (num-1,) splitter values
+
+    def dest_of(local: Table):
+        lwords = []
+        for k in sort_keys:
+            lwords.extend(_key_words(local.column(k.column), k))
+        # partition id = number of splitters <= key (lexicographic)
+        nloc = local.row_count
+        dest = jnp.zeros((nloc,), jnp.int32)
+        for i in range(num - 1):
+            le = jnp.zeros((nloc,), jnp.bool_)
+            eq = jnp.ones((nloc,), jnp.bool_)
+            for w, sp in zip(lwords, splitters):
+                sv = sp[i]
+                le = le | (eq & (sv < w))
+                eq = eq & (sv == w)
+            dest = dest + (le | eq).astype(jnp.int32)
+        return dest
+
+    # planning pass: per-(src,dst) counts under the range partitioning
+    def count_body(local: Table):
+        dest = dest_of(local)
+        return jnp.bincount(dest, length=num).astype(jnp.int32)[None, :]
+
+    counts = shard_map(
+        count_body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )(sharded)
+    cap = capacity or total_recv_capacity(counts)
+    pair_cap = _round_capacity(int(jnp.max(counts)))
+
+    def body(local: Table, C):
+        from .shuffle import exchange_ragged
+
+        dest = dest_of(local)
+        shuffled, occ, overflow = exchange_ragged(
+            local, dest, C, cap, axis, impl, pair_capacity=pair_cap
+        )
+        # local sort with padding rows (occ False) sorted last
+        swords = [jnp.where(occ, jnp.uint64(0), jnp.uint64(1))]
+        for k in sort_keys:
+            swords.extend(_key_words(shuffled.column(k.column), k))
+        iota = jnp.arange(shuffled.row_count, dtype=jnp.int32)
+        perm = jax.lax.sort(
+            tuple(swords) + (iota,), num_keys=len(swords)
+        )[-1]
+        out = jax.tree_util.tree_map(
+            lambda x: None if x is None else x[perm], shuffled
+        )
+        return out, occ[perm], overflow[None]
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
+        check_vma=False,
+    )
+    out, occ, overflow = fn(sharded, counts)
+    if on_overflow == "raise":
+        check_overflow_compact(overflow, cap, "distributed sort")
+    return out, occ, overflow
