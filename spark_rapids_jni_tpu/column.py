@@ -60,6 +60,15 @@ def encode_storage(arr: np.ndarray, dtype: dt.DType) -> jax.Array:
     return dev
 
 
+# LIST child types whose storage dtype maps back to the declared type
+# unambiguously (see Column.list_child_dtype)
+_LIST_CHILD_IDS = frozenset({
+    dt.TypeId.INT8, dt.TypeId.INT16, dt.TypeId.INT32, dt.TypeId.INT64,
+    dt.TypeId.UINT8, dt.TypeId.UINT16, dt.TypeId.UINT32, dt.TypeId.UINT64,
+    dt.TypeId.FLOAT32, dt.TypeId.BOOL8,
+})
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(eq=False)
 class Column:
@@ -145,6 +154,73 @@ class Column:
             if valid.shape != dev.shape[:1]:
                 raise ValueError("validity shape mismatch")
         return Column(data=dev, dtype=dtype, validity=valid)
+
+    @staticmethod
+    def from_list_of_lists(
+        values: Sequence, child_dtype: Optional[dt.DType] = None,
+        pad_width: Optional[int] = None,
+    ) -> "Column":
+        """Build a LIST column (fixed-width child) from Python lists.
+
+        Device layout mirrors STRING (SURVEY.md §7 hard part 2 — padding
+        instead of offsets under XLA static shapes): ``data`` is an
+        (n, pad) matrix of child storage values, ``lengths`` the per-row
+        element counts; the child dtype is carried by the data buffer's
+        dtype. This is the LIST<INT8> shape of the reference's packed-row
+        output (row_conversion.cu:389-406).
+        """
+        child = child_dtype or dt.UINT8
+        if child.id not in _LIST_CHILD_IDS:
+            # the child type is reconstructed from the buffer dtype
+            # (list_child_dtype), so only types whose storage dtype maps
+            # back unambiguously are supported — FLOAT64 (bit-view
+            # storage), temporals and decimals would silently change
+            # type on a round trip
+            raise TypeError(
+                f"LIST child {child} not supported (MVP children: "
+                "int8..64, uint8..64, float32, bool)"
+            )
+        n = len(values)
+        max_len = max(
+            (len(v) for v in values if v is not None), default=0
+        )
+        if pad_width is not None and max_len > pad_width:
+            raise ValueError(
+                f"list length {max_len} exceeds pad width {pad_width}"
+            )
+        pad = pad_width if pad_width is not None else max(max_len, 1)
+        npdt = np.dtype(child.storage_dtype)
+        mat = np.zeros((n, pad), dtype=npdt)
+        lens = np.zeros((n,), dtype=np.int32)
+        valid = np.ones((n,), dtype=np.bool_)
+        for i, v in enumerate(values):
+            if v is None:
+                valid[i] = False
+                continue
+            arr = np.asarray(list(v), dtype=npdt)
+            mat[i, : len(arr)] = arr
+            lens[i] = len(arr)
+        dev = jnp.asarray(mat)
+        if dev.dtype != npdt:
+            raise TypeError(
+                f"device buffer dtype {dev.dtype} != {npdt}; 64-bit "
+                "children require jax_enable_x64"
+            )
+        return Column(
+            data=dev,
+            dtype=dt.DType(dt.TypeId.LIST),
+            validity=None if valid.all() else jnp.asarray(valid),
+            lengths=jnp.asarray(lens),
+        )
+
+    @property
+    def list_child_dtype(self) -> dt.DType:
+        """Child element dtype of a LIST column, reconstructed from the
+        data buffer's dtype — faithful exactly for the child set
+        from_list_of_lists accepts (which is why it restricts one)."""
+        if self.dtype.id != dt.TypeId.LIST:
+            raise TypeError("not a LIST column")
+        return dt.from_numpy_dtype(np.dtype(self.data.dtype))
 
     @staticmethod
     def from_decimal128(
@@ -243,6 +319,13 @@ class Column:
             ints = to_py_ints(np.asarray(self.data))
             return [
                 ints[i] if valid[i] else None
+                for i in range(self.row_count)
+            ]
+        if self.dtype.id == dt.TypeId.LIST:
+            mat = np.asarray(self.data)
+            lens = np.asarray(self.lengths)
+            return [
+                mat[i, : lens[i]].tolist() if valid[i] else None
                 for i in range(self.row_count)
             ]
         arr = self.to_numpy()

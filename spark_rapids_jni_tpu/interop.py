@@ -105,6 +105,8 @@ def _arrow_type_to_dtype(t) -> dt.DType:
         raise TypeError("decimal precision > 18 (DECIMAL128) not yet supported")
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
         return dt.STRING
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return dt.DType(dt.TypeId.LIST)
     raise TypeError(f"unsupported arrow type {t}")
 
 
@@ -119,6 +121,17 @@ def column_from_arrow(arr, pad_width: Optional[int] = None) -> Column:
         # from_strings accepts str/bytes/None directly (binary arrays arrive
         # as bytes and stay lossless via surrogateescape).
         return Column.from_strings(arr.to_pylist(), pad_width=pad_width)
+
+    if dtype.id == dt.TypeId.LIST:
+        # offsets+child -> padded matrix (fixed-width child only; the
+        # reference's own nested output is LIST<INT8>,
+        # row_conversion.cu:389-406)
+        child = _arrow_type_to_dtype(arr.type.value_type)
+        # from_list_of_lists enforces the supported-child set (and
+        # raises clearly for float64/temporal/decimal children)
+        return Column.from_list_of_lists(
+            arr.to_pylist(), child, pad_width=pad_width
+        )
 
     n = len(arr)
     valid_np = None
@@ -182,6 +195,20 @@ def column_to_arrow(col: Column):
                 ],
                 type=pa.binary(),
             )
+    if col.dtype.id == dt.TypeId.LIST:
+        child = col.list_child_dtype
+        pa_child = {
+            dt.TypeId.INT8: pa.int8(), dt.TypeId.UINT8: pa.uint8(),
+            dt.TypeId.INT16: pa.int16(), dt.TypeId.UINT16: pa.uint16(),
+            dt.TypeId.INT32: pa.int32(), dt.TypeId.UINT32: pa.uint32(),
+            dt.TypeId.INT64: pa.int64(), dt.TypeId.UINT64: pa.uint64(),
+            dt.TypeId.FLOAT32: pa.float32(),
+            dt.TypeId.BOOL8: pa.bool_(),
+        }.get(child.id)
+        if pa_child is None:
+            raise TypeError(f"LIST child {child} not exportable")
+        return pa.array(col.to_pylist(), type=pa.list_(pa_child))
+
     arr = col.to_numpy()
     if col.dtype.is_decimal:
         scale = -col.dtype.scale

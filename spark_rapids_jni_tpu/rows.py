@@ -426,3 +426,53 @@ def packed_rows_from_numpy(
             f"row width {data.shape[1]} != layout row_size {layout.row_size}"
         )
     return PackedRows(jnp.asarray(data), layout)
+
+
+def to_rows_list(
+    table: Table, split: bool = True, backend: str = "xla"
+) -> Column:
+    """Packed rows as a true LIST<UINT8> column — the reference's output
+    type (offsets sequence + INT8 child assembled via make_lists_column,
+    row_conversion.cu:389-406). Fixed row width means every list has
+    length ``row_size``; the padded-matrix LIST layout holds the batch
+    concatenation directly."""
+    batches = to_rows(table, split=split, backend=backend)
+    data = (
+        jnp.concatenate([b.data for b in batches])
+        if len(batches) > 1
+        else batches[0].data
+    )
+    n = data.shape[0]
+    lengths = jnp.full((n,), data.shape[1], jnp.int32)
+    return Column(data, dt.DType(dt.TypeId.LIST), None, lengths)
+
+
+def from_rows_list(
+    col: Column,
+    dtypes: Sequence[dt.DType],
+    names: Optional[Sequence[str]] = None,
+    backend: str = "xla",
+) -> Table:
+    """Inverse of :func:`to_rows_list`: LIST<UINT8/INT8> column of packed
+    rows -> columnar table (convert_from_rows takes a lists_column_view,
+    RowConversionJni.cpp:54-55)."""
+    if col.dtype.id != dt.TypeId.LIST:
+        raise TypeError("from_rows_list expects a LIST column")
+    layout = compute_fixed_width_layout(dtypes)
+    if col.data.ndim != 2 or col.data.shape[1] != layout.row_size:
+        raise ValueError(
+            f"packed list width {col.data.shape[1:]} != row size "
+            f"{layout.row_size}"
+        )
+    # every list must be exactly one full row and non-null: a ragged or
+    # nullable input whose PAD happens to equal row_size would otherwise
+    # silently decode zero padding as row bytes (the reference gates the
+    # same way: child must be a dense INT8 list, row_conversion.cu:524-528)
+    if col.validity is not None and not bool(jnp.all(col.validity)):
+        raise ValueError("packed-rows list column must have no nulls")
+    if not bool(jnp.all(col.lengths == layout.row_size)):
+        raise ValueError(
+            f"every packed row must be exactly {layout.row_size} bytes"
+        )
+    pr = PackedRows(col.data.astype(jnp.uint8), layout)
+    return from_rows(pr, dtypes, names, backend=backend)

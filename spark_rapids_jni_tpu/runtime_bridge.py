@@ -53,6 +53,41 @@ def _column_from_wire(
     type_id: int, scale: int, data: Optional[bytes],
     valid: Optional[bytes], num_rows: int,
 ) -> Column:
+    if dt.TypeId(type_id) == dt.TypeId.LIST:
+        # LIST wire convention: the scale slot carries the CHILD type id
+        # (scale is meaningless for LIST), and the data buffer is
+        # Arrow-shaped: int32 offsets[num_rows+1] then the concatenated
+        # child values. Decoded into the padded-matrix device layout.
+        child = dt.DType(dt.TypeId(scale))
+        offs = np.frombuffer(data, np.int32, num_rows + 1)
+        lens = np.diff(offs).astype(np.int32)
+        w = np.dtype(child.storage_dtype).itemsize
+        need = 4 * (num_rows + 1) + w * int(offs[-1])
+        if len(data) < need:
+            raise ValueError(
+                f"LIST wire buffer holds {len(data)} bytes, offsets "
+                f"require {need}"
+            )
+        flat = np.frombuffer(
+            data, np.dtype(child.storage_dtype),
+            count=int(offs[-1]),
+            offset=4 * (num_rows + 1),
+        )
+        pad = max(int(lens.max()) if num_rows else 1, 1)
+        mat = np.zeros((num_rows, pad), np.dtype(child.storage_dtype))
+        mask = np.arange(pad)[None, :] < lens[:, None]
+        mat[mask] = flat
+        v = (
+            None
+            if valid is None
+            else np.frombuffer(valid, np.uint8, num_rows).astype(np.bool_)
+        )
+        import jax.numpy as jnp
+
+        return Column(
+            jnp.asarray(mat), dt.DType(dt.TypeId.LIST),
+            None if v is None else jnp.asarray(v), jnp.asarray(lens),
+        )
     d = dt.DType(dt.TypeId(type_id), scale)
     if d.id == dt.TypeId.DECIMAL128:
         # 16 little-endian bytes/value on the wire -> (n, 2) u64 limbs
@@ -72,7 +107,30 @@ def _column_from_wire(
 
 
 def _column_to_wire(c: Column):
-    """(type_id, scale, data bytes, valid bytes | None)."""
+    """(type_id, scale, data bytes, valid bytes | None).
+
+    LIST columns use the convention documented in _column_from_wire:
+    scale = child type id, data = int32 offsets then child values.
+    """
+    if c.dtype.id == dt.TypeId.LIST:
+        child = c.list_child_dtype
+        mat = np.asarray(c.data)
+        lens = np.asarray(c.lengths).astype(np.int32)
+        offs = np.zeros((lens.shape[0] + 1,), np.int32)
+        np.cumsum(lens, out=offs[1:])
+        mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
+        flat = np.ascontiguousarray(mat[mask])
+        valid = (
+            None
+            if c.validity is None
+            else np.asarray(c.validity).astype(np.uint8).tobytes()
+        )
+        return (
+            int(dt.TypeId.LIST),
+            int(child.id),
+            offs.tobytes() + flat.tobytes(),
+            valid,
+        )
     host = np.ascontiguousarray(np.asarray(c.data))
     valid = (
         None
@@ -136,21 +194,21 @@ def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
         ]
         return ops.filter_table(Table(keep), mask)
     if name == "to_rows":
-        # device row transpose; result = one UINT8 column of the packed
-        # bytes (the LIST<INT8> child of row_conversion.cu:392-394)
-        batches = rows_mod.to_rows(table)
-        flat = np.concatenate(
-            [np.asarray(b.data).reshape(-1) for b in batches]
-        )
-        return Table([Column.from_numpy(flat, dtype=dt.UINT8)])
+        # device row transpose; result = a true LIST<UINT8> column (the
+        # reference's output type, row_conversion.cu:389-406)
+        return Table([rows_mod.to_rows_list(table)])
     if name == "from_rows":
         schema = [
             dt.DType(dt.TypeId(t), s)
             for t, s in zip(op["type_ids"], op["scales"])
         ]
+        src = table.columns[0]
+        if src.dtype.id == dt.TypeId.LIST:
+            return rows_mod.from_rows_list(src, schema)
+        # legacy flat-UINT8 input: one column of num_rows*row_size bytes
         layout = rows_mod.compute_fixed_width_layout(schema)
         n = int(op["num_rows"])
-        raw = np.asarray(table.columns[0].data).reshape(n, layout.row_size)
+        raw = np.asarray(src.data).reshape(n, layout.row_size)
         pr = rows_mod.PackedRows(jnp.asarray(raw), layout)
         return rows_mod.from_rows(pr, schema)
     raise ValueError(f"unknown table op {name!r}")

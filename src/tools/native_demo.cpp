@@ -135,6 +135,13 @@ int main() {
                          &r_rows) == SRT_OK,
         "to_rows dispatch");
   CHECK(r_cols == 1, "to_rows output arity");
+  /* packed rows arrive as a true LIST<UINT8> wire column: type id 24
+   * (LIST), the scale slot carrying the child type id, and the data
+   * buffer holding int32 offsets[n+1] then the child bytes — the
+   * reference's own output type (row_conversion.cu:389-406). */
+  CHECK(r_ids[0] == 24, "to_rows type is LIST");
+  CHECK(r_scales[0] == 5 /* UINT8 */, "LIST child type id");
+  CHECK(r_rows == n, "to_rows row count");
 
   srt_row_layout layout;
   int32_t offs[2];
@@ -149,14 +156,22 @@ int main() {
   CHECK(srt_pack_rows(type_ids, 2, cols, valids, n, host_rows.data()) ==
             SRT_OK,
         "host pack");
+  const size_t header = sizeof(int32_t) * static_cast<size_t>(n + 1);
   CHECK(srt_buffer_size(r_data[0]) ==
-            static_cast<int64_t>(host_rows.size()),
+            static_cast<int64_t>(header + host_rows.size()),
         "packed size mismatch");
-  CHECK(std::memcmp(srt_buffer_data(r_data[0]), host_rows.data(),
+  const auto* list_bytes =
+      static_cast<const uint8_t*>(srt_buffer_data(r_data[0]));
+  const auto* list_offs = reinterpret_cast<const int32_t*>(list_bytes);
+  for (int64_t i = 0; i <= n; ++i) {
+    CHECK(list_offs[i] == i * layout.row_size,
+          "LIST offsets not the row_size sequence");
+  }
+  CHECK(std::memcmp(list_bytes + header, host_rows.data(),
                     host_rows.size()) == 0,
         "device rows != host codec rows");
-  std::printf("native_demo: device to_rows matches host codec (%zu "
-              "bytes)\n",
+  std::printf("native_demo: device to_rows (LIST<UINT8>) matches host "
+              "codec (%zu bytes)\n",
               host_rows.size());
 
   /* cleanup: every handle back to the registry */

@@ -74,7 +74,9 @@ class TestCtypesDeviceDispatch:
 
     def test_row_roundtrip_through_device(self):
         """to_rows on device -> from_rows on device -> original columns,
-        all initiated through the C ABI."""
+        all initiated through the C ABI. The packed rows travel as a
+        true LIST<UINT8> wire column (offsets + child, the reference's
+        output type) rather than the old flat-UINT8 workaround."""
         n = 96
         a = np.arange(n, dtype=np.int64) * 3 - 7
         b = (np.arange(n) % 2).astype(np.int32)
@@ -83,7 +85,7 @@ class TestCtypesDeviceDispatch:
         ha, hb, hbv = _wire(a), _wire(b), _wire(bv)
         handles = [ha, hb, hbv]
         try:
-            _, _, rd, rv, nbytes = native.jax_table_op(
+            out_ids0, out_s0, rd, rv, rrows = native.jax_table_op(
                 json.dumps({"op": "to_rows"}),
                 ids,
                 [0, 0],
@@ -92,6 +94,17 @@ class TestCtypesDeviceDispatch:
                 n,
             )
             handles += [rd[0], *[x for x in rv if x]]
+            assert out_ids0[0] == dt.TypeId.LIST.value
+            assert out_s0[0] == dt.TypeId.UINT8.value  # child type id
+            assert rrows == n
+            # wire layout: int32 offsets[n+1] then the child bytes; the
+            # offsets must be the arithmetic row_size sequence
+            raw = native.buffer_bytes(rd[0])
+            offs = np.frombuffer(raw, np.int32, n + 1)
+            row_size = offs[1] - offs[0]
+            np.testing.assert_array_equal(
+                offs, np.arange(n + 1, dtype=np.int32) * row_size
+            )
             back_op = json.dumps(
                 {
                     "op": "from_rows",
@@ -102,11 +115,11 @@ class TestCtypesDeviceDispatch:
             )
             out_ids, _, od, ov, on = native.jax_table_op(
                 back_op,
+                [dt.TypeId.LIST.value],
                 [dt.TypeId.UINT8.value],
-                [0],
                 [rd[0]],
                 [None],
-                nbytes,
+                n,
             )
             handles += [*od, *[x for x in ov if x]]
             assert on == n and out_ids == ids
