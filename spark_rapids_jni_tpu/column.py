@@ -197,7 +197,17 @@ class Column:
             if v is None:
                 valid[i] = False
                 continue
-            arr = np.asarray(list(v), dtype=npdt)
+            items = list(v)
+            if any(x is None for x in items):
+                # Arrow allows element-level nulls; the padded-matrix
+                # layout has no child validity yet — refuse rather than
+                # coerce (NaN for floats, TypeError deep in numpy for
+                # ints)
+                raise TypeError(
+                    "null elements inside lists are not supported "
+                    f"(row {i})"
+                )
+            arr = np.asarray(items, dtype=npdt)
             mat[i, : len(arr)] = arr
             lens[i] = len(arr)
         dev = jnp.asarray(mat)

@@ -196,17 +196,11 @@ def column_to_arrow(col: Column):
                 type=pa.binary(),
             )
     if col.dtype.id == dt.TypeId.LIST:
+        # every supported child's storage dtype is a plain numpy dtype
+        # (the from_list_of_lists restriction), so arrow derives the
+        # child type from it directly — no second hand-maintained map
         child = col.list_child_dtype
-        pa_child = {
-            dt.TypeId.INT8: pa.int8(), dt.TypeId.UINT8: pa.uint8(),
-            dt.TypeId.INT16: pa.int16(), dt.TypeId.UINT16: pa.uint16(),
-            dt.TypeId.INT32: pa.int32(), dt.TypeId.UINT32: pa.uint32(),
-            dt.TypeId.INT64: pa.int64(), dt.TypeId.UINT64: pa.uint64(),
-            dt.TypeId.FLOAT32: pa.float32(),
-            dt.TypeId.BOOL8: pa.bool_(),
-        }.get(child.id)
-        if pa_child is None:
-            raise TypeError(f"LIST child {child} not exportable")
+        pa_child = pa.from_numpy_dtype(np.dtype(child.storage_dtype))
         return pa.array(col.to_pylist(), type=pa.list_(pa_child))
 
     arr = col.to_numpy()

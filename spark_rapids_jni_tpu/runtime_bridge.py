@@ -84,8 +84,16 @@ def _column_from_wire(
         )
         import jax.numpy as jnp
 
+        dev = jnp.asarray(mat)
+        if dev.dtype != mat.dtype:
+            # x64 disabled: a silent int64->int32 downgrade would corrupt
+            # values AND misreport the child type id on download
+            raise TypeError(
+                f"device buffer dtype {dev.dtype} != {mat.dtype}; 64-bit "
+                "LIST children require jax_enable_x64"
+            )
         return Column(
-            jnp.asarray(mat), dt.DType(dt.TypeId.LIST),
+            dev, dt.DType(dt.TypeId.LIST),
             None if v is None else jnp.asarray(v), jnp.asarray(lens),
         )
     d = dt.DType(dt.TypeId(type_id), scale)

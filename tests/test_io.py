@@ -304,3 +304,75 @@ def test_from_dnf_shapes():
     assert p1.columns() == {"a", "b"}
     p2 = from_dnf([[("a", "==", 1)], [("b", ">", 2)]])
     assert p2.columns() == {"a", "b"}
+
+
+class TestPrefetchOverlap:
+    """Round-3 VERDICT item 10: decode row group k+1 on a host thread
+    while k computes — the nvcomp/GDS async-feed role."""
+
+    def _make_file(self, tmp_path, rng, n_groups=6, rows_per_group=1_500_000):
+        pa = pytest.importorskip("pyarrow")
+        pq_mod = pytest.importorskip("pyarrow.parquet")
+        path = str(tmp_path / "overlap.parquet")
+        n = n_groups * rows_per_group
+        tbl = pa.table({
+            "k": rng.integers(0, 1000, n),
+            "v": rng.standard_normal(n),
+            "w": rng.standard_normal(n),
+            "x": rng.integers(0, 10**9, n),
+        })
+        pq_mod.write_table(tbl, path, row_group_size=rows_per_group)
+        return path
+
+    def test_prefetch_matches_serial(self, tmp_path, rng):
+        from spark_rapids_jni_tpu.io.parquet import scan_parquet
+
+        path = self._make_file(tmp_path, rng, n_groups=3,
+                               rows_per_group=10_000)
+        serial = [
+            np.asarray(t["k"].data) for t in scan_parquet(path)
+        ]
+        pre = [
+            np.asarray(t["k"].data)
+            for t in scan_parquet(path, prefetch=2)
+        ]
+        assert len(serial) == len(pre)
+        for a, b in zip(serial, pre):
+            np.testing.assert_array_equal(a, b)
+
+    def test_prefetch_overlaps_compute(self, tmp_path, rng):
+        """With sleep-dominated compute, total time with prefetch must
+        approach sum(compute) + one decode instead of the serial
+        sum(compute) + sum(decode)."""
+        import time
+
+        from spark_rapids_jni_tpu.io.parquet import scan_parquet
+
+        path = self._make_file(tmp_path, rng)
+        compute_s = 0.25
+
+        def run(prefetch):
+            t0 = time.perf_counter()
+            n = 0
+            for t in scan_parquet(path, prefetch=prefetch):
+                time.sleep(compute_s)  # stands in for device compute
+                n += 1
+            return time.perf_counter() - t0, n
+
+        serial_s, n_serial = run(0)
+        prefetch_s, n_pre = run(2)
+        assert n_serial == n_pre
+        decode_total = serial_s - n_serial * compute_s
+        if decode_total < 0.3:
+            pytest.skip("decode too fast on this host to measure overlap")
+        # generous bound: at least half the decode time must be hidden
+        assert prefetch_s < serial_s - 0.5 * decode_total + 0.1, (
+            serial_s, prefetch_s, decode_total
+        )
+
+    def test_prefetch_propagates_errors(self, tmp_path):
+        from spark_rapids_jni_tpu.io.parquet import scan_parquet
+
+        with pytest.raises(Exception):
+            list(scan_parquet(str(tmp_path / "missing.parquet"),
+                              prefetch=2))
