@@ -95,14 +95,18 @@ def _arrow_type_to_dtype(t) -> dt.DType:
             "ns": dt.DURATION_NANOSECONDS,
         }[t.unit]
     if pa.types.is_decimal(t):
-        # cudf maps precision<=9 -> DECIMAL32, <=18 -> DECIMAL64. Arrow scale
-        # is positive-right-of-point; cudf wire scale is its negation
-        # (RowConversionTest.java:37-38 uses negative scales).
+        # cudf maps precision<=9 -> DECIMAL32, <=18 -> DECIMAL64, else
+        # DECIMAL128. Arrow scale is positive-right-of-point; cudf wire
+        # scale is its negation (RowConversionTest.java:37-38 uses
+        # negative scales). decimal256 (32-byte values) must be rejected
+        # here: every buffer reader below assumes the 16-byte stride.
+        if not pa.types.is_decimal128(t):
+            raise TypeError(f"unsupported arrow decimal width: {t}")
         if t.precision <= 9:
             return dt.decimal32(-t.scale)
         if t.precision <= 18:
             return dt.decimal64(-t.scale)
-        raise TypeError("decimal precision > 18 (DECIMAL128) not yet supported")
+        return dt.decimal128(-t.scale)
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
         return dt.STRING
     if pa.types.is_list(t) or pa.types.is_large_list(t):
@@ -138,6 +142,17 @@ def column_from_arrow(arr, pad_width: Optional[int] = None) -> Column:
     if arr.null_count:
         valid_np = np.asarray(arr.is_valid())
 
+    if dtype.id == dt.TypeId.DECIMAL128:
+        # Arrow decimal128's buffer IS the device limb layout: 16-byte
+        # little-endian two's-complement values = (n, 2) u64 [lo, hi]
+        buf = arr.buffers()[1]
+        words = np.frombuffer(buf, dtype=np.uint64)
+        limbs = words[arr.offset * 2 : (arr.offset + n) * 2].reshape(n, 2)
+        return Column.from_numpy(
+            np.ascontiguousarray(limbs),
+            validity=valid_np,
+            dtype=dtype,
+        )
     if dtype.is_decimal:
         # Arrow decimal128 stores 16-byte little-endian two's-complement
         # unscaled ints. The precision<=18 gate guarantees values fit in the
@@ -203,23 +218,31 @@ def column_to_arrow(col: Column):
         pa_child = pa.from_numpy_dtype(np.dtype(child.storage_dtype))
         return pa.array(col.to_pylist(), type=pa.list_(pa_child))
 
-    arr = col.to_numpy()
     if col.dtype.is_decimal:
-        scale = -col.dtype.scale
-        typ = pa.decimal128(18 if col.dtype.itemsize == 8 else 9, scale)
-        py = [
-            None if not valid[i] else int(arr[i])
-            for i in range(col.row_count)
-        ]
+        # one export path for all three widths: python ints (None for
+        # null) -> Decimal at the cudf precision for the width. The
+        # localcontext matters for 128-bit values (default precision is
+        # 28 significant digits; scaleb would silently round).
         import decimal as _dec
 
-        return pa.array(
-            [
+        scale = -col.dtype.scale
+        precision = {4: 9, 8: 18, 16: 38}[col.dtype.itemsize]
+        vals = col.to_pylist()
+        limit = 10 ** precision
+        for v in vals:
+            if v is not None and abs(v) >= limit:
+                raise ValueError(
+                    f"unscaled value {v} exceeds Arrow "
+                    f"decimal128({precision}) precision"
+                )
+        with _dec.localcontext(prec=50):
+            py = [
                 None if v is None else _dec.Decimal(v).scaleb(-scale)
-                for v in py
-            ],
-            type=typ,
-        )
+                for v in vals
+            ]
+        return pa.array(py, type=pa.decimal128(precision, scale))
+
+    arr = col.to_numpy()
     if col.dtype.id == dt.TypeId.DURATION_DAYS:
         # Arrow has no duration[D] unit; export as duration[s].
         arr = arr.astype("timedelta64[s]")

@@ -1,0 +1,133 @@
+"""Per-executor-thread dispatch (the PER_THREAD_DEFAULT_STREAM analog,
+SURVEY.md §2.3 last row): Spark runs one task per executor thread, each
+dispatching native calls concurrently. The reference gets isolation from
+per-thread CUDA streams; here concurrent dispatch goes through the C
+ABI / embedded runtime (GIL-interleaved host glue, async XLA execution)
+and must be correct and leak-free under thread contention."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available() or not native.jax_runtime_available(),
+    reason="native library with embedded JAX runtime not built",
+)
+
+N_THREADS = 6
+OPS_PER_THREAD = 4
+
+
+def _worker_wire(tid, results, errors):
+    try:
+        rng = np.random.default_rng(tid)
+        for it in range(OPS_PER_THREAD):
+            n = 200 + 10 * tid
+            k = rng.integers(0, 8, n).astype(np.int64)
+            v = rng.integers(-50, 50, n).astype(np.int64)
+            hk = native.buffer_create(k.tobytes(), f"t{tid}-k")
+            hv = native.buffer_create(v.tobytes(), f"t{tid}-v")
+            try:
+                op = json.dumps({
+                    "op": "groupby", "by": [0],
+                    "aggs": [{"column": 1, "agg": "sum"}],
+                })
+                i64 = dt.TypeId.INT64.value
+                _, _, od, ov, rows = native.jax_table_op(
+                    op, [i64, i64], [0, 0], [hk, hv], [None, None], n
+                )
+                keys = np.frombuffer(
+                    native.buffer_bytes(od[0]), np.int64, rows
+                )
+                sums = np.frombuffer(
+                    native.buffer_bytes(od[1]), np.int64, rows
+                )
+                want = {int(u): int(v[k == u].sum()) for u in np.unique(k)}
+                got = dict(zip(keys.tolist(), sums.tolist()))
+                if got != want:
+                    errors.append((tid, it, "oracle mismatch"))
+                for h in [*od, *[x for x in ov if x]]:
+                    native.buffer_release(h)
+            finally:
+                native.buffer_release(hk)
+                native.buffer_release(hv)
+        results.append(tid)
+    except Exception as e:  # pragma: no cover
+        errors.append((tid, repr(e)))
+
+
+def _worker_resident(tid, results, errors):
+    try:
+        rng = np.random.default_rng(100 + tid)
+        for it in range(OPS_PER_THREAD):
+            n = 160
+            x = rng.permutation(n).astype(np.int64)
+            hx = native.buffer_create(x.tobytes(), f"t{tid}-x")
+            try:
+                t = native.jax_table_upload(
+                    [dt.TypeId.INT64.value], [0], [hx], [None], n
+                )
+                s = native.jax_table_op_resident(
+                    json.dumps(
+                        {"op": "sort_by", "keys": [{"column": 0}]}
+                    ),
+                    [t],
+                )
+                _, _, od, ov, rows = native.jax_table_download(s)
+                got = np.frombuffer(
+                    native.buffer_bytes(od[0]), np.int64, rows
+                )
+                if got.tolist() != sorted(x.tolist()):
+                    errors.append((tid, it, "sort mismatch"))
+                for h in [*od, *[v for v in ov if v]]:
+                    native.buffer_release(h)
+                native.jax_table_free(t)
+                native.jax_table_free(s)
+            finally:
+                native.buffer_release(hx)
+        results.append(tid)
+    except Exception as e:  # pragma: no cover
+        errors.append((tid, repr(e)))
+
+
+class TestConcurrentDispatch:
+    def test_wire_ops_from_many_threads(self):
+        native.jax_init()
+        before = native.live_handle_count()
+        results, errors = [], []
+        threads = [
+            threading.Thread(target=_worker_wire, args=(i, results, errors))
+            for i in range(N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert errors == []
+        assert sorted(results) == list(range(N_THREADS))
+        assert native.live_handle_count() == before
+
+    def test_resident_tables_from_many_threads(self):
+        native.jax_init()
+        before = native.live_handle_count()
+        resident_before = native.jax_resident_table_count()
+        results, errors = [], []
+        threads = [
+            threading.Thread(
+                target=_worker_resident, args=(i, results, errors)
+            )
+            for i in range(N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert errors == []
+        assert sorted(results) == list(range(N_THREADS))
+        assert native.live_handle_count() == before
+        assert native.jax_resident_table_count() == resident_before

@@ -268,3 +268,25 @@ class TestWire:
             np.frombuffer(out_d[0], np.uint64).reshape(n, 2)
         )
         assert got == sorted(vals)
+
+
+class TestArrowInterop:
+    def test_arrow_decimal128_round_trip(self, rng):
+        pa = pytest.importorskip("pyarrow")
+        import decimal as _dec
+
+        from spark_rapids_jni_tpu import interop
+
+        vals = _rand_ints(rng, 40) + [None]
+        scale = 10
+        with _dec.localcontext(prec=50):
+            py = [
+                None if v is None else _dec.Decimal(v).scaleb(-scale)
+                for v in vals
+            ]
+        arr = pa.array(py, type=pa.decimal128(38, scale))
+        col = interop.column_from_arrow(arr)
+        assert col.dtype == dt.decimal128(-scale)
+        assert col.to_pylist() == vals
+        back = interop.column_to_arrow(col)
+        assert back.to_pylist() == arr.to_pylist()
