@@ -34,9 +34,30 @@ def scan_orc(
     filters=None,
     pad_widths: Optional[dict] = None,
     exact_filter: bool = True,
+    prefetch: int = 0,
 ) -> Iterator[Table]:
-    """Stream an ORC file stripe-by-stripe as device Tables."""
+    """Stream an ORC file stripe-by-stripe as device Tables.
+
+    ``prefetch=N`` decodes/uploads up to N stripes ahead on a background
+    thread (same overlap machinery as scan_parquet)."""
     _require()
+    from ..interop import table_from_arrow
+    from .parquet import _apply_exact_filter, _prefetch_iter
+
+    if prefetch > 0:
+        return _prefetch_iter(
+            scan_orc(path, columns, filters, pad_widths, exact_filter,
+                     prefetch=0),
+            prefetch,
+        )
+    return _scan_orc_serial(
+        path, columns, filters, pad_widths, exact_filter
+    )
+
+
+def _scan_orc_serial(
+    path, columns, filters, pad_widths, exact_filter
+) -> Iterator[Table]:
     from ..interop import table_from_arrow
     from .parquet import _apply_exact_filter
 

@@ -376,3 +376,22 @@ class TestPrefetchOverlap:
         with pytest.raises(Exception):
             list(scan_parquet(str(tmp_path / "missing.parquet"),
                               prefetch=2))
+
+
+class TestOrcPrefetch:
+    def test_orc_prefetch_matches_serial(self, tmp_path, rng):
+        pa = pytest.importorskip("pyarrow")
+        orc = pytest.importorskip("pyarrow.orc")
+        from spark_rapids_jni_tpu.io.orc import scan_orc
+
+        path = str(tmp_path / "t.orc")
+        n = 30_000
+        tbl = pa.table({"k": rng.integers(0, 100, n)})
+        orc.write_table(tbl, path, stripe_size=8 * 64 * 1024)
+        serial = [np.asarray(t["k"].data) for t in scan_orc(path)]
+        pre = [
+            np.asarray(t["k"].data) for t in scan_orc(path, prefetch=2)
+        ]
+        assert len(serial) == len(pre) >= 1
+        for a, b in zip(serial, pre):
+            np.testing.assert_array_equal(a, b)

@@ -506,3 +506,44 @@ class TestDistributedSort:
         ks = self._collect(out, occ, "k")
         vs = self._collect(out, occ, "v")
         assert vs == [x * 10 for x in ks]
+
+
+class TestDistributedDecimal128:
+    def test_distributed_groupby_decimal128(self, mesh, rng):
+        """Two-u64-limb columns ((n, 2) buffers) ride the ragged-compact
+        exchange and the exact mod-2^128 segment sums end-to-end."""
+        from spark_rapids_jni_tpu.ops.int128 import from_py_ints
+
+        n = 800
+        k = rng.integers(0, 20, n, dtype=np.int64)
+        vals = [int(v) * 10**25 for v in rng.integers(-50, 50, n)]
+        from spark_rapids_jni_tpu import dtype as dt
+
+        t = Table(
+            [
+                Column.from_numpy(k),
+                Column.from_numpy(
+                    from_py_ints(vals), dtype=dt.decimal128(-30)
+                ),
+            ],
+            ["k", "d"],
+        )
+        agg, ngroups, overflow = parallel.distributed_groupby(
+            t, ["k"], [GroupbyAgg("d", "sum")], mesh
+        )
+        assert int(np.asarray(overflow).max()) <= 0
+        per_dev = agg["k"].data.shape[0] // 8
+        counts = np.asarray(ngroups)
+        got = {}
+        ks = np.asarray(agg["k"].data).reshape(8, per_dev)
+        from spark_rapids_jni_tpu.ops.int128 import to_py_ints
+
+        sums_limbs = np.asarray(agg["sum_d"].data).reshape(8, per_dev, 2)
+        for d in range(8):
+            sums = to_py_ints(sums_limbs[d])
+            for i in range(counts[d]):
+                got[int(ks[d, i])] = sums[i]
+        want = {}
+        for key, v in zip(k.tolist(), vals):
+            want[key] = want.get(key, 0) + v
+        assert got == want
