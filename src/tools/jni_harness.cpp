@@ -296,6 +296,52 @@ int main() {
     std::printf("jni_harness: resident-table chaining ok\n");
   }
 
+  /* -- 3c. DECIMAL128 across the JNI wire (16-byte limb values) ------- */
+  {
+    /* unscaled values spanning past 64 bits: -(2^70), -1, 0, 1, 2^70 */
+    const int64_t dn = 5;
+    uint64_t limbs[dn][2] = {
+        {0, 0xFFFFFFFFFFFFFFC0ULL},  /* -(2^70) : lo=0, hi=-(1<<6) */
+        {0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL}, /* -1 */
+        {0, 0},
+        {1, 0},
+        {0, 0x40ULL},                /* 2^70 : hi = 1<<6 */
+    };
+    /* shuffle them out of order */
+    uint64_t shuffled[dn][2];
+    const int order[dn] = {4, 1, 3, 0, 2};
+    for (int i = 0; i < dn; ++i) {
+      shuffled[i][0] = limbs[order[i]][0];
+      shuffled[i][1] = limbs[order[i]][1];
+    }
+    srt_handle hd128 = srt_buffer_create(shuffled, sizeof shuffled, "d128");
+    CHECK(hd128 != 0, "decimal128 buffer");
+    jintArray did = srt_mock::make_int_array({27});   /* DECIMAL128 */
+    jintArray dsc = srt_mock::make_int_array({-7});
+    jlongArray ddat = srt_mock::make_long_array({hd128});
+    jlongArray dval = srt_mock::make_long_array({0});
+    jlongArray dres = Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+        env, cls,
+        srt_mock::make_string(
+            "{\"op\": \"sort_by\", \"keys\": [{\"column\": 0}]}"),
+        did, dsc, ddat, dval, dn);
+    CHECK(!srt_mock::exception_pending() && dres != nullptr,
+          "decimal128 sort dispatch");
+    std::vector<jlong> dv = srt_mock::long_array_values(dres);
+    CHECK(dv[0] == 1 && dv[1] == dn, "decimal128 result shape");
+    CHECK(dv[2] == 27 && dv[2 + 1] == -7, "decimal128 type/scale echo");
+    const auto* sorted128 =
+        static_cast<const uint64_t*>(srt_buffer_data(dv[4]));
+    for (int64_t i = 0; i < dn; ++i) {
+      CHECK(sorted128[2 * i] == limbs[i][0] &&
+                sorted128[2 * i + 1] == limbs[i][1],
+            "decimal128 sorted order");
+    }
+    srt_buffer_release(dv[4]);
+    srt_buffer_release(hd128);
+    std::printf("jni_harness: DECIMAL128 wire sort ok\n");
+  }
+
   /* -- 4. error paths must record pending Java exceptions ------------ */
   CHECK_THROWS(
       Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(

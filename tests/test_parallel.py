@@ -547,3 +547,33 @@ class TestDistributedDecimal128:
         for key, v in zip(k.tolist(), vals):
             want[key] = want.get(key, 0) + v
         assert got == want
+
+
+class TestDistributedSortStrings:
+    def test_total_order_string_keys(self, mesh, rng):
+        """Multi-word order keys (padded byte matrix + length tiebreak)
+        through the sample -> range-partition -> local-sort pipeline."""
+        words = [f"w{i:03d}" for i in range(40)]
+        n = 800
+        vals = [words[i] for i in rng.integers(0, 40, n)]
+        t = Table(
+            [
+                Column.from_strings(vals),
+                Column.from_numpy(np.arange(n, dtype=np.int64)),
+            ],
+            ["k", "v"],
+        )
+        out, occ, overflow = parallel.distributed_sort(t, ["k"], mesh)
+        assert int(np.asarray(overflow).max()) <= 0
+        per_dev = out["k"].data.shape[0] // 8
+        occ_np = np.asarray(occ).reshape(8, per_dev)
+        mats = np.asarray(out["k"].data).reshape(8, per_dev, -1)
+        lens = np.asarray(out["k"].lengths).reshape(8, per_dev)
+        got = []
+        for d in range(8):
+            for i in range(per_dev):
+                if occ_np[d, i]:
+                    got.append(
+                        bytes(mats[d, i, : lens[d, i]]).decode()
+                    )
+        assert got == sorted(vals)
