@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from spark_rapids_jni_tpu.column import Table
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column, Table
 
 
 def generate(sales_rows: int = 100_000, seed: int = 0) -> dict:
@@ -27,24 +28,57 @@ def generate(sales_rows: int = 100_000, seed: int = 0) -> dict:
     item_pop = rng.zipf(1.3, sales_rows) % n_items
 
     def fact(n):
-        return {
-            "item_sk": item_pop[:n].astype(np.int64),
-            "customer_sk": rng.integers(0, n_cust, n, dtype=np.int64),
-            "date_sk": rng.integers(0, n_dates, n, dtype=np.int64),
-            "quantity": rng.integers(1, 100, n, dtype=np.int64),
-            "sales_price": np.round(rng.uniform(0.5, 300.0, n), 2),
-            "net_profit": np.round(rng.uniform(-50.0, 120.0, n), 2),
-        }
+        # TPC-DS money columns are DECIMAL(7,2): unscaled cents carried
+        # at scale -2 (the representation the reference round-trips,
+        # RowConversionTest.java:37-38), not floats
+        return Table(
+            [
+                Column.from_numpy(item_pop[:n].astype(np.int64)),
+                Column.from_numpy(
+                    rng.integers(0, n_cust, n, dtype=np.int64)
+                ),
+                Column.from_numpy(
+                    rng.integers(0, n_dates, n, dtype=np.int64)
+                ),
+                Column.from_numpy(
+                    rng.integers(1, 100, n, dtype=np.int64)
+                ),
+                Column.from_numpy(
+                    rng.integers(50, 30_000, n, dtype=np.int64),
+                    dtype=dt.decimal64(-2),
+                ),
+                Column.from_numpy(
+                    rng.integers(-5_000, 12_000, n, dtype=np.int64),
+                    dtype=dt.decimal64(-2),
+                ),
+            ],
+            ["item_sk", "customer_sk", "date_sk", "quantity",
+             "sales_price", "net_profit"],
+        )
 
     store_sales = fact(sales_rows)
     web_sales = fact(max(sales_rows // 4, 8))
 
-    item = {
-        "item_sk": np.arange(n_items, dtype=np.int64),
-        "brand_id": rng.integers(0, 100, n_items, dtype=np.int64),
-        "category_id": rng.integers(0, 12, n_items, dtype=np.int64),
-        "current_price": np.round(rng.uniform(0.5, 300.0, n_items), 2),
-    }
+    item = Table(
+        [
+            Column.from_numpy(np.arange(n_items, dtype=np.int64)),
+            Column.from_numpy(
+                rng.integers(0, 100, n_items, dtype=np.int64)
+            ),
+            Column.from_numpy(
+                rng.integers(0, 12, n_items, dtype=np.int64)
+            ),
+            Column.from_numpy(
+                rng.integers(50, 30_000, n_items, dtype=np.int64),
+                dtype=dt.decimal64(-2),
+            ),
+            # string dimension attribute: rides joins and the shuffle
+            Column.from_strings(
+                [f"brand#{i % 100:02d}" for i in range(n_items)]
+            ),
+        ],
+        ["item_sk", "brand_id", "category_id", "current_price", "brand"],
+    )
     customer = {
         "customer_sk": np.arange(n_cust, dtype=np.int64),
         "birth_year": rng.integers(1930, 2005, n_cust, dtype=np.int64),
@@ -56,9 +90,9 @@ def generate(sales_rows: int = 100_000, seed: int = 0) -> dict:
         "moy": (np.arange(n_dates, dtype=np.int64) // 30) % 12 + 1,
     }
     return {
-        "store_sales": Table.from_pydict(store_sales),
-        "web_sales": Table.from_pydict(web_sales),
-        "item": Table.from_pydict(item),
+        "store_sales": store_sales,
+        "web_sales": web_sales,
+        "item": item,
         "customer": Table.from_pydict(customer),
         "date_dim": Table.from_pydict(date_dim),
     }

@@ -142,13 +142,21 @@ def q23_distributed(tables: dict, mesh, min_count: int = 4):
 # q64-shape: chained dimension joins -> wide-key aggregation
 # ---------------------------------------------------------------------------
 
+def _price_cutoff(col, max_price: float):
+    """Threshold in the column's own representation (decimal columns
+    hold unscaled values: $150.00 at scale -2 is 15000)."""
+    scale = col.dtype.scale if col.dtype.is_decimal else 0
+    return max_price * (10 ** -scale)
+
+
 def q64(tables: dict, max_price: float = 150.0) -> Table:
     sales = tables["store_sales"]
     item = tables["item"]
     cheap = ops.filter_table(
         item,
         Column(
-            ops.compute.values(item["current_price"]) <= max_price,
+            ops.compute.values(item["current_price"])
+            <= _price_cutoff(item["current_price"], max_price),
             dt.BOOL8,
             None,
         ),
@@ -173,7 +181,8 @@ def q64_distributed(tables: dict, mesh, max_price: float = 150.0):
     cheap = ops.filter_table(
         item,
         Column(
-            ops.compute.values(item["current_price"]) <= max_price,
+            ops.compute.values(item["current_price"])
+            <= _price_cutoff(item["current_price"], max_price),
             dt.BOOL8,
             None,
         ),
@@ -224,8 +233,15 @@ def _pad_to_mesh(table: Table, mesh) -> Table:
     pad_cols = []
     for c in table.columns:
         if c.dtype.is_string:
-            raise TypeError("benchmark padding: fixed-width only")
-        fill_vals = jnp.full((rem,), _PAD_KEY).astype(c.data.dtype)
+            # empty-string padding rows (zero bytes, zero lengths)
+            data = jnp.zeros((rem, c.data.shape[1]), jnp.uint8)
+            pad_cols.append(
+                Column(data, c.dtype, None, jnp.zeros((rem,), jnp.int32))
+            )
+            continue
+        fill_vals = jnp.full(
+            (rem,) + tuple(c.data.shape[1:]), _PAD_KEY
+        ).astype(c.data.dtype)
         pad_cols.append(Column(fill_vals, c.dtype, None))
     pad = Table(pad_cols, list(table.names))
     return ops.concatenate([table, pad])

@@ -45,13 +45,31 @@ _ARITH_OPS = {
 
 def _promote(a: Column, b: Column) -> dt.DType:
     if a.dtype.is_decimal or b.dtype.is_decimal:
-        if a.dtype.is_decimal and b.dtype.is_decimal:
-            wid = max(a.dtype.itemsize, b.dtype.itemsize)
-            scale = min(a.dtype.scale, b.dtype.scale)
-            return dt.DType(
-                dt.TypeId.DECIMAL64 if wid >= 8 else dt.TypeId.DECIMAL32, scale
+        da, db = a.dtype, b.dtype
+        # Spark promotes an integer operand to decimal(scale 0), so
+        # qty * price works without an explicit cast; floats still
+        # require one (the result type would silently stop being exact)
+        if not da.is_decimal:
+            if not da.is_integer:
+                raise TypeError(
+                    "decimal/float binary ops require explicit cast"
+                )
+            da = dt.DType(
+                dt.TypeId.DECIMAL64 if da.itemsize >= 8 else dt.TypeId.DECIMAL32
             )
-        raise TypeError("decimal/non-decimal binary ops require explicit cast")
+        if not db.is_decimal:
+            if not db.is_integer:
+                raise TypeError(
+                    "decimal/float binary ops require explicit cast"
+                )
+            db = dt.DType(
+                dt.TypeId.DECIMAL64 if db.itemsize >= 8 else dt.TypeId.DECIMAL32
+            )
+        wid = max(da.itemsize, db.itemsize)
+        scale = min(da.scale, db.scale)
+        return dt.DType(
+            dt.TypeId.DECIMAL64 if wid >= 8 else dt.TypeId.DECIMAL32, scale
+        )
     return dt.common_numeric_dtype(a.dtype, b.dtype)
 
 

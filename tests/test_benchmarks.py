@@ -72,10 +72,13 @@ def test_q23_vs_oracle(tables):
 
 def _oracle_q64(tables, max_price=150.0):
     item = tables["item"].to_pydict()
+    # current_price is decimal: to_pydict yields unscaled values
+    price_scale = tables["item"]["current_price"].dtype.scale
+    cutoff = max_price * (10 ** -price_scale)
     cheap_brand = {
         item["item_sk"][i]: item["brand_id"][i]
         for i in range(len(item["item_sk"]))
-        if item["current_price"][i] <= max_price
+        if item["current_price"][i] <= cutoff
     }
     cust = tables["customer"].to_pydict()
     state = dict(zip(cust["customer_sk"], cust["state_id"]))
