@@ -27,7 +27,7 @@ from .parquet import (  # noqa: F401
     parquet_metadata,
 )
 from .orc import read_orc, scan_orc, write_orc  # noqa: F401
-from .csv import read_csv, write_csv  # noqa: F401
+from .csv import read_csv, scan_csv, write_csv  # noqa: F401
 from .ipc import read_arrow_ipc, write_arrow_ipc  # noqa: F401
 
 __all__ = [
@@ -43,6 +43,7 @@ __all__ = [
     "scan_orc",
     "write_orc",
     "read_csv",
+    "scan_csv",
     "write_csv",
     "read_arrow_ipc",
     "write_arrow_ipc",

@@ -97,3 +97,61 @@ def write_csv(table: Table, path, delimiter: str = ",", header: bool = True) -> 
                 include_header=header, delimiter=delimiter
             ),
         )
+
+
+def scan_csv(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    delimiter: str = ",",
+    header: bool = True,
+    block_size: int = 1 << 22,
+    pad_widths: Optional[dict] = None,
+    prefetch: int = 0,
+):
+    """Stream a CSV file as device Table batches (Arrow incremental
+    reader, one batch per ~``block_size`` bytes). ``prefetch=N`` parses
+    and uploads ahead on a background thread like scan_parquet."""
+    _require()
+    from .parquet import _prefetch_iter
+
+    if prefetch > 0:
+        return _prefetch_iter(
+            scan_csv(path, columns, filters, delimiter, header,
+                     block_size, pad_widths, prefetch=0),
+            prefetch,
+        )
+    return _scan_csv_serial(
+        path, columns, filters, delimiter, header, block_size, pad_widths
+    )
+
+
+def _scan_csv_serial(
+    path, columns, filters, delimiter, header, block_size, pad_widths
+):
+    from ..interop import table_from_arrow
+    from .parquet import _apply_exact_filter
+
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    read_opts = pa_csv.ReadOptions(
+        autogenerate_column_names=not header, block_size=block_size
+    )
+    parse_opts = pa_csv.ParseOptions(delimiter=delimiter)
+    with pa_csv.open_csv(
+        path, read_options=read_opts, parse_options=parse_opts
+    ) as reader:
+        want = None
+        for batch in reader:
+            atbl = pa.Table.from_batches([batch])
+            if want is None:
+                want, read_cols = preds.projection_columns(
+                    predicate, columns, atbl.column_names
+                )
+            with trace_range("io.csv.upload"):
+                dev = table_from_arrow(
+                    atbl.select(read_cols), pad_widths=pad_widths
+                )
+            if predicate is not None:
+                with trace_range("io.csv.filter"):
+                    dev = _apply_exact_filter(dev, predicate, want)
+            yield dev
