@@ -106,28 +106,36 @@ def scan_csv(
     delimiter: str = ",",
     header: bool = True,
     block_size: int = 1 << 22,
+    dtypes: Optional[dict] = None,
     pad_widths: Optional[dict] = None,
     prefetch: int = 0,
 ):
     """Stream a CSV file as device Table batches (Arrow incremental
     reader, one batch per ~``block_size`` bytes). ``prefetch=N`` parses
-    and uploads ahead on a background thread like scan_parquet."""
+    and uploads ahead on a background thread like scan_parquet.
+
+    ``dtypes`` pins column types up front — the incremental reader infers
+    types from the FIRST block only and aborts on later drift, so pin any
+    column whose early rows underdetermine its type (e.g. ints followed by
+    floats past ``block_size``)."""
     _require()
     from .parquet import _prefetch_iter
 
     if prefetch > 0:
         return _prefetch_iter(
             scan_csv(path, columns, filters, delimiter, header,
-                     block_size, pad_widths, prefetch=0),
+                     block_size, dtypes, pad_widths, prefetch=0),
             prefetch,
         )
     return _scan_csv_serial(
-        path, columns, filters, delimiter, header, block_size, pad_widths
+        path, columns, filters, delimiter, header, block_size, dtypes,
+        pad_widths,
     )
 
 
 def _scan_csv_serial(
-    path, columns, filters, delimiter, header, block_size, pad_widths
+    path, columns, filters, delimiter, header, block_size, dtypes,
+    pad_widths,
 ):
     from ..interop import table_from_arrow
     from .parquet import _apply_exact_filter
@@ -137,15 +145,34 @@ def _scan_csv_serial(
         autogenerate_column_names=not header, block_size=block_size
     )
     parse_opts = pa_csv.ParseOptions(delimiter=delimiter)
+    # with an explicit projection the convert set is known up front, so
+    # unprojected columns skip host type conversion entirely (same
+    # pushdown read_csv does); without one it's known after block 1
+    want = read_cols = None
+    if columns is not None:
+        want, read_cols = preds.projection_columns(
+            predicate, columns, columns
+        )
+    convert_opts = pa_csv.ConvertOptions(
+        column_types={k: v for k, v in (dtypes or {}).items()},
+        include_columns=read_cols,
+    )
     with pa_csv.open_csv(
-        path, read_options=read_opts, parse_options=parse_opts
+        path,
+        read_options=read_opts,
+        parse_options=parse_opts,
+        convert_options=convert_opts,
     ) as reader:
-        want = None
-        for batch in reader:
-            atbl = pa.Table.from_batches([batch])
+        while True:
+            with trace_range("io.csv.parse"):
+                try:
+                    batch = reader.read_next_batch()
+                except StopIteration:
+                    break
+                atbl = pa.Table.from_batches([batch])
             if want is None:
                 want, read_cols = preds.projection_columns(
-                    predicate, columns, atbl.column_names
+                    predicate, None, atbl.column_names
                 )
             with trace_range("io.csv.upload"):
                 dev = table_from_arrow(

@@ -59,6 +59,16 @@ from .window import (
     row_number,
 )
 from .quantiles import quantile
+from . import regex
+from .regex import (
+    contains_re,
+    matches_re,
+    rlike,
+    find_re,
+    extract_re,
+    replace_re,
+    count_re,
+)
 
 __all__ = [
     "compute",
@@ -124,4 +134,12 @@ __all__ = [
     "lag",
     "row_number",
     "quantile",
+    "regex",
+    "contains_re",
+    "matches_re",
+    "rlike",
+    "find_re",
+    "extract_re",
+    "replace_re",
+    "count_re",
 ]

@@ -1,0 +1,697 @@
+"""Regular-expression ops over string columns: host-compiled DFA,
+device-executed as table gathers under ``lax.scan``.
+
+cudf ships a full regex engine in CUDA (``contains_re``, ``matches_re``,
+``extract``, ``replace_re`` — part of the string surface exercised by the
+vendored Java suite, SURVEY.md §2.3 string-ops row; Spark plans reach it
+through ``rlike`` / ``regexp_extract`` / ``regexp_replace``). A
+backtracking engine is hostile to XLA — per-row data-dependent control
+flow — so the TPU design moves ALL regex analysis to the host and leaves
+the device a branch-free automaton:
+
+  host:   pattern → Thompson NFA → byte equivalence classes → dense
+          (states × classes) DFA transition table (numpy, cached)
+  device: ``lax.scan`` over the pad dimension of the (n, pad) string
+          matrix; each step is one gather into the transition table —
+          identical cost for every row, no data-dependent shapes.
+
+Span queries (extract/replace) track one DFA instance per start offset:
+the carry is an (n, pad) state matrix and every scan step advances all
+starts at once, so the whole leftmost-longest span table costs ``pad``
+steps of vectorized work instead of a per-row backtracking loop.
+
+Supported syntax (byte-level, ASCII-oriented — a documented subset):
+literals, ``.``, escapes (``\\n \\t \\r \\f \\v \\xHH`` + escaped
+specials), ``[...]`` classes with ranges and negation, ``\\d \\D \\w
+\\W \\s \\S``, alternation ``|``, groups ``(...)`` / ``(?:...)``,
+quantifiers ``* + ? {m} {m,} {m,n}``, anchors ``^`` / ``$`` at the
+pattern ends. Match semantics are leftmost-longest (POSIX), which agrees
+with Java/Spark for the patterns plans generate; divergent corners
+(e.g. ``(a|ab)`` alternation order) are pinned in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import dtype as dt
+from ..column import Column
+from .strings import _require_string, _shift_left
+
+_MAX_DFA_STATES = 1024
+_MAX_COUNTED_REPEAT = 64
+
+_DIGIT = frozenset(range(ord("0"), ord("9") + 1))
+_WORD = frozenset(
+    set(_DIGIT)
+    | set(range(ord("a"), ord("z") + 1))
+    | set(range(ord("A"), ord("Z") + 1))
+    | {ord("_")}
+)
+_SPACE = frozenset(b" \t\n\r\f\v")
+_ALL = frozenset(range(256))
+_DOT = _ALL - {ord("\n")}
+_SPECIALS = set("\\^$.|?*+()[]{}")
+
+
+# ---------------------------------------------------------------------------
+# AST: ('lit', charset) | ('cat', [nodes]) | ('alt', [nodes])
+#      ('star'|'plus'|'opt', node) | ('group', node, index)
+# ---------------------------------------------------------------------------
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.ngroups = 0
+
+    def _peek(self):
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def _take(self):
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def _error(self, msg):
+        raise ValueError(f"regex: {msg} at position {self.i} in {self.p!r}")
+
+    def parse(self):
+        node = self._alt()
+        if self.i != len(self.p):
+            self._error(f"unexpected {self._peek()!r}")
+        return node
+
+    def _alt(self):
+        branches = [self._cat()]
+        while self._peek() == "|":
+            self._take()
+            branches.append(self._cat())
+        return branches[0] if len(branches) == 1 else ("alt", branches)
+
+    def _cat(self):
+        items = []
+        while self._peek() not in (None, "|", ")"):
+            items.append(self._repeat())
+        if len(items) == 1:
+            return items[0]
+        return ("cat", items)
+
+    def _repeat(self):
+        node = self._atom()
+        while True:
+            c = self._peek()
+            if c == "*":
+                self._take()
+                node = ("star", node)
+            elif c == "+":
+                self._take()
+                node = ("plus", node)
+            elif c == "?":
+                self._take()
+                node = ("opt", node)
+            elif c == "{":
+                node = self._counted(node)
+            else:
+                return node
+
+    def _counted(self, node):
+        self._take()  # '{'
+        spec = ""
+        while self._peek() not in (None, "}"):
+            spec += self._take()
+        if self._peek() != "}":
+            self._error("unterminated {m,n}")
+        self._take()
+        parts = spec.split(",")
+        try:
+            lo = int(parts[0])
+            if len(parts) == 1:
+                hi = lo
+            elif parts[1] == "":
+                hi = None
+            else:
+                hi = int(parts[1])
+        except ValueError:
+            self._error(f"bad counted repeat {{{spec}}}")
+        if hi is not None and (hi < lo or hi > _MAX_COUNTED_REPEAT):
+            self._error(f"counted repeat bound must be <= {_MAX_COUNTED_REPEAT}")
+        if lo > _MAX_COUNTED_REPEAT:
+            self._error(f"counted repeat bound must be <= {_MAX_COUNTED_REPEAT}")
+        items = [node] * lo
+        if hi is None:
+            items.append(("star", node))
+        else:
+            items.extend([("opt", node)] * (hi - lo))
+        return ("cat", items)
+
+    def _atom(self):
+        c = self._peek()
+        if c is None:
+            self._error("expected atom")
+        if c == "(":
+            self._take()
+            capturing = True
+            if self.p[self.i : self.i + 2] == "?:":
+                self.i += 2
+                capturing = False
+            inner = self._alt()
+            if self._peek() != ")":
+                self._error("unterminated group")
+            self._take()
+            if capturing:
+                self.ngroups += 1
+                return ("group", inner, self.ngroups)
+            return inner
+        if c == "[":
+            return ("lit", self._char_class())
+        if c == ".":
+            self._take()
+            return ("lit", _DOT)
+        if c == "\\":
+            return ("lit", self._escape())
+        if c in "^$":
+            self._error(f"anchor {c!r} only supported at the pattern ends")
+        if c in "*+?{":
+            self._error(f"quantifier {c!r} with nothing to repeat")
+        self._take()
+        return ("lit", frozenset({ord(c)}))
+
+    def _escape(self) -> frozenset:
+        self._take()  # backslash
+        c = self._peek()
+        if c is None:
+            self._error("trailing backslash")
+        self._take()
+        simple = {"n": 10, "t": 9, "r": 13, "f": 12, "v": 11, "0": 0}
+        if c in simple:
+            return frozenset({simple[c]})
+        if c == "x":
+            hh = self.p[self.i : self.i + 2]
+            if len(hh) != 2:
+                self._error("bad \\xHH escape")
+            self.i += 2
+            return frozenset({int(hh, 16)})
+        classes = {
+            "d": _DIGIT, "D": _ALL - _DIGIT,
+            "w": _WORD, "W": _ALL - _WORD,
+            "s": _SPACE, "S": _ALL - _SPACE,
+        }
+        if c in classes:
+            return classes[c]
+        if c in _SPECIALS or not c.isalnum():
+            return frozenset({ord(c)})
+        self._error(f"unsupported escape \\{c}")
+
+    def _char_class(self) -> frozenset:
+        self._take()  # '['
+        negate = False
+        if self._peek() == "^":
+            negate = True
+            self._take()
+        members: set = set()
+        while True:
+            c = self._peek()
+            if c is None:
+                self._error("unterminated character class")
+            if c == "]":
+                self._take()
+                break
+            if c == "\\":
+                sub = self._escape()
+                if len(sub) > 1:  # \d etc. — no range allowed off it
+                    members |= sub
+                    continue
+                lo = next(iter(sub))
+            else:
+                self._take()
+                lo = ord(c)
+            if self._peek() == "-" and self.p[self.i + 1 : self.i + 2] not in (
+                "", "]",
+            ):
+                self._take()  # '-'
+                c2 = self._take()
+                if c2 == "\\":
+                    self.i -= 1
+                    sub2 = self._escape()
+                    if len(sub2) > 1:
+                        self._error("bad range endpoint")
+                    hi = next(iter(sub2))
+                else:
+                    hi = ord(c2)
+                if hi < lo:
+                    self._error("reversed character-class range")
+                members |= set(range(lo, hi + 1))
+            else:
+                members.add(lo)
+        return frozenset(_ALL - members if negate else members)
+
+
+def _strip_anchors(pattern: str):
+    """Peel ``^``/``$`` off the pattern ends (the only positions the
+    subset supports; elsewhere the parser errors out)."""
+    anchored_start = pattern.startswith("^")
+    if anchored_start:
+        pattern = pattern[1:]
+    # '$' anchors only when not escaped: count trailing backslashes
+    anchored_end = False
+    if pattern.endswith("$"):
+        nbs = len(pattern[:-1]) - len(pattern[:-1].rstrip("\\"))
+        if nbs % 2 == 0:
+            anchored_end = True
+            pattern = pattern[:-1]
+    return pattern, anchored_start, anchored_end
+
+
+# ---------------------------------------------------------------------------
+# Thompson NFA + subset construction over byte equivalence classes
+# ---------------------------------------------------------------------------
+
+
+class _NFA:
+    def __init__(self):
+        self.nstates = 0
+        self.eps: list[set] = []
+        self.trans: list[tuple[int, frozenset, int]] = []
+
+    def state(self) -> int:
+        self.eps.append(set())
+        self.nstates += 1
+        return self.nstates - 1
+
+    def add(self, src, charset, dst):
+        self.trans.append((src, charset, dst))
+
+    def compile(self, node) -> tuple[int, int]:
+        """Thompson fragment: returns (start, accept)."""
+        kind = node[0]
+        if kind == "lit":
+            s, a = self.state(), self.state()
+            self.add(s, node[1], a)
+            return s, a
+        if kind == "cat":
+            if not node[1]:
+                s = self.state()
+                return s, s
+            frags = [self.compile(ch) for ch in node[1]]
+            for (_, a), (s2, _) in zip(frags, frags[1:]):
+                self.eps[a].add(s2)
+            return frags[0][0], frags[-1][1]
+        if kind == "alt":
+            s, a = self.state(), self.state()
+            for branch in node[1]:
+                bs, ba = self.compile(branch)
+                self.eps[s].add(bs)
+                self.eps[ba].add(a)
+            return s, a
+        if kind == "star":
+            s, a = self.state(), self.state()
+            bs, ba = self.compile(node[1])
+            self.eps[s] |= {bs, a}
+            self.eps[ba] |= {bs, a}
+            return s, a
+        if kind == "plus":
+            bs, ba = self.compile(node[1])
+            s, a = self.state(), self.state()
+            self.eps[s].add(bs)
+            self.eps[ba] |= {bs, a}
+            return s, a
+        if kind == "opt":
+            s, a = self.state(), self.state()
+            bs, ba = self.compile(node[1])
+            self.eps[s] |= {bs, a}
+            self.eps[ba].add(a)
+            return s, a
+        if kind == "group":
+            return self.compile(node[1])
+        raise AssertionError(f"unknown AST node {kind}")
+
+    def closure(self, states: frozenset) -> frozenset:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for t in self.eps[s]:
+                if t not in out:
+                    out.add(t)
+                    stack.append(t)
+        return frozenset(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledRegex:
+    """Dense DFA tables (host numpy; uploaded as constants at trace time)."""
+
+    class_map: np.ndarray  # (256,) int32: byte -> equivalence class
+    trans: np.ndarray      # (S, C) int32: state × class -> state
+    accepting: np.ndarray  # (S,) bool
+    anchored_start: bool
+    anchored_end: bool
+    # capture-group geometry for extract (None when not applicable)
+    prefix_len: int | None = None
+    suffix_len: int | None = None
+
+    @property
+    def num_classes(self) -> int:
+        return self.trans.shape[1]
+
+
+def _byte_classes(nfa: _NFA) -> np.ndarray:
+    """Partition bytes by the set of NFA edges they can drive — the DFA
+    only needs one column per class, keeping the table small."""
+    sig = {}
+    class_map = np.zeros(256, dtype=np.int32)
+    for b in range(256):
+        key = frozenset(
+            i for i, (_, cs, _) in enumerate(nfa.trans) if b in cs
+        )
+        if key not in sig:
+            sig[key] = len(sig)
+        class_map[b] = sig[key]
+    return class_map
+
+
+def _determinize(nfa: _NFA, start: int, accept: int, class_map) -> tuple:
+    nclasses = int(class_map.max()) + 1
+    rep_byte = [int(np.argmax(class_map == c)) for c in range(nclasses)]
+    start_set = nfa.closure(frozenset({start}))
+    ids = {start_set: 0}
+    rows = []
+    work = [start_set]
+    while work:
+        cur = work.pop(0)
+        row = []
+        for c in range(nclasses):
+            b = rep_byte[c]
+            moved = frozenset(
+                d for (s, cs, d) in nfa.trans if s in cur and b in cs
+            )
+            nxt = nfa.closure(moved) if moved else frozenset()
+            if nxt not in ids:
+                if len(ids) >= _MAX_DFA_STATES:
+                    raise ValueError(
+                        f"regex too complex: DFA exceeds {_MAX_DFA_STATES} states"
+                    )
+                ids[nxt] = len(ids)
+                work.append(nxt)
+            row.append(ids[nxt])
+        rows.append(row)
+    trans = np.asarray(rows, dtype=np.int32)
+    accepting = np.zeros(len(ids), dtype=bool)
+    for sset, i in ids.items():
+        accepting[i] = accept in sset
+    return trans, accepting
+
+
+def _node_len_range(node) -> tuple[int, float]:
+    kind = node[0]
+    if kind == "lit":
+        return 1, 1
+    if kind == "cat":
+        lo = hi = 0
+        for ch in node[1]:
+            l, h = _node_len_range(ch)
+            lo, hi = lo + l, hi + h
+        return lo, hi
+    if kind == "alt":
+        ranges = [_node_len_range(b) for b in node[1]]
+        return min(r[0] for r in ranges), max(r[1] for r in ranges)
+    if kind == "star":
+        return 0, float("inf")
+    if kind == "plus":
+        return _node_len_range(node[1])[0], float("inf")
+    if kind == "opt":
+        return 0, _node_len_range(node[1])[1]
+    if kind == "group":
+        return _node_len_range(node[1])
+    raise AssertionError(kind)
+
+
+def _group_geometry(node):
+    """For extract: the pattern must be a concatenation containing exactly
+    one capture group, with fixed-length prefix and suffix around it (the
+    shape of practical ``regexp_extract`` patterns like ``id=(\\d+)``).
+    Returns (prefix_len, suffix_len) or raises."""
+    items = node[1] if node[0] == "cat" else [node]
+    gidx = [i for i, it in enumerate(items) if it[0] == "group"]
+    if len(gidx) != 1:
+        raise ValueError(
+            "extract_re: pattern must contain exactly one capture group"
+        )
+    g = gidx[0]
+    pre_lo, pre_hi = _node_len_range(("cat", items[:g]))
+    suf_lo, suf_hi = _node_len_range(("cat", items[g + 1 :]))
+    if pre_lo != pre_hi or suf_lo != suf_hi:
+        raise ValueError(
+            "extract_re: text before/after the capture group must have a "
+            "fixed match length (use {m} instead of open quantifiers there)"
+        )
+    return int(pre_lo), int(suf_lo)
+
+
+@functools.lru_cache(maxsize=256)
+def compile_re(
+    pattern: str, *, search_prefix: bool = False, with_group: bool = False
+) -> CompiledRegex:
+    """Compile to DFA tables. ``search_prefix`` prepends an implicit
+    ``.*`` (any byte, including newline) for find-anywhere semantics;
+    ``with_group`` additionally computes extract geometry."""
+    body, anch_s, anch_e = _strip_anchors(pattern)
+    parser = _Parser(body)
+    ast = parser.parse()
+    pre = suf = None
+    if with_group:
+        pre, suf = _group_geometry(ast)
+    if search_prefix and not anch_s:
+        ast = ("cat", [("star", ("lit", _ALL)), ast])
+    nfa = _NFA()
+    start, accept = nfa.compile(ast)
+    class_map = _byte_classes(nfa)
+    trans, accepting = _determinize(nfa, start, accept, class_map)
+    return CompiledRegex(
+        class_map=class_map,
+        trans=trans,
+        accepting=accepting,
+        anchored_start=anch_s,
+        anchored_end=anch_e,
+        prefix_len=pre,
+        suffix_len=suf,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device execution
+# ---------------------------------------------------------------------------
+
+
+def _dfa_tables(rx: CompiledRegex):
+    return (
+        jnp.asarray(rx.class_map),
+        jnp.asarray(rx.trans.reshape(-1)),
+        jnp.asarray(rx.accepting),
+        rx.trans.shape[1],
+    )
+
+
+def contains_re(col: Column, pattern: str) -> Column:
+    """True where the pattern matches anywhere in the string — Spark
+    ``rlike`` / cudf ``strings::contains_re``. One DFA state per row,
+    ``pad`` scan steps of one gather each."""
+    _require_string(col)
+    rx = compile_re(pattern, search_prefix=True)
+    cmap, tflat, acc, C = _dfa_tables(rx)
+    n, pad = col.data.shape
+    lens = col.lengths
+
+    def step(carry, x):
+        state, found = carry
+        j, byte_col = x
+        nxt = tflat[state * C + cmap[byte_col]]
+        live = j < lens
+        state = jnp.where(live, nxt, state)
+        found = found | (acc[state] & live)
+        return (state, found), None
+
+    state0 = jnp.zeros((n,), jnp.int32)
+    found0 = jnp.broadcast_to(acc[0], (n,))
+    (state, found), _ = lax.scan(
+        step, (state0, found0), (jnp.arange(pad), col.data.T)
+    )
+    if rx.anchored_end:
+        # the match must end exactly at the string end: only the final
+        # state (after consuming all len bytes) counts
+        found = acc[state]
+    return Column(found, dt.BOOL8, col.validity)
+
+
+def matches_re(col: Column, pattern: str) -> Column:
+    """Anchored full-string match — cudf ``strings::matches_re`` (Java
+    ``String.matches``): equivalent to ``^pattern$``."""
+    _require_string(col)
+    body, _, _ = _strip_anchors(pattern)
+    return contains_re(col, "^" + body + "$")
+
+
+def rlike(col: Column, pattern: str) -> Column:
+    """Spark SQL ``rlike`` alias of :func:`contains_re`."""
+    return contains_re(col, pattern)
+
+
+def _span_table(col: Column, rx: CompiledRegex):
+    """best_end[i, s] = largest e with a match over bytes [s, e) of row i
+    (leftmost-longest span table), or -1. Carry is an (n, pad) state
+    matrix: every scan step advances ALL start offsets at once."""
+    cmap, tflat, acc, C = _dfa_tables(rx)
+    n, pad = col.data.shape
+    lens = col.lengths
+    starts = jnp.arange(pad)[None, :]
+
+    # empty-width matches: pattern accepts at the start offset itself
+    empty_ok = jnp.broadcast_to(acc[0], (n, pad)) & (starts <= lens[:, None])
+    if rx.anchored_end:
+        empty_ok = empty_ok & (starts == lens[:, None])
+    best0 = jnp.where(empty_ok, starts, -1).astype(jnp.int32)
+    if rx.anchored_start:
+        best0 = jnp.where(starts == 0, best0, -1)
+
+    def step(carry, x):
+        states, best = carry
+        j, byte_col = x
+        cls = cmap[byte_col]  # (n,)
+        nxt = tflat[states * C + cls[:, None]]
+        live = (starts <= j) & (j < lens[:, None])
+        states = jnp.where(live, nxt, states)
+        hit = live & acc[states]
+        if rx.anchored_end:
+            hit = hit & (j + 1 == lens[:, None])
+        best = jnp.where(hit, (j + 1).astype(jnp.int32), best)
+        return (states, best), None
+
+    states0 = jnp.zeros((n, pad), jnp.int32)
+    (_, best), _ = lax.scan(
+        step, (states0, best0), (jnp.arange(pad), col.data.T)
+    )
+    if rx.anchored_start:
+        best = jnp.where(starts == 0, best, -1)
+    return best
+
+
+def find_re(col: Column, pattern: str) -> Column:
+    """Byte offset of the leftmost match, -1 when absent (cudf
+    ``strings::find_re``)."""
+    _require_string(col)
+    rx = compile_re(pattern)
+    best = _span_table(col, rx)
+    has = jnp.any(best >= 0, axis=1)
+    pos = jnp.argmax(best >= 0, axis=1).astype(jnp.int32)
+    return Column(jnp.where(has, pos, -1), dt.INT32, col.validity)
+
+
+def extract_re(col: Column, pattern: str) -> Column:
+    """Contents of the single capture group at the leftmost-longest match
+    (cudf ``strings::extract``; Spark ``regexp_extract(s, p, 1)``). Rows
+    with no match are null (the cudf convention). The group must sit
+    between fixed-length prefix/suffix regexes — the shape of practical
+    extract patterns; open-ended context raises."""
+    _require_string(col)
+    rx = compile_re(pattern, with_group=True)
+    best = _span_table(col, rx)
+    n, pad = col.data.shape
+    has = jnp.any(best >= 0, axis=1)
+    s_star = jnp.argmax(best >= 0, axis=1).astype(jnp.int32)
+    e_star = jnp.take_along_axis(best, s_star[:, None], axis=1)[:, 0]
+    gs = s_star + rx.prefix_len
+    glen = jnp.maximum(e_star - rx.suffix_len - gs, 0)
+    glen = jnp.where(has, glen, 0).astype(jnp.int32)
+    out = _shift_left(col, gs.astype(jnp.int32), glen)
+    validity = has if col.validity is None else (col.validity & has)
+    return Column(out.data, dt.STRING, validity, out.lengths)
+
+
+def replace_re(col: Column, pattern: str, repl: str | bytes) -> Column:
+    """Replace every non-overlapping leftmost-longest match with the
+    literal ``repl`` (cudf ``strings::replace_re``; Spark
+    ``regexp_replace`` sans backreferences). Empty-width matches are
+    skipped. Eager (cudf call model): the output pad width comes from the
+    realized lengths, which costs one device sync."""
+    _require_string(col)
+    if isinstance(repl, str):
+        repl = repl.encode("utf-8", "surrogateescape")
+    m = len(repl)
+    rx = compile_re(pattern)
+    best = _span_table(col, rx)
+    n, pad = col.data.shape
+    lens = col.lengths
+
+    # greedy leftmost non-overlapping selection: walk starts ascending;
+    # in_match[t] falls out of the same carry (cursor > t ⟺ t inside a
+    # selected span)
+    def select(carry, x):
+        cursor = carry
+        s, ends_col = x
+        can = (s >= cursor) & (ends_col > s)
+        cursor = jnp.where(can, ends_col, cursor)
+        return cursor, (can, cursor > s)
+
+    _, (is_start_T, in_match_T) = lax.scan(
+        select,
+        jnp.zeros((n,), jnp.int32),
+        (jnp.arange(pad), best.T),
+    )
+    is_start = is_start_T.T  # (n, pad)
+    in_match = in_match_T.T
+    j = jnp.arange(pad)[None, :]
+    in_str = j < lens[:, None]
+    copied = in_str & ~in_match
+    starts_i32 = is_start.astype(jnp.int32)
+    copied_i32 = copied.astype(jnp.int32)
+    starts_before = jnp.cumsum(starts_i32, axis=1) - starts_i32
+    copied_before = jnp.cumsum(copied_i32, axis=1) - copied_i32
+    out_pos = copied_before + m * starts_before
+
+    n_matches = jnp.sum(starts_i32, axis=1)
+    dropped = jnp.sum((in_match & in_str).astype(jnp.int32), axis=1)
+    new_len = (lens - dropped + m * n_matches).astype(jnp.int32)
+
+    pad_out = max(int(np.asarray(jnp.max(new_len))), 1)  # eager sync
+    rows = jnp.arange(n)[:, None]
+    dump = pad_out  # out-of-range scatter target, sliced off below
+    out = jnp.zeros((n, pad_out + 1), jnp.uint8)
+    idx = jnp.where(copied, jnp.minimum(out_pos, dump), dump)
+    out = out.at[rows, idx].set(jnp.where(copied, col.data, 0))
+    for k in range(m):
+        idx_k = jnp.where(is_start, jnp.minimum(out_pos + k, dump), dump)
+        out = out.at[rows, idx_k].set(
+            jnp.where(is_start, jnp.uint8(repl[k]), 0)
+        )
+    data = out[:, :pad_out]
+    data = jnp.where(jnp.arange(pad_out)[None, :] < new_len[:, None], data, 0)
+    return Column(data.astype(jnp.uint8), dt.STRING, col.validity, new_len)
+
+
+def count_re(col: Column, pattern: str) -> Column:
+    """Number of non-overlapping matches per row (cudf
+    ``strings::count_re``). Empty-width matches are not counted."""
+    _require_string(col)
+    rx = compile_re(pattern)
+    best = _span_table(col, rx)
+    n, pad = col.data.shape
+
+    def select(cursor, x):
+        s, ends_col = x
+        can = (s >= cursor) & (ends_col > s)
+        cursor = jnp.where(can, ends_col, cursor)
+        return cursor, can
+
+    _, is_start_T = lax.scan(
+        select, jnp.zeros((n,), jnp.int32), (jnp.arange(pad), best.T)
+    )
+    counts = jnp.sum(is_start_T.astype(jnp.int32), axis=0)
+    return Column(counts, dt.INT32, col.validity)

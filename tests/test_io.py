@@ -431,3 +431,31 @@ class TestCsvScan:
         )
         kk = np.asarray(t["k"].data)
         assert rows == int((kk < 10).sum())
+
+    def test_scan_projection_and_pinned_dtypes(self, tmp_path):
+        import pyarrow as pa
+
+        from spark_rapids_jni_tpu.io import scan_csv
+
+        path = str(tmp_path / "drift.csv")
+        # column v looks integral for the whole first block and turns
+        # float near the end: type inference from block 1 alone would
+        # abort mid-stream without the dtypes pin
+        n = 40_000
+        with open(path, "w") as f:
+            f.write("k,v,unused\n")
+            for i in range(n):
+                v = "2.5" if i == n - 1 else str(i % 7)
+                f.write(f"{i % 100},{v},junk{i}\n")
+        batches = list(
+            scan_csv(path, columns=["v"], block_size=1 << 16,
+                     dtypes={"v": pa.float64()})
+        )
+        assert len(batches) > 1
+        for b in batches:
+            assert list(b.names) == ["v"]
+        total = sum(float(b["v"].to_numpy().sum()) for b in batches)
+        want = sum(
+            2.5 if i == n - 1 else float(i % 7) for i in range(n)
+        )
+        assert abs(total - want) < 1e-6

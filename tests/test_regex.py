@@ -1,0 +1,201 @@
+"""Regex ops vs the Python ``re`` oracle.
+
+The engine advertises leftmost-longest (POSIX) span semantics over a
+documented syntax subset; every test pattern here is one where Python's
+backtracking ``re`` agrees, so ``re`` serves as the oracle (the same role
+the cudf Java suite's host comparisons play, SURVEY.md §4)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.column import Column
+from spark_rapids_jni_tpu.ops import regex as rx
+
+
+WORDS = [
+    "", "a", "ab", "abc", "aabbb", "id=123", "id=", "x1y2z3",
+    "hello world", "2024-01-31", "not-a-date", "foo.txt", "foo_txt",
+    "  padded  ", "aaaa", "abab", "cabbage", "12.5", "-7", "+e",
+    "tail123", "123head", "a|b", "[x]", "line\nbreak", "CAPS", "MiXeD",
+]
+
+
+def _col(values):
+    return Column.from_strings(values)
+
+
+def _rand_strings(rng, n=200, alphabet="abc01 .-", max_len=12):
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(0, max_len))
+        out.append("".join(rng.choice(list(alphabet), k)))
+    return out
+
+
+CONTAINS_PATTERNS = [
+    r"abc",
+    r"a+b",
+    r"\d+",
+    r"[a-c]{2}",
+    r"a.c",
+    r"(ab)+",
+    r"a|0",
+    r"^a",
+    r"c$",
+    r"^[a-z0-9]*$",
+    r"\s",
+    r"\.",
+    r"[^a-z]",
+    r"b{2,3}",
+    r"-?\d+\.\d+",
+]
+
+
+@pytest.mark.parametrize("pattern", CONTAINS_PATTERNS)
+def test_contains_re_fixed_corpus(pattern):
+    col = _col(WORDS)
+    got = np.asarray(rx.contains_re(col, pattern).data)
+    want = [re.search(pattern, w) is not None for w in WORDS]
+    assert got.tolist() == want, pattern
+
+
+@pytest.mark.parametrize("pattern", CONTAINS_PATTERNS)
+def test_contains_re_random(rng, pattern):
+    words = _rand_strings(rng)
+    col = _col(words)
+    got = np.asarray(rx.contains_re(col, pattern).data)
+    want = [re.search(pattern, w) is not None for w in words]
+    assert got.tolist() == want, pattern
+
+
+def test_contains_re_jit():
+    import jax
+
+    col = _col(WORDS)
+    f = jax.jit(lambda c: rx.contains_re(c, r"\d+").data)
+    got = np.asarray(f(col))
+    want = [re.search(r"\d+", w) is not None for w in WORDS]
+    assert got.tolist() == want
+
+
+@pytest.mark.parametrize(
+    "pattern",
+    [r"[a-z]+", r"\d{4}-\d{2}-\d{2}", r"a*", r"a.*c", r"(?:ab|ba)+"],
+)
+def test_matches_re(pattern):
+    col = _col(WORDS)
+    got = np.asarray(rx.matches_re(col, pattern).data)
+    want = [re.fullmatch(pattern, w) is not None for w in WORDS]
+    assert got.tolist() == want, pattern
+
+
+@pytest.mark.parametrize(
+    "pattern", [r"\d+", r"[bc]+", r"ab", r"^a+", r"c$", r"a.c"]
+)
+def test_find_re(pattern):
+    col = _col(WORDS)
+    got = np.asarray(rx.find_re(col, pattern).data)
+    for w, g in zip(WORDS, got.tolist()):
+        m = re.search(pattern, w)
+        assert g == (m.start() if m else -1), (pattern, w)
+
+
+@pytest.mark.parametrize(
+    "pattern,group_re",
+    [
+        (r"id=(\d+)", r"id=(\d+)"),
+        (r"(\d+)", r"(\d+)"),
+        (r"^(\w+)\.txt$", r"^(\w+)\.txt$"),
+        (r"-(\d{2})-", r"-(\d{2})-"),
+    ],
+)
+def test_extract_re(pattern, group_re):
+    col = _col(WORDS)
+    out = rx.extract_re(col, pattern)
+    vals = out.to_pylist()
+    for w, got in zip(WORDS, vals):
+        m = re.search(group_re, w)
+        assert got == (m.group(1) if m else None), (pattern, w)
+
+
+def test_extract_re_rejects_variable_context():
+    col = _col(WORDS)
+    with pytest.raises(ValueError):
+        rx.extract_re(col, r"a*(\d+)")
+    with pytest.raises(ValueError):
+        rx.extract_re(col, r"(\d+)(\w+)")
+
+
+@pytest.mark.parametrize(
+    "pattern,repl",
+    [
+        (r"\d+", "#"),
+        (r"[aeiou]", ""),
+        (r"ab", "xyz"),
+        (r"\s+", "_"),
+        (r"a.c", "QQ"),
+    ],
+)
+def test_replace_re(pattern, repl):
+    col = _col(WORDS)
+    out = rx.replace_re(col, pattern, repl).to_pylist()
+    want = [re.sub(pattern, repl, w) for w in WORDS]
+    assert out == want, (pattern, repl)
+
+
+def test_replace_re_random(rng):
+    words = _rand_strings(rng, n=300)
+    col = _col(words)
+    out = rx.replace_re(col, r"[ab]+", "<>").to_pylist()
+    want = [re.sub(r"[ab]+", "<>", w) for w in words]
+    assert out == want
+
+
+@pytest.mark.parametrize("pattern", [r"\d+", r"a", r"[bc]{2}", r"ab"])
+def test_count_re(pattern):
+    col = _col(WORDS)
+    got = np.asarray(rx.count_re(col, pattern).data)
+    want = [len(re.findall(pattern, w)) for w in WORDS]
+    assert got.tolist() == want, pattern
+
+
+def test_null_propagation():
+    col = _col(["abc", None, "123"])
+    out = rx.contains_re(col, r"\d")
+    assert np.asarray(out.validity).tolist() == [True, False, True]
+    ext = rx.extract_re(col, r"(\d+)")
+    # null input stays null; no-match row becomes null (cudf convention)
+    assert ext.to_pylist() == [None, None, "123"]
+
+
+def test_anchors_and_empty():
+    col = _col(["", "a", "ba"])
+    assert np.asarray(rx.contains_re(col, r"^a").data).tolist() == [
+        False, True, False,
+    ]
+    assert np.asarray(rx.contains_re(col, r"a$").data).tolist() == [
+        False, True, True,
+    ]
+    # empty-matching pattern contains-matches everything
+    assert np.asarray(rx.contains_re(col, r"z*").data).tolist() == [
+        True, True, True,
+    ]
+    # but full-match only where the whole string fits
+    assert np.asarray(rx.matches_re(col, r"a*").data).tolist() == [
+        True, True, False,
+    ]
+
+
+def test_unsupported_syntax_raises():
+    col = _col(["x"])
+    for bad in [r"a(?=b)", r"(a", r"a{1,999}", r"a\k", r"mid^dle"]:
+        with pytest.raises(ValueError):
+            rx.contains_re(col, bad)
+
+
+def test_dfa_state_cap():
+    # exponential-subset pattern: (a|b)*a(a|b){n} needs ~2^n DFA states
+    with pytest.raises(ValueError):
+        rx.compile_re(r"(?:a|b)*a(?:a|b){12}")
