@@ -42,6 +42,7 @@ def __getattr__(name):
         "interop",
         "rows",
         "factories",
+        "struct",
     ):
         import importlib
 

@@ -444,20 +444,27 @@ class Table:
         return Table(cols, names)
 
     @staticmethod
-    def from_pydict(data: dict, dtypes: Optional[dict] = None) -> "Table":
-        """Host-side convenience constructor (numpy arrays or string lists)."""
+    def from_pydict(
+        data: dict,
+        dtypes: Optional[dict] = None,
+        pad_widths: Optional[dict] = None,
+    ) -> "Table":
+        """Host-side convenience constructor (numpy arrays or string
+        lists). ``pad_widths`` maps string column name -> pad width, like
+        the io readers."""
         cols, names = [], []
         for name, values in data.items():
             want = (dtypes or {}).get(name)
+            pad = (pad_widths or {}).get(name)
             if want is not None and want.is_string:
-                cols.append(Column.from_strings(values))
+                cols.append(Column.from_strings(values, pad_width=pad))
             elif (
                 isinstance(values, (list, tuple))
                 and values
                 and isinstance(values[0], (str, bytes, type(None)))
                 and any(isinstance(v, (str, bytes)) for v in values)
             ):
-                cols.append(Column.from_strings(values))
+                cols.append(Column.from_strings(values, pad_width=pad))
             else:
                 arr = np.asarray(values)
                 if arr.dtype == object:

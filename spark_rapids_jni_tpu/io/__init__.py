@@ -29,6 +29,8 @@ from .parquet import (  # noqa: F401
 from .orc import read_orc, scan_orc, write_orc  # noqa: F401
 from .csv import read_csv, scan_csv, write_csv  # noqa: F401
 from .ipc import read_arrow_ipc, write_arrow_ipc  # noqa: F401
+from .json import read_json, scan_json, write_json  # noqa: F401
+from .avro import read_avro, write_avro  # noqa: F401
 
 __all__ = [
     "Predicate",
@@ -47,4 +49,9 @@ __all__ = [
     "write_csv",
     "read_arrow_ipc",
     "write_arrow_ipc",
+    "read_json",
+    "scan_json",
+    "write_json",
+    "read_avro",
+    "write_avro",
 ]

@@ -1,0 +1,307 @@
+"""Avro read (host decode -> HBM upload).
+
+The reference's capability surface includes Avro ingest: cudf ships an
+Avro reader exposed through the Java API the artifact packages
+(``Table.readAvro``/``AvroOptions`` in the vendored cudf test tree;
+the reference's own test deps pull ``parquet-avro``,
+/root/reference/pom.xml:118-123). cudf's reader supports primitive
+types only — the same scope here.
+
+No Avro library exists in the pinned environment, so this is a minimal
+self-contained Object Container File codec: header/schema parse, zigzag
+varint decode, ``null`` and ``deflate`` codecs (zlib is in the stdlib).
+Record fields may be Avro primitives (boolean/int/long/float/double/
+string/bytes) or the nullable union ``["null", <primitive>]``; anything
+else raises. Decoded columns upload once, with the same projection +
+device-filter pushdown as the other readers. A matching writer rounds
+trips tables for tests and interop (cudf has no Avro writer; this one
+exists for the test tier, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import struct as _struct
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..column import Column, Table
+from ..utils.tracing import trace_range
+from . import predicates as preds
+
+_MAGIC = b"Obj\x01"
+
+_PRIMITIVES = {"boolean", "int", "long", "float", "double", "string", "bytes"}
+
+
+# ---------------------------------------------------------------------------
+# varint / zigzag primitives
+# ---------------------------------------------------------------------------
+
+
+def _read_long(buf: _io.BytesIO) -> int:
+    """Zigzag varint (Avro int/long share the encoding)."""
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        v = b[0]
+        acc |= (v & 0x7F) << shift
+        if not (v & 0x80):
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)
+
+
+def _write_long(out: bytearray, v: int) -> None:
+    z = (v << 1) ^ (v >> 63) if v < 0 else (v << 1)
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+
+
+def _read_bytes(buf: _io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated bytes")
+    return data
+
+
+# ---------------------------------------------------------------------------
+# schema handling
+# ---------------------------------------------------------------------------
+
+
+def _field_plan(field: dict) -> tuple[str, str, int]:
+    """(name, primitive type, null-branch index) for one record field
+    (-1 = not nullable); raises on unsupported shapes (the cudf Avro
+    reader's primitive-only scope). Unions may spell the null branch in
+    either position — the wire index follows the declaration order."""
+    name = field["name"]
+    t = field["type"]
+    null_branch = -1
+    if isinstance(t, list):
+        branches = [b for b in t if b != "null"]
+        if len(branches) != 1 or len(t) > 2:
+            raise TypeError(
+                f"avro field {name!r}: only two-branch null unions "
+                f"are supported, got {t}"
+            )
+        if "null" in t:
+            null_branch = t.index("null")
+        t = branches[0]
+    if isinstance(t, dict):
+        t = t.get("type", t)
+    if t not in _PRIMITIVES:
+        raise TypeError(
+            f"avro field {name!r}: unsupported type {t!r} (primitive "
+            "types only, matching the cudf Avro reader scope)"
+        )
+    return name, t, null_branch
+
+
+def _parse_schema(meta: dict) -> list[tuple[str, str, bool]]:
+    schema = json.loads(meta[b"avro.schema"].decode())
+    if isinstance(schema, dict) and schema.get("type") == "record":
+        return [_field_plan(f) for f in schema.get("fields", [])]
+    raise TypeError("avro: top-level schema must be a record")
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+
+def _read_header(f) -> tuple[dict, bytes, _io.BytesIO]:
+    if f.read(4) != _MAGIC:
+        raise ValueError("not an Avro object container file")
+    buf = _io.BytesIO(f.read())
+    meta = {}
+    while True:
+        count = _read_long(buf)
+        if count == 0:
+            break
+        if count < 0:  # block with a byte size prefix
+            _read_long(buf)
+            count = -count
+        for _ in range(count):
+            k = _read_bytes(buf)
+            meta[k] = _read_bytes(buf)
+    sync = buf.read(16)
+    return meta, sync, buf
+
+
+def _decode_value(buf: _io.BytesIO, typ: str):
+    if typ == "boolean":
+        return buf.read(1)[0] != 0
+    if typ in ("int", "long"):
+        return _read_long(buf)
+    if typ == "float":
+        return _struct.unpack("<f", buf.read(4))[0]
+    if typ == "double":
+        return _struct.unpack("<d", buf.read(8))[0]
+    # string / bytes
+    raw = _read_bytes(buf)
+    return raw.decode("utf-8", "surrogateescape") if typ == "string" else raw
+
+
+def read_avro(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    pad_widths: Optional[dict] = None,
+) -> Table:
+    """Avro container file -> device Table (projection + device filter)."""
+    from ..interop import table_from_arrow  # noqa: F401  (parity import)
+    from .parquet import _apply_exact_filter
+
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    with trace_range("io.avro.parse"), open(path, "rb") as f:
+        meta, sync, buf = _read_header(f)
+        plan = _parse_schema(meta)
+        codec = meta.get(b"avro.codec", b"null").decode()
+        if codec not in ("null", "deflate"):
+            raise ValueError(f"avro codec {codec!r} not supported")
+        values: dict[str, list] = {name: [] for name, _, _ in plan}
+        while True:
+            try:
+                nrecords = _read_long(buf)
+            except EOFError:
+                break
+            nbytes = _read_long(buf)
+            block = buf.read(nbytes)
+            if len(block) != nbytes:
+                raise EOFError("truncated avro block")
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            bbuf = _io.BytesIO(block)
+            for _ in range(nrecords):
+                for name, typ, null_branch in plan:
+                    if null_branch >= 0:
+                        branch = _read_long(bbuf)
+                        if branch == null_branch:
+                            values[name].append(None)
+                            continue
+                    values[name].append(_decode_value(bbuf, typ))
+            if buf.read(16) != sync:
+                raise ValueError("avro sync-marker mismatch")
+
+    dev = Table.from_pydict(values, pad_widths=pad_widths)
+    want, read_cols = preds.projection_columns(
+        predicate, columns, list(values.keys())
+    )
+    dev = dev.select(read_cols)
+    if predicate is not None:
+        with trace_range("io.avro.filter"):
+            dev = _apply_exact_filter(dev, predicate, want)
+    return dev
+
+
+# ---------------------------------------------------------------------------
+# writer (test/interop convenience; cudf ships no Avro writer)
+# ---------------------------------------------------------------------------
+
+_AVRO_TYPE = {
+    "int64": "long", "int32": "int", "int16": "int", "int8": "int",
+    "uint8": "int", "uint16": "int", "uint32": "long",
+    "float64": "double", "float32": "float", "bool": "boolean",
+}
+
+
+def write_avro(table: Table, path, codec: str = "null") -> None:
+    """Device Table -> Avro container file (primitive columns)."""
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"avro codec {codec!r} not supported")
+    names = (
+        list(table.names)
+        if table.names is not None
+        else [f"c{i}" for i in range(len(table.columns))]
+    )
+    plan = []
+    pylists = []
+    for name, col in zip(names, table.columns):
+        vals = col.to_pylist()
+        if col.dtype.is_string:
+            typ = "string"
+        else:
+            np_name = np.dtype(col.to_numpy().dtype).name
+            typ = _AVRO_TYPE.get(np_name)
+            if typ is None:
+                raise TypeError(
+                    f"avro writer: unsupported column dtype {col.dtype}"
+                )
+        nullable = any(v is None for v in vals)
+        plan.append((name, typ, nullable))
+        pylists.append(vals)
+
+    schema = {
+        "type": "record",
+        "name": "spark_rapids_tpu",
+        "fields": [
+            {"name": n, "type": (["null", t] if nullable else t)}
+            for n, t, nullable in plan
+        ],
+    }
+    body = bytearray()
+    n_rows = table.row_count
+    for i in range(n_rows):
+        for (name, typ, nullable), vals in zip(plan, pylists):
+            v = vals[i]
+            if nullable:
+                _write_long(body, 0 if v is None else 1)
+                if v is None:
+                    continue
+            if typ == "boolean":
+                body.append(1 if v else 0)
+            elif typ in ("int", "long"):
+                _write_long(body, int(v))
+            elif typ == "float":
+                body += _struct.pack("<f", float(v))
+            elif typ == "double":
+                body += _struct.pack("<d", float(v))
+            else:
+                raw = (
+                    v.encode("utf-8", "surrogateescape")
+                    if isinstance(v, str)
+                    else bytes(v)
+                )
+                _write_long(body, len(raw))
+                body += raw
+    payload = bytes(body)
+    if codec == "deflate":
+        comp = zlib.compressobj(wbits=-15)
+        payload = comp.compress(payload) + comp.flush()
+
+    sync = os.urandom(16)
+    out = bytearray(_MAGIC)
+    meta = {
+        b"avro.schema": json.dumps(schema).encode(),
+        b"avro.codec": codec.encode(),
+    }
+    _write_long(out, len(meta))
+    for k, v in meta.items():
+        _write_long(out, len(k))
+        out += k
+        _write_long(out, len(v))
+        out += v
+    _write_long(out, 0)
+    out += sync
+    if n_rows:
+        _write_long(out, n_rows)
+        _write_long(out, len(payload))
+        out += payload
+        out += sync
+    with open(path, "wb") as f:
+        f.write(bytes(out))

@@ -1,0 +1,204 @@
+"""Line-delimited JSON read/write (host parse -> HBM upload).
+
+Parity with the JSON surface of the cudf Java API the reference ships
+(``Table.readJSON`` / ``JSONOptions`` in the vendored cudf test tree,
+SURVEY.md §2.3 relational-ops row; cudf reads JSON-lines records).
+Parsing runs on host via Arrow's multithreaded JSON reader; typed
+columns then upload once, with the same two-level predicate pushdown and
+background-prefetch streaming as the Parquet/ORC/CSV scanners.
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Optional, Sequence
+
+from ..column import Table
+from ..utils.tracing import trace_range
+from . import predicates as preds
+
+try:
+    import pyarrow as pa
+    import pyarrow.json as pa_json
+except ImportError:  # pragma: no cover
+    pa = pa_json = None
+
+
+def _require():
+    if pa_json is None:  # pragma: no cover
+        raise ImportError("pyarrow.json not available")
+
+
+def read_json(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    dtypes: Optional[dict] = None,
+    pad_widths: Optional[dict] = None,
+) -> Table:
+    """JSON-lines file -> device Table (projection + device filter).
+
+    ``dtypes`` maps column name -> pyarrow type to pin parse types
+    (Arrow's ``explicit_schema``); unlisted columns stay inferred."""
+    _require()
+    from ..interop import table_from_arrow
+    from .parquet import _apply_exact_filter
+
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    parse_opts = None
+    if dtypes:
+        parse_opts = pa_json.ParseOptions(
+            explicit_schema=pa.schema(list(dtypes.items())),
+            unexpected_field_behavior="infer",
+        )
+    with trace_range("io.json.parse"):
+        atbl = pa_json.read_json(path, parse_options=parse_opts)
+    want, read_cols = preds.projection_columns(
+        predicate, columns, atbl.column_names
+    )
+    atbl = atbl.select(read_cols)
+    with trace_range("io.json.upload"):
+        dev = table_from_arrow(atbl, pad_widths=pad_widths)
+    if predicate is not None:
+        with trace_range("io.json.filter"):
+            dev = _apply_exact_filter(dev, predicate, want)
+    return dev
+
+
+def write_json(table: Table, path) -> None:
+    """Device Table -> JSON-lines file (the cudf writeJSON shape).
+
+    Non-finite floats (NaN/Inf) become JSON null — strict JSON has no
+    token for them and Arrow's reader (so our own read_json) rejects the
+    Python-extension spelling."""
+    import json as _json
+    import math
+
+    def _clean(v):
+        if isinstance(v, float) and not math.isfinite(v):
+            return None
+        return v
+
+    with trace_range("io.json.write"):
+        names = (
+            list(table.names)
+            if table.names is not None
+            else [f"c{i}" for i in range(len(table.columns))]
+        )
+        rows = zip(*(c.to_pylist() for c in table.columns))
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(
+                    _json.dumps(
+                        {n: _clean(v) for n, v in zip(names, row)},
+                        allow_nan=False,
+                    )
+                )
+                f.write("\n")
+
+
+def scan_json(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    filters=None,
+    block_rows: int = 1 << 16,
+    dtypes: Optional[dict] = None,
+    pad_widths: Optional[dict] = None,
+    prefetch: int = 0,
+):
+    """Stream a JSON-lines file as device Table batches of ~``block_rows``
+    records. Arrow's JSON reader has no incremental mode, so the scanner
+    chunks the file on line boundaries and parses each chunk
+    independently — types pinned via ``dtypes`` stay consistent across
+    chunks (pin any column whose early records underdetermine its type).
+    ``prefetch=N`` parses and uploads ahead on a background thread."""
+    _require()
+    from .parquet import _prefetch_iter
+
+    if prefetch > 0:
+        return _prefetch_iter(
+            scan_json(path, columns, filters, block_rows, dtypes,
+                      pad_widths, prefetch=0),
+            prefetch,
+        )
+    return _scan_json_serial(
+        path, columns, filters, block_rows, dtypes, pad_widths
+    )
+
+
+def _scan_json_serial(
+    path, columns, filters, block_rows, dtypes, pad_widths
+):
+    from ..interop import table_from_arrow
+    from .parquet import _apply_exact_filter
+
+    predicate = preds.from_dnf(filters) if filters is not None else None
+    parse_opts = None
+    if dtypes:
+        parse_opts = pa_json.ParseOptions(
+            explicit_schema=pa.schema(list(dtypes.items())),
+            unexpected_field_behavior="infer",
+        )
+    # with an explicit projection the read set is known before chunk 1
+    # (a projected column may be entirely absent from early chunks)
+    want = read_cols = None
+    if columns is not None:
+        want, read_cols = preds.projection_columns(
+            predicate, columns, columns
+        )
+    _seen_schema = None
+    with open(path, "rb") as f:
+        while True:
+            with trace_range("io.json.parse"):
+                lines = []
+                for _ in range(block_rows):
+                    line = f.readline()
+                    if not line:
+                        break
+                    if line.strip():
+                        lines.append(line)
+                if not lines:
+                    break
+                atbl = pa_json.read_json(
+                    _io.BytesIO(b"".join(lines)), parse_options=parse_opts
+                )
+            if want is None:
+                want, read_cols = preds.projection_columns(
+                    predicate, columns, atbl.column_names
+                )
+            # JSON key sets drift across chunks (sparse keys are normal);
+            # whole-file read_json null-fills, so the scanner must too.
+            # A column absent from this chunk needs a type for its null
+            # fill: dtypes-pinned ones use the pin, others the first
+            # chunk's schema (kept below); a column never seen at all
+            # raises with advice to pin it.
+            missing = [c for c in read_cols if c not in atbl.column_names]
+            if missing:
+                fills = []
+                for c in missing:
+                    typ = None
+                    if dtypes and c in dtypes:
+                        typ = dtypes[c]
+                    elif _seen_schema is not None and c in _seen_schema.names:
+                        typ = _seen_schema.field(c).type
+                    if typ is None:
+                        raise ValueError(
+                            f"scan_json: column {c!r} missing from a "
+                            "chunk and its type is unknown — pin it via "
+                            "dtypes="
+                        )
+                    fills.append(pa.nulls(len(atbl), type=typ))
+                atbl = pa.table(
+                    list(atbl.columns) + fills,
+                    names=list(atbl.column_names) + missing,
+                )
+            if _seen_schema is None:
+                _seen_schema = atbl.schema
+            with trace_range("io.json.upload"):
+                dev = table_from_arrow(
+                    atbl.select(read_cols), pad_widths=pad_widths
+                )
+            if predicate is not None:
+                with trace_range("io.json.filter"):
+                    dev = _apply_exact_filter(dev, predicate, want)
+            yield dev

@@ -19,7 +19,7 @@ from .cast import cast
 from .reductions import reduce as reduce_column
 from .filter import filter_table, filter_table_capped
 from .gather import gather_table, gather_column
-from .sort import sort_table, argsort_table, SortKey
+from .sort import sort_table, argsort_table, SortKey, is_sorted, merge_sorted
 from .hashing import murmur3_column, murmur3_table
 from .groupby import groupby_aggregate, GroupbyAgg
 from .join import (
@@ -59,7 +59,15 @@ from .window import (
     row_number,
 )
 from .quantiles import quantile
-from . import regex
+from . import lists, regex
+from .lists import (
+    count_elements,
+    explode,
+    explode_outer,
+    explode_position,
+    extract_list_element,
+    list_contains,
+)
 from .regex import (
     contains_re,
     matches_re,
@@ -96,6 +104,8 @@ __all__ = [
     "sort_table",
     "argsort_table",
     "SortKey",
+    "is_sorted",
+    "merge_sorted",
     "murmur3_column",
     "murmur3_table",
     "groupby_aggregate",
@@ -134,6 +144,13 @@ __all__ = [
     "lag",
     "row_number",
     "quantile",
+    "lists",
+    "count_elements",
+    "explode",
+    "explode_outer",
+    "explode_position",
+    "extract_list_element",
+    "list_contains",
     "regex",
     "contains_re",
     "matches_re",

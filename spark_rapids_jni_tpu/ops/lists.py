@@ -1,0 +1,168 @@
+"""LIST-column ops over the padded-matrix layout.
+
+The cudf surface the reference artifact ships includes the lists kernel
+family (``cudf::explode`` / ``explode_outer`` / ``explode_position``,
+``lists::count_elements`` / ``contains`` / ``extract_list_element`` —
+SURVEY.md §2.3 relational-ops row; Spark reaches them via ``explode``,
+``size``, ``array_contains``, ``element_at``). On the (n, pad) child
+matrix + lengths layout these are all gathers and masked comparisons;
+explode's data-dependent output size follows the repo's two-phase
+discipline: eager APIs host-sync the exact size (the cudf call model),
+mirroring filter/join.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column, Table
+
+
+def _require_list(col: Column):
+    if col.dtype.id != dt.TypeId.LIST:
+        raise TypeError("expected a LIST column")
+
+
+def count_elements(col: Column) -> Column:
+    """Per-row element count (cudf ``lists::count_elements``; Spark
+    ``size``). Null rows are null."""
+    _require_list(col)
+    return Column(col.lengths.astype(jnp.int32), dt.INT32, col.validity)
+
+
+def list_contains(col: Column, value) -> Column:
+    """True where the row's list contains ``value`` (cudf
+    ``lists::contains``; Spark ``array_contains``)."""
+    _require_list(col)
+    n, pad = col.data.shape
+    in_list = jnp.arange(pad)[None, :] < col.lengths[:, None]
+    hit = jnp.any((col.data == value) & in_list, axis=1)
+    return Column(hit, dt.BOOL8, col.validity)
+
+
+def extract_list_element(col: Column, index: int) -> Column:
+    """Element at ``index`` per row (cudf ``lists::extract_list_element``;
+    Spark ``element_at`` is this with 1-based index). Negative indexes
+    count from the end; out-of-range rows are null."""
+    _require_list(col)
+    n, pad = col.data.shape
+    idx = jnp.where(index < 0, col.lengths + index, index)
+    in_range = (idx >= 0) & (idx < col.lengths)
+    vals = jnp.take_along_axis(
+        col.data, jnp.clip(idx, 0, pad - 1)[:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    validity = (
+        in_range if col.validity is None else (col.validity & in_range)
+    )
+    return Column(vals, col.list_child_dtype, validity)
+
+
+def _explode_gather(col: Column, outer: bool):
+    """Host-synced parent/element index plan for explode (two-phase:
+    count, then gather — the filter/join eager discipline)."""
+    lens = np.asarray(col.lengths).astype(np.int64)
+    valid = (
+        np.ones(len(lens), dtype=bool)
+        if col.validity is None
+        else np.asarray(col.validity)
+    )
+    lens = np.where(valid, lens, 0)
+    if outer:
+        # empty/null lists contribute ONE null output row
+        slots = np.maximum(lens, 1)
+    else:
+        slots = lens
+    total = int(slots.sum())
+    offsets = np.concatenate([[0], np.cumsum(slots)])
+    out_idx = np.arange(total)
+    parent = np.searchsorted(offsets, out_idx, side="right") - 1
+    element = out_idx - offsets[parent]
+    # element is in-range except the placeholder row of an empty/null
+    # parent under outer semantics
+    elem_valid = element < lens[parent]
+    return parent.astype(np.int32), element.astype(np.int32), elem_valid
+
+
+def _explode_table(
+    table: Table, column: Union[int, str], outer: bool, position: bool
+) -> Table:
+    from .join import _resolve_col
+
+    ci = _resolve_col(table, column)
+    lcol = table.columns[ci]
+    _require_list(lcol)
+    parent, element, elem_valid = _explode_gather(lcol, outer)
+    parent_j = jnp.asarray(parent)
+    element_j = jnp.asarray(element)
+    elem_valid_j = jnp.asarray(elem_valid)
+
+    n, pad = lcol.data.shape
+    vals = lcol.data[parent_j, jnp.clip(element_j, 0, pad - 1)]
+    vals = jnp.where(elem_valid_j, vals, 0)
+    child = Column(
+        vals,
+        lcol.list_child_dtype,
+        None if bool(elem_valid.all()) else elem_valid_j,
+    )
+
+    out_cols, out_names = [], []
+    names = table.names
+    for i, c in enumerate(table.columns):
+        name = names[i] if names is not None else f"c{i}"
+        if i == ci:
+            if position:
+                pos_validity = (
+                    None if bool(elem_valid.all()) else elem_valid_j
+                )
+                out_cols.append(
+                    Column(
+                        jnp.where(elem_valid_j, element_j, 0).astype(
+                            jnp.int32
+                        ),
+                        dt.INT32,
+                        pos_validity,
+                    )
+                )
+                out_names.append("pos")
+            out_cols.append(child)
+            out_names.append(name)
+        else:
+            data = (
+                c.data[parent_j]
+                if c.data.ndim == 1
+                else c.data[parent_j, :]
+            )
+            validity = (
+                c.validity[parent_j] if c.validity is not None else None
+            )
+            lengths = (
+                c.lengths[parent_j] if c.lengths is not None else None
+            )
+            out_cols.append(Column(data, c.dtype, validity, lengths))
+            out_names.append(name)
+    return Table(out_cols, out_names if names is not None else None)
+
+
+def explode(table: Table, column: Union[int, str]) -> Table:
+    """Replicate each row once per list element, replacing the LIST
+    column with its elements (cudf ``explode``; Spark ``explode`` drops
+    empty and null lists)."""
+    return _explode_table(table, column, outer=False, position=False)
+
+
+def explode_outer(table: Table, column: Union[int, str]) -> Table:
+    """Like :func:`explode`, but empty/null lists keep one output row
+    with a null element (cudf ``explode_outer``)."""
+    return _explode_table(table, column, outer=True, position=False)
+
+
+def explode_position(
+    table: Table, column: Union[int, str], outer: bool = False
+) -> Table:
+    """Explode with a leading ``pos`` INT32 column of element indexes
+    (cudf ``explode_position``; Spark ``posexplode``)."""
+    return _explode_table(table, column, outer=outer, position=True)

@@ -79,3 +79,48 @@ def sort_table(
     """ORDER BY: returns the table (or ``payload``) reordered."""
     perm = argsort_table(table, sort_keys)
     return gather_table(payload if payload is not None else table, perm)
+
+
+def is_sorted(
+    table: Table, sort_keys: Sequence[Union[SortKey, str, int]]
+) -> jax.Array:
+    """Device bool: rows already ordered by ``sort_keys`` (cudf
+    ``is_sorted``). Nulls follow each key's resolved placement."""
+    sort_keys = [
+        k if isinstance(k, SortKey) else SortKey(k) for k in sort_keys
+    ]
+    words: list[jax.Array] = []
+    for k in sort_keys:
+        words.extend(_key_words(table.column(k.column), k))
+    n = words[0].shape[0]
+    if n <= 1:
+        return jnp.asarray(True)
+    # adjacent-pair lexicographic compare: prev <= next
+    eq = jnp.ones((n - 1,), dtype=jnp.bool_)
+    ok = jnp.zeros((n - 1,), dtype=jnp.bool_)
+    for w in words:
+        a, b = w[:-1], w[1:]
+        ok = ok | (eq & (a < b))
+        eq = eq & (a == b)
+    return jnp.all(ok | eq)
+
+
+def merge_sorted(
+    tables: Sequence[Table],
+    sort_keys: Sequence[Union[SortKey, str, int]],
+) -> Table:
+    """K-way merge of individually sorted tables into one sorted table
+    (cudf ``cudf::merge`` / Java ``Table.merge``).
+
+    TPU-first design note: a streaming k-way merge is data-dependent
+    control flow per output row — hostile to XLA. Concatenate + one
+    stable lexsort over normalized u64 key words runs entirely on the
+    MXU-adjacent sort network at HBM bandwidth and is how the op lowers
+    here; stability preserves the order of equal keys across inputs in
+    table order (matching cudf's stable merge)."""
+    from .copying import concatenate
+
+    if not tables:
+        raise ValueError("merge_sorted: need at least one table")
+    whole = concatenate(tables)
+    return sort_table(whole, sort_keys)

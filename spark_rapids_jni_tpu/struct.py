@@ -1,0 +1,284 @@
+"""STRUCT columns: typed child columns + top-level validity.
+
+The cudf surface the reference artifact ships includes STRUCT columns
+(``cudf::make_structs_column``, struct gather/sort/filter — SURVEY.md
+§2.3 columnar-type-system row; Spark reaches them for nested schemas and
+``struct(...)`` expressions). cudf lays a struct out as parallel child
+columns plus a struct-level null mask — exactly Arrow's layout — and the
+TPU design keeps that: a ``StructColumn`` owns one device ``Column`` per
+field and an optional validity vector. There is no single flat device
+buffer (children have heterogeneous dtypes), so a struct is a pytree of
+its children and composes with jit/shard_map like a small Table.
+
+MVP scope (documented): flat structs over fixed-width/string/decimal
+children; struct-of-struct nesting is not supported yet. Struct columns
+live standalone or packed/unpacked from Table columns via
+``pack``/``unpack``; ordering follows cudf struct semantics —
+lexicographic over fields in declaration order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dt
+from .column import Column, Table
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(eq=False)
+class StructColumn:
+    """One STRUCT column: parallel children + struct-level validity.
+
+    A null struct row is null at THIS level; children keep whatever
+    validity they carry (cudf semantics: child nulls under a valid
+    struct are visible, children under a null struct are undefined)."""
+
+    children: tuple
+    names: tuple
+    validity: Optional[jax.Array] = None
+
+    def tree_flatten(self):
+        return (tuple(self.children), self.validity), tuple(self.names)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        children, validity = leaves
+        return cls(children=children, names=aux, validity=validity)
+
+    # -- construction -----------------------------------------------------
+
+    @staticmethod
+    def from_children(
+        children: Sequence[Column],
+        names: Optional[Sequence[str]] = None,
+        validity=None,
+    ) -> "StructColumn":
+        """cudf ``make_structs_column``: zip existing columns into a
+        struct."""
+        children = tuple(children)
+        if not children:
+            raise ValueError("struct needs at least one field")
+        n = children[0].data.shape[0]
+        for c in children:
+            if c.data.shape[0] != n:
+                raise ValueError("struct children must share row count")
+        names = tuple(
+            names if names is not None
+            else (f"f{i}" for i in range(len(children)))
+        )
+        if len(names) != len(children):
+            raise ValueError("one name per child")
+        if validity is not None and not isinstance(validity, jax.Array):
+            validity = jnp.asarray(np.asarray(validity, dtype=np.bool_))
+        return StructColumn(children, names, validity)
+
+    @staticmethod
+    def from_pylist(
+        rows: Sequence[Optional[dict]],
+        dtypes: Optional[dict] = None,
+    ) -> "StructColumn":
+        """Build from a list of dicts (None = null struct row). Field
+        set is taken from the first non-null row; missing keys in later
+        rows become child nulls."""
+        first = next((r for r in rows if r is not None), None)
+        if first is None:
+            raise ValueError("all-null struct needs explicit dtypes/fields")
+        names = list(first.keys())
+        valid = np.array([r is not None for r in rows], dtype=np.bool_)
+        cols = []
+        for name in names:
+            vals = [None if r is None else r.get(name) for r in rows]
+            want = (dtypes or {}).get(name)
+            if want is not None and want.id != dt.TypeId.STRING:
+                arr = np.array(
+                    [0 if v is None else v for v in vals],
+                    dtype=np.dtype(want.storage_dtype)
+                    if want.id != dt.TypeId.FLOAT64
+                    else np.float64,
+                )
+                v_mask = np.array([v is not None for v in vals], np.bool_)
+                cols.append(
+                    Column.from_numpy(
+                        arr,
+                        validity=None if v_mask.all() else v_mask,
+                        dtype=want,
+                    )
+                )
+            elif isinstance(first.get(name), str) or (
+                want is not None and want.id == dt.TypeId.STRING
+            ):
+                cols.append(Column.from_strings(vals))
+            else:
+                tbl = Table.from_pydict({name: vals})
+                cols.append(tbl.columns[0])
+        return StructColumn.from_children(
+            cols, names, None if valid.all() else valid
+        )
+
+    # -- basic accessors --------------------------------------------------
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.DType(dt.TypeId.STRUCT)
+
+    @property
+    def row_count(self) -> int:
+        return int(self.children[0].data.shape[0])
+
+    @property
+    def num_fields(self) -> int:
+        return len(self.children)
+
+    def field(self, key: Union[int, str]) -> Column:
+        """Child extraction (cudf struct ``get_child`` / Spark
+        ``struct.field``): child nulls OR struct-level nulls."""
+        i = self.names.index(key) if isinstance(key, str) else key
+        c = self.children[i]
+        if self.validity is None:
+            return c
+        v = (
+            self.validity
+            if c.validity is None
+            else jnp.logical_and(c.validity, self.validity)
+        )
+        return Column(c.data, c.dtype, v, c.lengths)
+
+    def null_count(self) -> int:
+        if self.validity is None:
+            return 0
+        return int(jnp.sum(jnp.logical_not(self.validity)))
+
+    def to_pylist(self) -> list:
+        fields = [c.to_pylist() for c in self.children]
+        valid = (
+            [True] * self.row_count
+            if self.validity is None
+            else np.asarray(self.validity).tolist()
+        )
+        return [
+            dict(zip(self.names, vals)) if ok else None
+            for ok, *vals in zip(valid, *fields)
+        ]
+
+    # -- row selection ----------------------------------------------------
+
+    def gather(self, indices, index_valid=None) -> "StructColumn":
+        from .ops.gather import gather_column
+
+        children = tuple(
+            gather_column(c, indices, None) for c in self.children
+        )
+        valid = None
+        if self.validity is not None:
+            valid = jnp.take(self.validity, indices, mode="clip")
+        if index_valid is not None:
+            valid = (
+                index_valid
+                if valid is None
+                else jnp.logical_and(valid, index_valid)
+            )
+        return StructColumn(children, self.names, valid)
+
+    def filter(self, mask: Column) -> "StructColumn":
+        """Eager row filter by a BOOL8 mask column (host-syncs the
+        count, like filter_table)."""
+        from .ops import compute
+
+        keep = jnp.logical_and(mask.data, compute.valid_mask(mask))
+        total = int(jnp.sum(keep))
+        idx = jnp.nonzero(keep, size=total)[0].astype(jnp.int32)
+        return self.gather(idx)
+
+    # -- ordering ---------------------------------------------------------
+
+    def order_keys(self) -> list:
+        """u64 order-key words: struct-level null word (nulls first),
+        then each field's words with field-null words interleaved —
+        cudf's lexicographic struct comparator, flattened for lexsort."""
+        from .ops import keys as keys_mod
+
+        n = self.row_count
+        words: list[jax.Array] = []
+        if self.validity is not None:
+            words.append(
+                jnp.where(self.validity, jnp.uint64(1), jnp.uint64(0))
+            )
+        for c in self.children:
+            if c.validity is not None:
+                words.append(
+                    jnp.where(c.validity, jnp.uint64(1), jnp.uint64(0))
+                )
+            words.extend(keys_mod.column_order_keys(c))
+        return words
+
+    def argsort(self, ascending: bool = True) -> jax.Array:
+        """Stable permutation ordering rows by lexicographic field
+        comparison (struct-level nulls first when ascending)."""
+        words = self.order_keys()
+        if not ascending:
+            words = [~w for w in words]
+        return jnp.lexsort(words[::-1])
+
+
+def pack(table: Table, columns: Sequence[Union[int, str]],
+         name: str = "s") -> StructColumn:
+    """Zip table columns into a StructColumn (Spark ``struct(cols...)``)."""
+    cols = [table.column(c) for c in columns]
+    names = [
+        c if isinstance(c, str) else (
+            table.names[c] if table.names else f"f{c}"
+        )
+        for c in columns
+    ]
+    return StructColumn.from_children(cols, names)
+
+
+def unpack(struct: StructColumn) -> Table:
+    """Flatten a StructColumn into a Table of its fields (struct-level
+    validity folded into every child)."""
+    return Table(
+        [struct.field(i) for i in range(struct.num_fields)],
+        list(struct.names),
+    )
+
+
+def struct_from_arrow(arr) -> StructColumn:
+    """Arrow StructArray -> device StructColumn (flat structs)."""
+    import pyarrow as pa
+
+    from .interop import column_from_arrow
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if not pa.types.is_struct(arr.type):
+        raise TypeError(f"expected a struct array, got {arr.type}")
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    children = []
+    names = []
+    for i, f in enumerate(arr.type):
+        names.append(f.name)
+        children.append(column_from_arrow(arr.field(i)))
+    return StructColumn.from_children(children, names, validity)
+
+
+def struct_to_arrow(sc: StructColumn):
+    """Device StructColumn -> Arrow StructArray."""
+    import pyarrow as pa
+
+    from .interop import column_to_arrow
+
+    fields = [column_to_arrow(c) for c in sc.children]
+    mask = None
+    if sc.validity is not None:
+        mask = pa.array(~np.asarray(sc.validity), type=pa.bool_())
+    return pa.StructArray.from_arrays(
+        fields, names=list(sc.names), mask=mask
+    )

@@ -459,3 +459,224 @@ class TestCsvScan:
             2.5 if i == n - 1 else float(i % 7) for i in range(n)
         )
         assert abs(total - want) < 1e-6
+
+
+class TestJson:
+    def test_round_trip_with_nulls(self, tmp_path, rng):
+        from spark_rapids_jni_tpu.io import read_json, write_json
+
+        path = str(tmp_path / "t.jsonl")
+        t = Table.from_pydict({
+            "k": [1, 2, None, 4],
+            "s": ["a", None, "cc", "d"],
+            "f": [1.5, 2.0, 3.25, None],
+        })
+        write_json(t, path)
+        back = read_json(path)
+        assert back["k"].to_pylist() == [1, 2, None, 4]
+        assert back["s"].to_pylist() == ["a", None, "cc", "d"]
+        assert back["f"].to_pylist() == [1.5, 2.0, 3.25, None]
+
+    def test_projection_and_filter(self, tmp_path, rng):
+        from spark_rapids_jni_tpu.io import read_json, write_json
+
+        path = str(tmp_path / "f.jsonl")
+        n = 5_000
+        k = rng.integers(0, 100, n)
+        v = rng.integers(-10, 10, n)
+        write_json(Table.from_pydict({"k": k, "v": v}), path)
+        out = read_json(path, columns=["v"], filters=col("k") < 10)
+        assert list(out.names) == ["v"]
+        np.testing.assert_array_equal(
+            np.sort(out["v"].to_numpy()), np.sort(v[k < 10])
+        )
+
+    def test_scan_batches_and_prefetch(self, tmp_path, rng):
+        from spark_rapids_jni_tpu.io import read_json, scan_json, write_json
+
+        path = str(tmp_path / "s.jsonl")
+        n = 30_000
+        k = rng.integers(0, 100, n)
+        write_json(Table.from_pydict({"k": k}), path)
+        batches = list(scan_json(path, block_rows=1 << 13))
+        assert len(batches) > 1
+        got = np.concatenate([b["k"].to_numpy() for b in batches])
+        np.testing.assert_array_equal(got, k)
+        pre = list(scan_json(path, block_rows=1 << 13, prefetch=2))
+        got_pre = np.concatenate([b["k"].to_numpy() for b in pre])
+        np.testing.assert_array_equal(got_pre, k)
+
+    def test_scan_pinned_dtypes_across_chunks(self, tmp_path):
+        import pyarrow as pa
+
+        from spark_rapids_jni_tpu.io import scan_json
+
+        path = str(tmp_path / "drift.jsonl")
+        n = 20_000
+        with open(path, "w") as f:
+            for i in range(n):
+                v = 2.5 if i == n - 1 else i % 3
+                f.write('{"v": %s}\n' % v)
+        batches = list(
+            scan_json(path, block_rows=1 << 12,
+                      dtypes={"v": pa.float64()})
+        )
+        assert len(batches) > 1
+        total = sum(float(b["v"].to_numpy().sum()) for b in batches)
+        want = sum(2.5 if i == n - 1 else i % 3 for i in range(n))
+        assert abs(total - want) < 1e-6
+
+
+class TestAvro:
+    def test_round_trip_null_codec(self, tmp_path, rng):
+        from spark_rapids_jni_tpu.io import read_avro, write_avro
+
+        path = str(tmp_path / "t.avro")
+        t = Table.from_pydict({
+            "i": [1, None, 3, -(2**40)],
+            "f": [1.5, 2.25, None, -0.5],
+            "b": [True, False, True, None],
+            "s": ["x", None, "yz", ""],
+        })
+        write_avro(t, path)
+        back = read_avro(path)
+        assert back["i"].to_pylist() == [1, None, 3, -(2**40)]
+        assert back["f"].to_pylist() == [1.5, 2.25, None, -0.5]
+        assert back["b"].to_pylist() == [True, False, True, None]
+        assert back["s"].to_pylist() == ["x", None, "yz", ""]
+
+    def test_round_trip_deflate(self, tmp_path, rng):
+        from spark_rapids_jni_tpu.io import read_avro, write_avro
+
+        path = str(tmp_path / "d.avro")
+        n = 5_000
+        k = rng.integers(-(2**30), 2**30, n)
+        t = Table.from_pydict({"k": k})
+        write_avro(t, path, codec="deflate")
+        back = read_avro(path)
+        np.testing.assert_array_equal(back["k"].to_numpy(), k)
+
+    def test_projection_and_filter(self, tmp_path, rng):
+        from spark_rapids_jni_tpu.io import read_avro, write_avro
+
+        path = str(tmp_path / "p.avro")
+        n = 2_000
+        k = rng.integers(0, 100, n)
+        v = rng.integers(-5, 5, n)
+        write_avro(Table.from_pydict({"k": k, "v": v}), path)
+        out = read_avro(path, columns=["v"], filters=col("k") < 10)
+        assert list(out.names) == ["v"]
+        np.testing.assert_array_equal(
+            np.sort(out["v"].to_numpy()), np.sort(v[k < 10])
+        )
+
+    def test_unsupported_schema_raises(self, tmp_path):
+        from spark_rapids_jni_tpu.io.avro import (
+            _MAGIC, _write_long, read_avro,
+        )
+        import json as _json
+
+        path = str(tmp_path / "bad.avro")
+        schema = {"type": "record", "name": "r",
+                  "fields": [{"name": "m",
+                              "type": {"type": "map", "values": "long"}}]}
+        out = bytearray(_MAGIC)
+        meta = {b"avro.schema": _json.dumps(schema).encode()}
+        _write_long(out, len(meta))
+        for kk, vv in meta.items():
+            _write_long(out, len(kk)); out += kk
+            _write_long(out, len(vv)); out += vv
+        _write_long(out, 0)
+        out += b"\x00" * 16
+        with open(path, "wb") as f:
+            f.write(bytes(out))
+        with pytest.raises(TypeError):
+            read_avro(path)
+
+    def test_not_avro_raises(self, tmp_path):
+        from spark_rapids_jni_tpu.io import read_avro
+
+        path = str(tmp_path / "x.avro")
+        with open(path, "wb") as f:
+            f.write(b"PAR1 not avro")
+        with pytest.raises(ValueError):
+            read_avro(path)
+
+
+class TestReviewRegressions:
+    def test_avro_reversed_union_order(self, tmp_path):
+        """[\"long\", \"null\"] unions are spec-legal: the null branch
+        index follows declaration order, not always 0."""
+        import json as _json
+
+        from spark_rapids_jni_tpu.io import read_avro
+        from spark_rapids_jni_tpu.io.avro import _MAGIC, _write_long
+
+        schema = {"type": "record", "name": "r",
+                  "fields": [{"name": "k", "type": ["long", "null"]}]}
+        body = bytearray()
+        # rows: 7, null, -3  (branch 0 = long value, branch 1 = null)
+        _write_long(body, 0); _write_long(body, 7)
+        _write_long(body, 1)
+        _write_long(body, 0); _write_long(body, -3)
+        sync = b"\x01" * 16
+        out = bytearray(_MAGIC)
+        meta = {b"avro.schema": _json.dumps(schema).encode()}
+        _write_long(out, len(meta))
+        for kk, vv in meta.items():
+            _write_long(out, len(kk)); out += kk
+            _write_long(out, len(vv)); out += vv
+        _write_long(out, 0)
+        out += sync
+        _write_long(out, 3)
+        _write_long(out, len(body))
+        out += bytes(body)
+        out += sync
+        path = str(tmp_path / "rev.avro")
+        with open(path, "wb") as f:
+            f.write(bytes(out))
+        back = read_avro(path)
+        assert back["k"].to_pylist() == [7, None, -3]
+
+    def test_json_nan_round_trip(self, tmp_path):
+        from spark_rapids_jni_tpu.io import read_json, write_json
+
+        path = str(tmp_path / "nan.jsonl")
+        t = Table.from_pydict({"f": [1.0, float("nan"), float("inf")]})
+        write_json(t, path)  # must not emit invalid JSON
+        back = read_json(path)
+        assert back["f"].to_pylist() == [1.0, None, None]
+
+    def test_scan_json_sparse_keys(self, tmp_path):
+        from spark_rapids_jni_tpu.io import scan_json
+
+        path = str(tmp_path / "sparse.jsonl")
+        n = 9_000
+        with open(path, "w") as f:
+            for i in range(n):
+                if i < 5_000:
+                    f.write('{"k": %d}\n' % i)
+                else:
+                    f.write('{"k": %d, "x": %d}\n' % (i, i * 2))
+        # "x" is absent from the whole first chunk: with a dtypes pin the
+        # scan null-fills it chunk-locally like read_json does file-wide
+        batches = list(
+            scan_json(path, columns=["x"], block_rows=1 << 12,
+                      dtypes={"x": pa.int64()})
+        )
+        vals = [v for b in batches for v in b["x"].to_pylist()]
+        want = [None if i < 5_000 else i * 2 for i in range(n)]
+        assert vals == want
+        # without a pin and never seen: clear error
+        path2 = str(tmp_path / "never.jsonl")
+        with open(path2, "w") as f:
+            for i in range(100):
+                f.write('{"k": %d}\n' % i)
+        with pytest.raises(ValueError):
+            list(scan_json(path2, columns=["zzz"], block_rows=50))
+
+    def test_from_pydict_pad_widths(self):
+        t = Table.from_pydict(
+            {"s": ["ab", "c"]}, pad_widths={"s": 32}
+        )
+        assert t["s"].data.shape[1] == 32

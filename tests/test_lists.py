@@ -1,0 +1,112 @@
+"""LIST op tests vs host oracles (explode family + element ops)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops import lists as L
+
+
+def _table():
+    lc = Column.from_list_of_lists(
+        [[1, 2, 3], [], [7], None, [9, 10]], child_dtype=dt.INT64
+    )
+    k = Column.from_numpy(np.array([10, 20, 30, 40, 50], dtype=np.int64))
+    s = Column.from_strings(["a", "bb", None, "dd", "e"])
+    return Table([k, lc, s], ["k", "v", "s"])
+
+
+def test_count_elements():
+    t = _table()
+    out = L.count_elements(t["v"])
+    assert out.to_pylist() == [3, 0, 1, None, 2]
+
+
+def test_list_contains():
+    t = _table()
+    out = L.list_contains(t["v"], 7)
+    assert out.to_pylist() == [False, False, True, None, False]
+    # zero padding must not produce false hits
+    out0 = L.list_contains(t["v"], 0)
+    assert out0.to_pylist() == [False, False, False, None, False]
+
+
+def test_extract_list_element():
+    t = _table()
+    assert L.extract_list_element(t["v"], 0).to_pylist() == [
+        1, None, 7, None, 9,
+    ]
+    assert L.extract_list_element(t["v"], -1).to_pylist() == [
+        3, None, 7, None, 10,
+    ]
+    assert L.extract_list_element(t["v"], 2).to_pylist() == [
+        3, None, None, None, None,
+    ]
+
+
+def test_explode():
+    t = _table()
+    out = L.explode(t, "v")
+    assert list(out.names) == ["k", "v", "s"]
+    assert out["k"].to_pylist() == [10, 10, 10, 30, 50, 50]
+    assert out["v"].to_pylist() == [1, 2, 3, 7, 9, 10]
+    assert out["v"].dtype == dt.INT64
+    # sibling string column gathers through, including its null
+    assert out["s"].to_pylist() == ["a", "a", "a", None, "e", "e"]
+
+
+def test_explode_outer():
+    t = _table()
+    out = L.explode_outer(t, "v")
+    assert out["k"].to_pylist() == [10, 10, 10, 20, 30, 40, 50, 50]
+    assert out["v"].to_pylist() == [1, 2, 3, None, 7, None, 9, 10]
+
+
+def test_explode_position():
+    t = _table()
+    out = L.explode_position(t, "v")
+    assert list(out.names) == ["k", "pos", "v", "s"]
+    assert out["pos"].to_pylist() == [0, 1, 2, 0, 0, 1]
+    out2 = L.explode_position(t, "v", outer=True)
+    assert out2["pos"].to_pylist() == [0, 1, 2, None, 0, None, 0, 1]
+
+
+def test_explode_empty_result():
+    lc = Column.from_list_of_lists([[], None], child_dtype=dt.INT32)
+    t = Table([lc], ["v"])
+    out = L.explode(t, "v")
+    assert out.row_count == 0
+
+
+def test_explode_random_oracle(rng):
+    n = 500
+    pylists = []
+    for i in range(n):
+        if rng.random() < 0.1:
+            pylists.append(None)
+        else:
+            k = int(rng.integers(0, 6))
+            pylists.append(rng.integers(-100, 100, k).tolist())
+    keys = rng.integers(0, 1000, n)
+    t = Table(
+        [
+            Column.from_numpy(keys),
+            Column.from_list_of_lists(pylists, child_dtype=dt.INT64),
+        ],
+        ["k", "v"],
+    )
+    out = L.explode(t, "v")
+    want_k, want_v = [], []
+    for key, lst in zip(keys.tolist(), pylists):
+        for x in lst or []:
+            want_k.append(key)
+            want_v.append(x)
+    assert out["k"].to_pylist() == want_k
+    assert out["v"].to_pylist() == want_v
+
+
+def test_non_list_raises():
+    t = _table()
+    with pytest.raises(TypeError):
+        L.explode(t, "k")

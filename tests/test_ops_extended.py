@@ -345,3 +345,59 @@ class TestCompaction:
         t = Table.from_pydict({"a": a, "b": b})
         want = len({(x, y) for x, y in zip(a, b)})
         assert int(ops.distinct_count(t)) == want
+
+
+class TestMergeSorted:
+    def test_merge_matches_oracle(self, rng):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column, Table
+        from spark_rapids_jni_tpu.ops import is_sorted, merge_sorted, SortKey
+
+        parts = []
+        host = []
+        for _ in range(3):
+            k = np.sort(rng.integers(0, 1000, 500))
+            v = rng.integers(-10, 10, 500)
+            host.append((k, v))
+            parts.append(Table(
+                [Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"]
+            ))
+        out = merge_sorted(parts, [SortKey("k")])
+        allk = np.concatenate([h[0] for h in host])
+        np.testing.assert_array_equal(
+            out["k"].to_numpy(), np.sort(allk, kind="stable")
+        )
+        assert bool(is_sorted(out, [SortKey("k")]))
+        # stability: equal keys keep input-table order
+        a = Table([Column.from_numpy(np.array([5, 5], dtype=np.int64)),
+                   Column.from_numpy(np.array([0, 1], dtype=np.int64))],
+                  ["k", "tag"])
+        b = Table([Column.from_numpy(np.array([5], dtype=np.int64)),
+                   Column.from_numpy(np.array([2], dtype=np.int64))],
+                  ["k", "tag"])
+        m = merge_sorted([a, b], [SortKey("k")])
+        assert m["tag"].to_pylist() == [0, 1, 2]
+
+    def test_is_sorted(self, rng):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column, Table
+        from spark_rapids_jni_tpu.ops import is_sorted, SortKey
+
+        k = np.array([3, 1, 2], dtype=np.int64)
+        t = Table([Column.from_numpy(k)], ["k"])
+        assert not bool(is_sorted(t, [SortKey("k")]))
+        assert bool(is_sorted(t, [SortKey("k")])) is False
+        ts = Table([Column.from_numpy(np.sort(k))], ["k"])
+        assert bool(is_sorted(ts, [SortKey("k")]))
+        # descending + nulls-first placement
+        kd = Column.from_numpy(
+            np.array([9, 7, 7, 1], dtype=np.int64),
+            validity=np.array([False, True, True, True]),
+        )
+        td = Table([kd], ["k"])
+        assert bool(
+            is_sorted(td, [SortKey("k", ascending=False,
+                                    nulls_first=True)])
+        )

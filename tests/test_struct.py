@@ -1,0 +1,122 @@
+"""STRUCT column tests: construction, field access, gather/filter/sort,
+Arrow round-trip (the cudf structs surface, SURVEY.md §2.3)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.struct import (
+    StructColumn,
+    pack,
+    struct_from_arrow,
+    struct_to_arrow,
+    unpack,
+)
+
+ROWS = [
+    {"a": 1, "s": "x"},
+    None,
+    {"a": 3, "s": None},
+    {"a": None, "s": "w"},
+    {"a": 5, "s": "v"},
+]
+
+
+def test_from_pylist_round_trip():
+    sc = StructColumn.from_pylist(ROWS)
+    assert sc.row_count == 5
+    assert sc.null_count() == 1
+    assert sc.to_pylist() == ROWS
+
+
+def test_field_access_folds_struct_nulls():
+    sc = StructColumn.from_pylist(ROWS)
+    # row 1 is a null struct: its children read as null through field()
+    assert sc.field("a").to_pylist() == [1, None, 3, None, 5]
+    assert sc.field("s").to_pylist() == ["x", None, None, "w", "v"]
+    assert sc.field(0).to_pylist() == [1, None, 3, None, 5]
+
+
+def test_gather_and_filter():
+    sc = StructColumn.from_pylist(ROWS)
+    import jax.numpy as jnp
+
+    g = sc.gather(jnp.asarray([4, 0, 1]))
+    assert g.to_pylist() == [ROWS[4], ROWS[0], None]
+    mask = Column.from_numpy(
+        np.array([True, True, False, False, True]), dtype=dt.BOOL8
+    )
+    f = sc.filter(mask)
+    assert f.to_pylist() == [ROWS[0], None, ROWS[4]]
+
+
+def test_argsort_lexicographic():
+    sc = StructColumn.from_pylist(
+        [
+            {"a": 2, "b": 9},
+            {"a": 1, "b": 5},
+            {"a": 2, "b": 1},
+            None,
+            {"a": 1, "b": 7},
+        ]
+    )
+    perm = np.asarray(sc.argsort())
+    got = sc.gather(perm).to_pylist()
+    # struct-level nulls first, then (a, b) lexicographic
+    assert got == [
+        None,
+        {"a": 1, "b": 5},
+        {"a": 1, "b": 7},
+        {"a": 2, "b": 1},
+        {"a": 2, "b": 9},
+    ]
+
+
+def test_pack_unpack():
+    t = Table.from_pydict({"k": [1, 2, 3], "v": [9, None, 7]})
+    sc = pack(t, ["k", "v"])
+    assert sc.to_pylist() == [
+        {"k": 1, "v": 9},
+        {"k": 2, "v": None},
+        {"k": 3, "v": 7},
+    ]
+    back = unpack(sc)
+    assert back["k"].to_pylist() == [1, 2, 3]
+    assert back["v"].to_pylist() == [9, None, 7]
+
+
+def test_arrow_round_trip():
+    arr = pa.array(
+        ROWS,
+        type=pa.struct([("a", pa.int64()), ("s", pa.string())]),
+    )
+    sc = struct_from_arrow(arr)
+    assert sc.to_pylist() == ROWS
+    back = struct_to_arrow(sc)
+    assert back.to_pylist() == ROWS
+    assert pa.types.is_struct(back.type)
+
+
+def test_jit_pytree():
+    import jax
+
+    sc = StructColumn.from_pylist(
+        [{"a": 1, "b": 2.5}, {"a": 3, "b": 4.5}]
+    )
+
+    @jax.jit
+    def f(s):
+        return s.field("a").data + 1
+
+    assert np.asarray(f(sc)).tolist() == [2, 4]
+
+
+def test_mismatched_children_raise():
+    a = Column.from_numpy(np.array([1, 2], dtype=np.int64))
+    b = Column.from_numpy(np.array([1], dtype=np.int64))
+    with pytest.raises(ValueError):
+        StructColumn.from_children([a, b])
+    with pytest.raises(ValueError):
+        StructColumn.from_children([])
