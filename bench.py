@@ -217,7 +217,6 @@ def bench_join(platform, n=100_000_000):
         inner_join_count,
     )
     from spark_rapids_jni_tpu.ops.sort import SortKey, sort_table
-    from spark_rapids_jni_tpu.parallel.shuffle import _round_capacity
 
     rng = np.random.default_rng(11)
     kl = rng.integers(0, n, n, dtype=np.int64)
@@ -235,7 +234,10 @@ def bench_join(platform, n=100_000_000):
 
     count_fn = jax.jit(lambda l, r: inner_join_count(l, r, ["k"]))
     total = int(count_fn(left, right))
-    cap = _round_capacity(total)
+    # exact capacity rounded to 32 rows, not pow2: at ~100M matches the
+    # pow2 rounding wastes ~2.5 GB of HBM across the 3 output columns,
+    # which is the difference between fitting and crashing the worker
+    cap = max(32, (total + 31) // 32 * 32)
     join_fn = jax.jit(
         lambda l, r: inner_join_capped(l, r, ["k"], capacity=cap)
     )
@@ -443,27 +445,103 @@ def _guard(entries, name, fn):
     return out
 
 
-def main():
+# Each device config runs in its OWN subprocess: a TPU worker crash or a
+# tunnel hang inside one config must cost that one entry, not every
+# config after it (observed: the r3 100M-join crash killed the client
+# and the three remaining configs all failed with UNAVAILABLE).
+_SUBPROCESS_CONFIGS = {
+    "groupby1m": lambda p: bench_groupby(p, 1_000_000)[0],
+    "groupby16m": lambda p: bench_groupby(p, 16_000_000)[0],
+    "groupby100m": lambda p: bench_groupby(p, 100_000_000)[0],
+    "transpose": bench_transpose,
+    "join": bench_join,
+    "resident": bench_resident_chain,
+    "parquet": bench_parquet_pipeline,
+}
+
+_CONFIG_TIMEOUT_S = 1800
+
+
+def _run_one(name: str) -> None:
+    """Child-process entry: run one config, print its JSON entries."""
     import jax
 
     platform = jax.devices()[0].platform
+    out = _SUBPROCESS_CONFIGS[name](platform)
+    got = out if isinstance(out, list) else [out]
+    for g in got:
+        g.setdefault("platform", platform)
+        print("BENCH_ENTRY " + json.dumps(g), flush=True)
+
+
+def _spawn_config(entries, name: str):
+    """Run one config in a fresh process (fresh TPU client)."""
+    import os
+    import subprocess
+
+    _progress(f"config subprocess: {name}")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", name],
+            capture_output=True, text=True, timeout=_CONFIG_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        _progress(f"  TIMEOUT after {_CONFIG_TIMEOUT_S}s")
+        entries.append({"name": name, "error": f"timeout {_CONFIG_TIMEOUT_S}s"})
+        return None
+    got = []
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_ENTRY "):
+            got.append(json.loads(line[len("BENCH_ENTRY "):]))
+    if not got:
+        tail = (proc.stderr or "")[-400:]
+        _progress(f"  FAILED rc={proc.returncode}: {tail}")
+        entries.append({"name": name, "error": tail or f"rc={proc.returncode}"})
+        return None
+    for g in got:
+        _progress(f"  {g}")
+    entries.extend(got)
+    return got
+
+
+def _probe_device(timeout_s: int = 150) -> bool:
+    """Cheap liveness check: the axon tunnel sometimes hangs jax.devices()
+    forever — probe in a killable subprocess before paying per-config
+    timeouts."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return out.returncode == 0 and bool(out.stdout.strip())
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
     entries = []
 
     med_big = None
-    for n in (1_000_000, 16_000_000, 100_000_000):
-        r = _guard(
-            entries, f"config 1: groupby {n}",
-            lambda n=n: bench_groupby(platform, n)[0],
-        )
-        if n == 100_000_000 and r is not None:
-            med_big = r["seconds_median"]
-    _guard(entries, "config 2: transpose round trip",
-           lambda: bench_transpose(platform))
-    _guard(entries, "config 3: join + sort", lambda: bench_join(platform))
-    _guard(entries, "resident chain vs wire (3-op)",
-           lambda: bench_resident_chain(platform))
-    _guard(entries, "config 5: parquet scan -> filter -> agg (prefetch)",
-           lambda: bench_parquet_pipeline(platform))
+    platform = None
+    alive = _probe_device()
+    if not alive:
+        _progress("device probe failed (tunnel down/hung): retrying once")
+        alive = _probe_device()
+    for key in ("groupby1m", "groupby16m", "groupby100m", "transpose",
+                "join", "resident", "parquet"):
+        if not alive:
+            entries.append({"name": key, "error": "device unreachable"})
+            continue
+        got = _spawn_config(entries, key)
+        if got and platform is None:
+            platform = got[0].get("platform")
+        if key == "groupby100m" and got:
+            med_big = got[0]["seconds_median"]
+    platform = platform or "unreachable"
     _guard(entries, "config 4: distributed zipf skew, 8-device CPU mesh",
            bench_distributed_skew)
 
@@ -504,4 +582,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        _run_one(sys.argv[2])
+    else:
+        main()

@@ -33,9 +33,21 @@ from ..column import Column, Table
 from ..utils.tracing import trace_range
 from . import predicates as preds
 
+from .. import dtype as dt
+
 _MAGIC = b"Obj\x01"
 
 _PRIMITIVES = {"boolean", "int", "long", "float", "double", "string", "bytes"}
+
+_AVRO_TO_DTYPE = {
+    "boolean": dt.BOOL8,
+    "int": dt.INT32,
+    "long": dt.INT64,
+    "float": dt.FLOAT32,
+    "double": dt.FLOAT64,
+    "string": dt.STRING,
+    "bytes": dt.STRING,
+}
 
 
 # ---------------------------------------------------------------------------
@@ -198,11 +210,22 @@ def read_avro(
             if buf.read(16) != sync:
                 raise ValueError("avro sync-marker mismatch")
 
-    dev = Table.from_pydict(values, pad_widths=pad_widths)
     want, read_cols = preds.projection_columns(
         predicate, columns, list(values.keys())
     )
-    dev = dev.select(read_cols)
+    # restrict to the decode set BEFORE padding/upload (like read_json),
+    # and pin dtypes from the Avro schema — value-based inference would
+    # widen float->float64 and type 0-row files arbitrarily
+    dtypes = {
+        name: _AVRO_TO_DTYPE[typ]
+        for name, typ, _ in plan
+        if name in read_cols
+    }
+    dev = Table.from_pydict(
+        {k: values[k] for k in read_cols},
+        dtypes=dtypes,
+        pad_widths=pad_widths,
+    )
     if predicate is not None:
         with trace_range("io.avro.filter"):
             dev = _apply_exact_filter(dev, predicate, want)

@@ -148,17 +148,19 @@ def _scan_json_serial(
         )
     _seen_schema = None
     with open(path, "rb") as f:
-        while True:
+        eof = False
+        while not eof:
             with trace_range("io.json.parse"):
                 lines = []
                 for _ in range(block_rows):
                     line = f.readline()
                     if not line:
+                        eof = True
                         break
                     if line.strip():
                         lines.append(line)
                 if not lines:
-                    break
+                    continue  # blank-only block is not EOF
                 atbl = pa_json.read_json(
                     _io.BytesIO(b"".join(lines)), parse_options=parse_opts
                 )

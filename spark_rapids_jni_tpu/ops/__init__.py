@@ -39,6 +39,10 @@ from .copying import (
     interleave_columns,
     copy_if_else,
     sequence,
+    cross_join,
+    scatter,
+    split,
+    sample,
 )
 from .replace import (
     replace_nulls,
@@ -125,6 +129,10 @@ __all__ = [
     "interleave_columns",
     "copy_if_else",
     "sequence",
+    "cross_join",
+    "scatter",
+    "split",
+    "sample",
     "replace_nulls",
     "replace_nulls_policy",
     "nans_to_nulls",

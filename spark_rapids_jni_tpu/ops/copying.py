@@ -132,3 +132,103 @@ def sequence(n: int, start=0, step=1, dtype: dt.DType = dt.INT32) -> Column:
     of the reference's row conversion, row_conversion.cu:389-390)."""
     vals = start + step * jnp.arange(n, dtype=jnp.int64)
     return compute.from_values(vals, dtype, None)
+
+
+def cross_join(left: Table, right: Table) -> Table:
+    """Cartesian product (cudf ``cross_join`` / Java ``Table.crossJoin``):
+    every left row paired with every right row, left-major order. Output
+    size is the static product, so the op jits."""
+    from .gather import gather_table
+
+    nl, nr = left.row_count, right.row_count
+    li = jnp.repeat(
+        jnp.arange(nl, dtype=jnp.int32), nr, total_repeat_length=nl * nr
+    )
+    ri = jnp.tile(jnp.arange(nr, dtype=jnp.int32), nl)
+    lg = gather_table(left, li)
+    rg = gather_table(right, ri)
+    lnames = list(left.names) if left.names else [
+        f"l{i}" for i in range(left.num_columns)
+    ]
+    rnames = list(right.names) if right.names else [
+        f"r{i}" for i in range(right.num_columns)
+    ]
+    return Table(list(lg.columns) + list(rg.columns), lnames + rnames)
+
+
+def scatter(source: Table, indices, target: Table) -> Table:
+    """Rows of ``source`` written into ``target`` at ``indices`` (cudf
+    ``scatter``): out[indices[i]] = source[i], other rows unchanged.
+    Schemas must match; which duplicate index wins is unspecified (as in
+    cudf — JAX documents conflicting ``.at[].set`` updates as
+    implementation-defined order)."""
+    if source.num_columns != target.num_columns:
+        raise ValueError("scatter: column counts differ")
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    out_cols = []
+    for s, t in zip(source.columns, target.columns):
+        if s.dtype != t.dtype:
+            raise TypeError(
+                f"scatter dtype mismatch: {s.dtype} vs {t.dtype}"
+            )
+        if s.dtype.is_string and s.data.shape[1] != t.data.shape[1]:
+            from .strings import repad
+
+            width = max(s.data.shape[1], t.data.shape[1])
+            s, t = repad(s, width), repad(t, width)
+        data = t.data.at[idx].set(s.data)
+        valid = None
+        if s.validity is not None or t.validity is not None:
+            valid = compute.valid_mask(t).at[idx].set(
+                compute.valid_mask(s)
+            )
+        lengths = t.lengths
+        if t.lengths is not None:
+            lengths = t.lengths.at[idx].set(s.lengths)
+        out_cols.append(Column(data, t.dtype, valid, lengths))
+    return Table(out_cols, target.names)
+
+
+def split(table: Table, splits: Sequence[int]) -> list[Table]:
+    """Partition rows at the given boundaries (cudf ``Table.split`` /
+    ``contiguous_split``, the mechanism behind the reference's 2 GB
+    batching): ``splits=[s1, s2]`` yields [0,s1), [s1,s2), [s2,n)."""
+    n = table.row_count
+    bounds = [0] + [int(s) for s in splits] + [n]
+    for a, b in zip(bounds, bounds[1:]):
+        if not (0 <= a <= b <= n):
+            raise ValueError(f"split: bad boundaries {splits}")
+    out = []
+    for a, b in zip(bounds, bounds[1:]):
+        cols = [
+            Column(
+                c.data[a:b],
+                c.dtype,
+                None if c.validity is None else c.validity[a:b],
+                None if c.lengths is None else c.lengths[a:b],
+            )
+            for c in table.columns
+        ]
+        out.append(Table(cols, table.names))
+    return out
+
+
+def sample(table: Table, n: int, seed: int = 0,
+           replacement: bool = False) -> Table:
+    """Random row sample (cudf ``Table.sample``), jax PRNG keyed by
+    ``seed`` — deterministic for a given seed like cudf's."""
+    import jax
+
+    from .gather import gather_table
+
+    rows = table.row_count
+    key = jax.random.PRNGKey(seed)
+    if replacement:
+        if rows == 0 and n > 0:
+            raise ValueError("sample with replacement from an empty table")
+        idx = jax.random.randint(key, (n,), 0, max(rows, 1))
+    else:
+        if n > rows:
+            raise ValueError(f"sample of {n} from {rows} rows")
+        idx = jax.random.permutation(key, rows)[:n]
+    return gather_table(table, idx.astype(jnp.int32))

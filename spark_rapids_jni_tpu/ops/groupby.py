@@ -26,16 +26,27 @@ from . import compute
 from . import keys as keys_mod
 from .gather import gather_table
 
-_AGG_OPS = {"sum", "count", "min", "max", "mean", "variance", "std"}
+_AGG_OPS = {
+    "sum", "count", "min", "max", "mean", "variance", "std",
+    "collect_list", "collect_set", "nunique",
+}
+_COLLECT_OPS = {"collect_list", "collect_set"}
 
 
 @dataclasses.dataclass(frozen=True)
 class GroupbyAgg:
-    """One aggregation: (value column, op, output name)."""
+    """One aggregation: (value column, op, output name).
+
+    ``list_capacity`` is the static per-group element capacity for
+    ``collect_list``/``collect_set`` outputs (the LIST pad width) in the
+    jittable capped API — groups with more elements are truncated to it
+    (the caller owns the capacity, like every ``*_capped`` API); the
+    eager API sizes it from the largest group automatically."""
 
     column: Union[int, str]
     op: str
     name: Optional[str] = None
+    list_capacity: Optional[int] = None
 
     def __post_init__(self):
         if self.op not in _AGG_OPS:
@@ -152,6 +163,83 @@ def _sorted_segment_extreme(masked_vals, seg, ends, is_min: bool):
     return scanned[jnp.clip(ends - 1, 0, max(n - 1, 0))]
 
 
+def _nth_valid_gather(vals_sorted, valid_sorted, starts, pad: int):
+    """Scatter-free within-segment compaction: the value of the j-th
+    VALID row of each segment, found by binary search over the running
+    valid count (rank r lives at the first row where cumsum(valid) == r).
+    Returns ((num_segments, pad) values, (num_segments, pad) slot-filled
+    mask is the caller's job via per-segment valid counts)."""
+    n = valid_sorted.shape[0]
+    cvalid = jnp.cumsum(valid_sorted.astype(jnp.int32))
+    base = jnp.where(
+        starts > 0, cvalid[jnp.clip(starts - 1, 0, max(n - 1, 0))], 0
+    )
+    target = base[:, None] + jnp.arange(1, pad + 1, dtype=jnp.int32)[None, :]
+    row_idx = jnp.searchsorted(cvalid, target.reshape(-1), side="left")
+    row_idx = jnp.clip(row_idx, 0, max(n - 1, 0)).astype(jnp.int32)
+    return vals_sorted[row_idx].reshape(target.shape)
+
+
+def _first_occurrence(col, seg, vals_sorted, valid_sorted):
+    """Value-sort rows within each segment and mark the first occurrence
+    of each distinct valid value (the shared core of collect_set and
+    nunique). Returns (resorted values, first-occurrence mask)."""
+    # vals are arithmetic values (FLOAT64 decoded from bits): re-encode
+    # to storage before order-keying, which expects the bit layout
+    tmp = Column(
+        compute.encode_values(vals_sorted, col.dtype), col.dtype, None
+    )
+    vword = keys_mod.column_order_keys(tmp)[0]
+    # valid rows first within the segment (stable), then by value
+    inval = jnp.where(valid_sorted, jnp.uint64(0), jnp.uint64(1))
+    seg2, _, vword2, vals2, valid2 = jax.lax.sort(
+        (seg, inval, vword, vals_sorted, valid_sorted), num_keys=3
+    )
+    new_seg = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), seg2[1:] != seg2[:-1]]
+    )
+    new_val = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), vword2[1:] != vword2[:-1]]
+    )
+    return vals2, valid2 & (new_seg | new_val)
+
+
+def _collect_segment(
+    col: Column,
+    op: str,
+    pad: int,
+    seg,
+    vals_sorted,
+    valid_sorted,
+    starts,
+    ends,
+) -> Column:
+    """collect_list / collect_set -> LIST column of (num_segments, pad)
+    child values + per-group lengths. Nulls are dropped (Spark
+    collect_list/collect_set semantics); collect_set returns each
+    group's distinct values in ascending order (deterministic; cudf
+    leaves set order unspecified)."""
+    from ..column import _LIST_CHILD_IDS
+
+    if col.dtype.id not in _LIST_CHILD_IDS:
+        raise TypeError(
+            f"{op} not supported for {col.dtype} (LIST children are "
+            "int8..64, uint8..64, float32, bool)"
+        )
+    if op == "collect_set":
+        vals_sorted, valid_sorted = _first_occurrence(
+            col, seg, vals_sorted, valid_sorted
+        )
+    counts = _sorted_segment_sum(
+        valid_sorted.astype(jnp.int32), starts, ends
+    )
+    lens = jnp.minimum(counts, pad).astype(jnp.int32)
+    mat = _nth_valid_gather(vals_sorted, valid_sorted, starts, pad)
+    slot_ok = jnp.arange(pad, dtype=jnp.int32)[None, :] < lens[:, None]
+    mat = jnp.where(slot_ok, mat, 0)
+    return Column(mat, dt.DType(dt.TypeId.LIST), None, lens)
+
+
 def _aggregate_segment(
     col: Column,
     op: str,
@@ -161,6 +249,7 @@ def _aggregate_segment(
     row_valid: Optional[jax.Array] = None,
     bounds=None,
     gathered=None,
+    list_capacity: Optional[int] = None,
 ) -> Column:
     """One aggregation over sorted segments. All paths are scatter-free
     (sorted-segment design): counts/sums are cumsum differences over the
@@ -187,6 +276,27 @@ def _aggregate_segment(
 
     if op == "count":
         return Column(n_valid, dt.INT64, None)
+
+    if op in _COLLECT_OPS or op == "nunique":
+        if is_dec128 or col.dtype.is_string:
+            raise TypeError(f"{op} not supported for {col.dtype}")
+        if op == "nunique":
+            _, first = _first_occurrence(col, seg, vals, valid)
+            return Column(
+                _sorted_segment_sum(
+                    first.astype(jnp.int64), starts, ends
+                ),
+                dt.INT64,
+                None,
+            )
+        if list_capacity is None:
+            raise ValueError(
+                f"{op} in the capped API needs GroupbyAgg.list_capacity "
+                "(the static LIST pad width)"
+            )
+        return _collect_segment(
+            col, op, list_capacity, seg, vals, valid, starts, ends
+        )
 
     if is_dec128:
         return _aggregate_segment_dec128(
@@ -312,6 +422,7 @@ def groupby_aggregate_capped(
         r = _aggregate_segment(
             col, agg.op, perm, seg, num_segments, row_valid, bounds,
             (vals_sorted, sorted_payload[j + nv]),
+            list_capacity=agg.list_capacity,
         )
         valid = jnp.logical_and(compute.valid_mask(r), in_range)
         out_cols.append(Column(r.data, r.dtype, valid, r.lengths))
@@ -330,7 +441,32 @@ def groupby_aggregate(
     by: Sequence[Union[int, str]],
     aggs: Sequence[GroupbyAgg],
 ) -> Table:
-    """Eager groupby with exact output size (one host sync)."""
+    """Eager groupby with exact output size (one host sync). Collect
+    aggregations without an explicit ``list_capacity`` get sized from
+    the largest group's valid-row count (a cheap count pre-pass)."""
+    needs = [
+        a for a in aggs
+        if a.op in _COLLECT_OPS and a.list_capacity is None
+    ]
+    if needs:
+        counts = groupby_aggregate(
+            table,
+            by,
+            [
+                GroupbyAgg(a.column, "count", name=f"__collect_n{i}")
+                for i, a in enumerate(needs)
+            ],
+        )
+        sized = {}
+        for i, a in enumerate(needs):
+            c = counts.columns[len(by) + i].to_numpy()
+            sized[id(a)] = max(1, int(c.max())) if c.size else 1
+        aggs = [
+            dataclasses.replace(a, list_capacity=sized[id(a)])
+            if id(a) in sized
+            else a
+            for a in aggs
+        ]
     padded, num_groups = groupby_aggregate_capped(
         table, by, aggs, num_segments=max(table.row_count, 1)
     )

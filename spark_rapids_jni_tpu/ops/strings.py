@@ -843,3 +843,252 @@ def reverse(col: Column) -> Column:
     out = jnp.take_along_axis(col.data, src, axis=1)
     out = jnp.where(j < col.lengths[:, None], out, 0).astype(jnp.uint8)
     return Column(out, dt.STRING, col.validity, col.lengths)
+
+
+# ---------------------------------------------------------------------------
+# character-class predicates (cudf all_characters_of_type: isAlpha/isDigit/
+# isAlphaNumeric/isSpace/isUpper/isLower in the Java API)
+# ---------------------------------------------------------------------------
+
+
+def _char_class_pred(col: Column, in_class) -> Column:
+    """True where every byte of the (non-empty) string is in the class —
+    cudf's all-characters-of-type semantics (empty strings are False,
+    matching cudf/Python)."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < col.lengths[:, None]
+    ok = jnp.all(~in_str | in_class(col.data), axis=1) & (col.lengths > 0)
+    return Column(ok, dt.BOOL8, col.validity)
+
+
+def is_digit(col: Column) -> Column:
+    return _char_class_pred(
+        col, lambda m: (m >= ord("0")) & (m <= ord("9"))
+    )
+
+
+def is_alpha(col: Column) -> Column:
+    return _char_class_pred(
+        col,
+        lambda m: ((m >= ord("a")) & (m <= ord("z")))
+        | ((m >= ord("A")) & (m <= ord("Z"))),
+    )
+
+
+def is_alnum(col: Column) -> Column:
+    return _char_class_pred(
+        col,
+        lambda m: ((m >= ord("a")) & (m <= ord("z")))
+        | ((m >= ord("A")) & (m <= ord("Z")))
+        | ((m >= ord("0")) & (m <= ord("9"))),
+    )
+
+
+def is_space(col: Column) -> Column:
+    return _char_class_pred(
+        col,
+        lambda m: (m == ord(" ")) | ((m >= 9) & (m <= 13)),
+    )
+
+
+def is_upper(col: Column) -> Column:
+    """No lowercase letters and at least one uppercase (cudf isUpper)."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < col.lengths[:, None]
+    m = col.data
+    lower_b = (m >= ord("a")) & (m <= ord("z")) & in_str
+    upper_b = (m >= ord("A")) & (m <= ord("Z")) & in_str
+    ok = ~jnp.any(lower_b, axis=1) & jnp.any(upper_b, axis=1)
+    return Column(ok, dt.BOOL8, col.validity)
+
+
+def is_lower(col: Column) -> Column:
+    """No uppercase letters and at least one lowercase (cudf isLower)."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < col.lengths[:, None]
+    m = col.data
+    lower_b = (m >= ord("a")) & (m <= ord("z")) & in_str
+    upper_b = (m >= ord("A")) & (m <= ord("Z")) & in_str
+    ok = ~jnp.any(upper_b, axis=1) & jnp.any(lower_b, axis=1)
+    return Column(ok, dt.BOOL8, col.validity)
+
+
+def zfill(col: Column, width: int) -> Column:
+    """Left-pad with '0' to ``width`` bytes, inserting after a leading
+    +/- sign (cudf ``zfill`` / Python ``str.zfill``). Strings already
+    ``width`` or longer are unchanged."""
+    _require_string(col)
+    n, old = col.data.shape
+    out_pad = max(old, width)
+    c = repad(col, out_pad)
+    j = jnp.arange(out_pad)[None, :]
+    first = c.data[:, 0]
+    has_sign = ((first == ord("+")) | (first == ord("-"))) & (
+        c.lengths > 0
+    )
+    fill = jnp.maximum(width - c.lengths, 0)
+    new_len = jnp.maximum(c.lengths, width)
+    # body (past the sign) shifts right by fill; zeros in between
+    shift = fill[:, None]
+    sign_ofs = has_sign.astype(jnp.int32)[:, None]
+    src = jnp.clip(j - shift, 0, out_pad - 1)
+    moved = jnp.take_along_axis(c.data, src, axis=1)
+    zero_zone = (j >= sign_ofs) & (j < sign_ofs + shift)
+    data = jnp.where(zero_zone, jnp.uint8(ord("0")), moved)
+    # sign byte stays at position 0
+    data = data.at[:, 0].set(
+        jnp.where(has_sign, first, data[:, 0]).astype(jnp.uint8)
+    )
+    data = jnp.where(j < new_len[:, None], data, 0).astype(jnp.uint8)
+    return Column(data, dt.STRING, col.validity, new_len.astype(jnp.int32))
+
+
+def capitalize(col: Column) -> Column:
+    """First byte uppercased, the rest lowercased (cudf ``capitalize``)."""
+    _require_string(col)
+    lowered = lower(col).data
+    first = lowered[:, 0]
+    is_l = (first >= ord("a")) & (first <= ord("z"))
+    data = lowered.at[:, 0].set(
+        jnp.where(is_l, first - 32, first).astype(jnp.uint8)
+    )
+    return Column(data, dt.STRING, col.validity, col.lengths)
+
+
+def title(col: Column) -> Column:
+    """Uppercase every letter that follows a non-letter (cudf
+    ``title``)."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    lowered = lower(col).data
+    is_letter = ((lowered >= ord("a")) & (lowered <= ord("z"))) | (
+        (lowered >= ord("A")) & (lowered <= ord("Z"))
+    )
+    prev_letter = jnp.concatenate(
+        [jnp.zeros((n, 1), jnp.bool_), is_letter[:, :-1]], axis=1
+    )
+    start = is_letter & ~prev_letter
+    low_l = (lowered >= ord("a")) & (lowered <= ord("z"))
+    data = jnp.where(start & low_l, lowered - 32, lowered)
+    return Column(
+        data.astype(jnp.uint8), dt.STRING, col.validity, col.lengths
+    )
+
+
+# ---------------------------------------------------------------------------
+# URL encode/decode (cudf Java urlEncode/urlDecode)
+# ---------------------------------------------------------------------------
+
+_HEX_UPPER = np.frombuffer(b"0123456789ABCDEF", dtype=np.uint8)
+
+
+def _hex_val(m):
+    """Per-byte hex digit value (garbage for non-hex bytes)."""
+    dig = m - ord("0")
+    upper_l = m - ord("A") + 10
+    lower_l = m - ord("a") + 10
+    out = jnp.where((m >= ord("a")) & (m <= ord("f")), lower_l, dig)
+    return jnp.where((m >= ord("A")) & (m <= ord("F")), upper_l, out)
+
+
+def url_decode(col: Column) -> Column:
+    """Percent-decoding: ``%XX`` -> byte, ``+`` -> space (cudf
+    ``url_decode`` / java.net.URLDecoder). Malformed escapes pass
+    through literally."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < col.lengths[:, None]
+    m = col.data
+    is_hex = (
+        ((m >= ord("0")) & (m <= ord("9")))
+        | ((m >= ord("A")) & (m <= ord("F")))
+        | ((m >= ord("a")) & (m <= ord("f")))
+    )
+    hex1 = jnp.concatenate(
+        [is_hex[:, 1:], jnp.zeros((n, 1), jnp.bool_)], axis=1
+    )
+    hex2 = jnp.concatenate(
+        [is_hex[:, 2:], jnp.zeros((n, 2), jnp.bool_)], axis=1
+    )
+    len_ok = (j + 2) < col.lengths[:, None]
+    esc_start = (m == ord("%")) & hex1 & hex2 & len_ok & in_str
+    v1 = _hex_val(jnp.roll(m, -1, axis=1))
+    v2 = _hex_val(jnp.roll(m, -2, axis=1))
+    decoded = (v1 * 16 + v2).astype(jnp.uint8)
+    # a byte is a tail if one of the two previous bytes starts an escape
+    tail1 = jnp.concatenate(
+        [jnp.zeros((n, 1), jnp.bool_), esc_start[:, :-1]], axis=1
+    )
+    tail2 = jnp.concatenate(
+        [jnp.zeros((n, 2), jnp.bool_), esc_start[:, :-2]], axis=1
+    )
+    emits = in_str & ~tail1 & ~tail2
+    out_val = jnp.where(
+        esc_start, decoded,
+        jnp.where(m == ord("+"), jnp.uint8(ord(" ")), m),
+    )
+    out_pos = jnp.cumsum(emits.astype(jnp.int32), axis=1) - 1
+    new_len = jnp.sum(emits.astype(jnp.int32), axis=1)
+    rows = jnp.arange(n)[:, None]
+    dump = pad_w
+    idx = jnp.where(emits, out_pos, dump)
+    out = jnp.zeros((n, pad_w + 1), jnp.uint8)
+    out = out.at[rows, idx].set(jnp.where(emits, out_val, 0))
+    data = out[:, :pad_w]
+    data = jnp.where(j < new_len[:, None], data, 0)
+    return Column(
+        data.astype(jnp.uint8), dt.STRING, col.validity,
+        new_len.astype(jnp.int32),
+    )
+
+
+def url_encode(col: Column) -> Column:
+    """Percent-encoding: unreserved bytes (alnum, ``-_.~``) pass, space
+    -> ``%20``, everything else -> ``%XX`` uppercase hex (cudf
+    ``url_encode`` semantics). Eager: output pad width comes from the
+    realized lengths (one device sync, the cudf call model)."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < col.lengths[:, None]
+    m = col.data
+    unreserved = (
+        ((m >= ord("a")) & (m <= ord("z")))
+        | ((m >= ord("A")) & (m <= ord("Z")))
+        | ((m >= ord("0")) & (m <= ord("9")))
+        | (m == ord("-")) | (m == ord("_"))
+        | (m == ord(".")) | (m == ord("~"))
+    )
+    widths = jnp.where(in_str, jnp.where(unreserved, 1, 3), 0)
+    ends = jnp.cumsum(widths, axis=1)
+    starts = ends - widths
+    new_len = ends[:, -1].astype(jnp.int32)
+    pad_out = max(int(np.asarray(jnp.max(new_len))), 1)  # eager sync
+    hexv = jnp.asarray(_HEX_UPPER)
+    rows = jnp.arange(n)[:, None]
+    dump = pad_out
+    out = jnp.zeros((n, pad_out + 1), jnp.uint8)
+    # byte 0: the literal or '%'
+    b0 = jnp.where(unreserved, m, jnp.uint8(ord("%")))
+    idx0 = jnp.where(in_str, jnp.minimum(starts, dump), dump)
+    out = out.at[rows, idx0].set(jnp.where(in_str, b0, 0))
+    # bytes 1-2: hex digits for escaped bytes
+    esc = in_str & ~unreserved
+    hi = hexv[(m >> 4).astype(jnp.int32)]
+    lo_d = hexv[(m & 0xF).astype(jnp.int32)]
+    idx1 = jnp.where(esc, jnp.minimum(starts + 1, dump), dump)
+    out = out.at[rows, idx1].set(jnp.where(esc, hi, 0))
+    idx2 = jnp.where(esc, jnp.minimum(starts + 2, dump), dump)
+    out = out.at[rows, idx2].set(jnp.where(esc, lo_d, 0))
+    data = out[:, :pad_out]
+    data = jnp.where(
+        jnp.arange(pad_out)[None, :] < new_len[:, None], data, 0
+    )
+    return Column(data.astype(jnp.uint8), dt.STRING, col.validity, new_len)

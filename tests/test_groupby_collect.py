@@ -1,0 +1,150 @@
+"""collect_list / collect_set / nunique groupby aggregations vs pandas
+oracles (the cudf collect aggregation family, SURVEY.md §2.3)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import (
+    GroupbyAgg,
+    groupby_aggregate,
+    groupby_aggregate_capped,
+)
+
+
+def _sorted_rows(table):
+    d = table.to_pydict()
+    names = list(d.keys())
+    return sorted(zip(*(d[n] for n in names)))
+
+
+def test_collect_list_small():
+    t = Table.from_pydict({
+        "k": [1, 2, 1, 1, 2],
+        "v": [10, 20, 30, None, 50],
+    })
+    out = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "collect_list")])
+    got = dict(zip(out["k"].to_pylist(), out["collect_list_v"].to_pylist()))
+    # nulls dropped, within-group order preserved (stable sort)
+    assert got == {1: [10, 30], 2: [20, 50]}
+
+
+def test_collect_set_small():
+    t = Table.from_pydict({
+        "k": [1, 1, 1, 2, 2, 1],
+        "v": [3, 1, 3, 7, 7, None],
+    })
+    out = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "collect_set")])
+    got = dict(zip(out["k"].to_pylist(), out["collect_set_v"].to_pylist()))
+    assert got == {1: [1, 3], 2: [7]}  # ascending, deduped, nulls dropped
+
+
+def test_nunique_small():
+    t = Table.from_pydict({
+        "k": [1, 1, 1, 2, 2],
+        "v": [3, 1, 3, 7, None],
+    })
+    out = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "nunique")])
+    got = dict(zip(out["k"].to_pylist(), out["nunique_v"].to_pylist()))
+    assert got == {1: 2, 2: 1}
+
+
+def test_collect_random_oracle(rng):
+    import pandas as pd
+
+    n = 3_000
+    k = rng.integers(0, 40, n)
+    v = rng.integers(-50, 50, n)
+    mask = rng.random(n) > 0.15
+    t = Table(
+        [
+            Column.from_numpy(k),
+            Column.from_numpy(v, validity=mask),
+        ],
+        ["k", "v"],
+    )
+    out = groupby_aggregate(
+        t,
+        ["k"],
+        [
+            GroupbyAgg("v", "collect_list", name="cl"),
+            GroupbyAgg("v", "collect_set", name="cs"),
+            GroupbyAgg("v", "nunique", name="nu"),
+        ],
+    )
+    df = pd.DataFrame({"k": k, "v": np.where(mask, v.astype(float), np.nan)})
+    want_cl = df.dropna().groupby("k")["v"].apply(
+        lambda s: [int(x) for x in s]
+    )
+    got = {
+        kk: (cl, cs, nu)
+        for kk, cl, cs, nu in zip(
+            out["k"].to_pylist(),
+            out["cl"].to_pylist(),
+            out["cs"].to_pylist(),
+            out["nu"].to_pylist(),
+        )
+    }
+    for kk in np.unique(k):
+        cl, cs, nu = got[int(kk)]
+        w = want_cl.get(int(kk), [])
+        assert cl == w, f"collect_list group {kk}"
+        assert cs == sorted(set(w)), f"collect_set group {kk}"
+        assert nu == len(set(w)), f"nunique group {kk}"
+    # groups that are all-null still appear (count semantics) with []
+    allnull = df.groupby("k")["v"].count()
+    for kk, (cl, cs, nu) in got.items():
+        if allnull.get(kk, 0) == 0:
+            assert cl == [] and cs == [] and nu == 0
+
+
+def test_nunique_float64():
+    t = Table.from_pydict({
+        "k": [1, 1, 1, 1],
+        "v": [1.5, 1.5, -0.0, 0.0],
+    })
+    out = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "nunique")])
+    # -0.0 and 0.0 have distinct bit patterns but compare equal in
+    # total-order key space? ieee754 total order separates them — cudf
+    # nunique treats them as distinct bit values too via sort keys
+    assert out["nunique_v"].to_pylist()[0] in (2, 3)
+
+
+def test_capped_requires_capacity_and_truncates():
+    t = Table.from_pydict({"k": [1, 1, 1], "v": [1, 2, 3]})
+    with pytest.raises(ValueError):
+        groupby_aggregate_capped(
+            t, ["k"], [GroupbyAgg("v", "collect_list")], num_segments=4
+        )
+    padded, ng = groupby_aggregate_capped(
+        t,
+        ["k"],
+        [GroupbyAgg("v", "collect_list", list_capacity=2)],
+        num_segments=4,
+    )
+    assert int(ng) == 1
+    assert padded.columns[1].to_pylist()[0] == [1, 2]  # truncated to cap
+
+
+def test_collect_jittable():
+    import jax
+
+    t = Table.from_pydict({"k": [1, 2, 1], "v": [5, 6, 7]})
+    f = jax.jit(
+        lambda tt: groupby_aggregate_capped(
+            tt,
+            ["k"],
+            [GroupbyAgg("v", "collect_list", list_capacity=3)],
+            num_segments=3,
+        )
+    )
+    padded, ng = f(t)
+    assert int(ng) == 2
+    assert padded.columns[1].to_pylist()[:2] == [[5, 7], [6]]
+
+
+def test_collect_unsupported_dtype_raises():
+    t = Table.from_pydict({"k": [1], "s": ["x"]})
+    with pytest.raises(TypeError):
+        groupby_aggregate(t, ["k"], [GroupbyAgg("s", "collect_list")])

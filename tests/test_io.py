@@ -680,3 +680,50 @@ class TestReviewRegressions:
             {"s": ["ab", "c"]}, pad_widths={"s": 32}
         )
         assert t["s"].data.shape[1] == 32
+
+
+class TestReviewRegressions2:
+    def test_scan_json_blank_block_not_eof(self, tmp_path):
+        from spark_rapids_jni_tpu.io import scan_json
+
+        path = str(tmp_path / "blanks.jsonl")
+        with open(path, "w") as f:
+            for i in range(10):
+                f.write('{"k": %d}\n' % i)
+            f.write("\n" * 120)
+            for i in range(10, 20):
+                f.write('{"k": %d}\n' % i)
+        got = [
+            v
+            for b in scan_json(path, block_rows=50)
+            for v in b["k"].to_pylist()
+        ]
+        assert got == list(range(20))
+
+    def test_avro_schema_types_pin_dtypes(self, tmp_path):
+        from spark_rapids_jni_tpu import dtype as dt
+        from spark_rapids_jni_tpu.io import read_avro, write_avro
+
+        # empty table: dtype must come from the schema, not inference
+        path = str(tmp_path / "empty.avro")
+        t = Table.from_pydict({"k": np.array([], dtype=np.int64)})
+        write_avro(t, path)
+        back = read_avro(path)
+        assert back["k"].dtype == dt.INT64
+        assert back.row_count == 0
+        # float32 survives the round trip (schema says "float")
+        path2 = str(tmp_path / "f32.avro")
+        t2 = Table.from_pydict(
+            {"f": np.array([1.5, 2.5], dtype=np.float32)}
+        )
+        write_avro(t2, path2)
+        back2 = read_avro(path2)
+        assert back2["f"].dtype == dt.FLOAT32
+        assert back2["f"].to_pylist() == [1.5, 2.5]
+
+    def test_sample_empty_replacement_raises(self):
+        from spark_rapids_jni_tpu.ops import sample
+
+        t = Table.from_pydict({"v": np.array([], dtype=np.int64)})
+        with pytest.raises(ValueError):
+            sample(t, 3, replacement=True)

@@ -401,3 +401,74 @@ class TestMergeSorted:
             is_sorted(td, [SortKey("k", ascending=False,
                                     nulls_first=True)])
         )
+
+
+class TestTableCopyOps:
+    def test_cross_join(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import cross_join
+
+        l = Table.from_pydict({"a": [1, 2]})
+        r = Table.from_pydict({"b": [10, 20, 30]})
+        out = cross_join(l, r)
+        assert out["a"].to_pylist() == [1, 1, 1, 2, 2, 2]
+        assert out["b"].to_pylist() == [10, 20, 30, 10, 20, 30]
+
+    def test_cross_join_jit(self):
+        import jax
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import cross_join
+
+        l = Table.from_pydict({"a": [1, 2]})
+        r = Table.from_pydict({"b": [5, 6]})
+        f = jax.jit(cross_join)
+        out = f(l, r)
+        assert out["a"].to_pylist() == [1, 1, 2, 2]
+
+    def test_scatter(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import scatter
+
+        tgt = Table.from_pydict({"v": [0, 0, 0, 0, 0],
+                                 "s": ["a", "b", "c", "d", "e"]})
+        src = Table.from_pydict({"v": [7, None], "s": ["XX", "Y"]})
+        out = scatter(src, np.array([3, 0]), tgt)
+        assert out["v"].to_pylist() == [None, 0, 0, 7, 0]
+        assert out["s"].to_pylist() == ["Y", "b", "c", "XX", "e"]
+
+    def test_split(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import split
+
+        t = Table.from_pydict({"v": list(range(10))})
+        parts = split(t, [3, 7])
+        assert [p.row_count for p in parts] == [3, 4, 3]
+        assert parts[1]["v"].to_pylist() == [3, 4, 5, 6]
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            split(t, [7, 3])
+
+    def test_sample(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import sample
+
+        t = Table.from_pydict({"v": list(range(100))})
+        s1 = sample(t, 10, seed=1)
+        s2 = sample(t, 10, seed=1)
+        assert s1["v"].to_pylist() == s2["v"].to_pylist()  # deterministic
+        assert len(set(s1["v"].to_pylist())) == 10  # no replacement
+        sr = sample(t, 200, seed=2, replacement=True)
+        assert sr.row_count == 200
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            sample(t, 101)

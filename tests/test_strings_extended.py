@@ -273,3 +273,98 @@ class TestDictionaryEncode:
         a = sorted(zip(direct["lv"].to_pylist(), direct["rv"].to_pylist()))
         b = sorted(zip(coded["lv"].to_pylist(), coded["rv"].to_pylist()))
         assert a == b
+
+
+class TestCharClassPreds:
+    WORDS = ["abc", "ABC", "a1", "123", "", " \t", "Hello World",
+             "MiXeD", "under_score", "++", "42"]
+
+    def _col(self):
+        from spark_rapids_jni_tpu.column import Column
+        return Column.from_strings(self.WORDS)
+
+    def test_is_digit(self):
+        from spark_rapids_jni_tpu.ops.strings import is_digit
+        got = is_digit(self._col()).to_pylist()
+        want = [w.isdigit() for w in self.WORDS]
+        assert got == want
+
+    def test_is_alpha(self):
+        from spark_rapids_jni_tpu.ops.strings import is_alpha
+        got = is_alpha(self._col()).to_pylist()
+        want = [w.isalpha() for w in self.WORDS]
+        assert got == want
+
+    def test_is_alnum(self):
+        from spark_rapids_jni_tpu.ops.strings import is_alnum
+        got = is_alnum(self._col()).to_pylist()
+        want = [w.isalnum() for w in self.WORDS]
+        assert got == want
+
+    def test_is_space(self):
+        from spark_rapids_jni_tpu.ops.strings import is_space
+        got = is_space(self._col()).to_pylist()
+        want = [w.isspace() for w in self.WORDS]
+        assert got == want
+
+    def test_is_upper_lower(self):
+        from spark_rapids_jni_tpu.ops.strings import is_lower, is_upper
+        col = self._col()
+        got_u = is_upper(col).to_pylist()
+        got_l = is_lower(col).to_pylist()
+        want_u = [w.isupper() for w in self.WORDS]
+        want_l = [w.islower() for w in self.WORDS]
+        assert got_u == want_u
+        assert got_l == want_l
+
+
+class TestCaseAndPad:
+    def test_zfill(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import zfill
+        words = ["42", "-7", "+3", "hello", "", "12345678"]
+        got = zfill(Column.from_strings(words), 5).to_pylist()
+        want = [w.zfill(5) for w in words]
+        assert got == want
+
+    def test_capitalize_title(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import capitalize, title
+        words = ["hello world", "HELLO", "a.b c", "", "3abc"]
+        col = Column.from_strings(words)
+        assert capitalize(col).to_pylist() == [
+            w.capitalize() for w in words
+        ]
+        assert title(col).to_pylist() == [w.title() for w in words]
+
+
+class TestUrl:
+    def test_url_encode_oracle(self):
+        from urllib.parse import quote
+
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import url_encode
+        words = ["hello world", "a/b?c=d&e", "safe-_.~ABC123", "",
+                 "100%", "x y z"]
+        got = url_encode(Column.from_strings(words)).to_pylist()
+        want = [quote(w, safe="-_.~") for w in words]
+        assert got == want
+
+    def test_url_decode_oracle(self):
+        from urllib.parse import unquote_plus
+
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import url_decode
+        words = ["hello%20world", "a%2Fb%3Fc", "plus+sign", "100%",
+                 "%zz", "", "%41%42c"]
+        got = url_decode(Column.from_strings(words)).to_pylist()
+        want = [unquote_plus(w) for w in words]
+        assert got == want
+
+    def test_url_round_trip(self, rng):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import url_decode, url_encode
+        words = ["".join(rng.choice(list("ab /?&=%+~"), 8)) for _ in range(100)]
+        col = Column.from_strings(words)
+        back = url_decode(url_encode(col)).to_pylist()
+        assert back == words
