@@ -207,16 +207,41 @@ def bench_transpose(platform, n=4_000_000, n_inputs=2):
                   bytes_moved, platform)
 
 
-def bench_join(platform, n=100_000_000):
-    """Config 3: two-phase hash inner join + global sort at 100M rows."""
+def bench_sort(platform, n=100_000_000):
+    """Config 3b: 100M-row single-chip sort (u64-normalized keys)."""
     import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.sort import SortKey, sort_table
+
+    rng = np.random.default_rng(13)
+    k = rng.integers(0, n, n, dtype=np.int64)
+    v = rng.integers(-100, 100, n, dtype=np.int64)
+    t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+    jax.block_until_ready(t.columns[0].data)
+    sort_fn = jax.jit(lambda tt: sort_table(tt, [SortKey("k")]))
+    med, mn, std, out = _timeit(sort_fn, [(t,)], reps_per_input=2)
+    head = np.asarray(out["k"].data[:1000])
+    assert (np.diff(head) >= 0).all(), "sort output not ordered"
+    return _entry(3, f"sort_{n // 1_000_000}M_int64", n, med, mn, std,
+                  n * 16 * 2, platform)
+
+
+def bench_join(platform, n=None):
+    """Config 3a: two-phase hash inner join at 100M rows (override
+    via SRT_BENCH_JOIN_ROWS for crash triage)."""
+    import os
+
+    import jax
+
+    if n is None:
+        n = int(os.environ.get("SRT_BENCH_JOIN_ROWS", 100_000_000))
 
     from spark_rapids_jni_tpu.column import Column, Table
     from spark_rapids_jni_tpu.ops.join import (
         inner_join_capped,
         inner_join_count,
     )
-    from spark_rapids_jni_tpu.ops.sort import SortKey, sort_table
 
     rng = np.random.default_rng(11)
     kl = rng.integers(0, n, n, dtype=np.int64)
@@ -252,15 +277,12 @@ def bench_join(platform, n=100_000_000):
     )
     # both sides read (16B/row each) + output written (3 int64 cols)
     bytes_moved = 2 * n * 16 + total * 24
-    e1 = _entry(3, "inner_join_100M_two_phase", 2 * n, med, mn, std,
-                bytes_moved, platform)
+    e1 = _entry(
+        3, f"inner_join_{n // 1_000_000}M_two_phase", 2 * n, med, mn,
+        std, bytes_moved, platform,
+    )
     e1["matches"] = total
-
-    sort_fn = jax.jit(lambda t: sort_table(t, [SortKey("k")]))
-    med, mn, std, _ = _timeit(sort_fn, [(left,)], reps_per_input=2)
-    e2 = _entry(3, "sort_100M_int64", n, med, mn, std, n * 16 * 2,
-                platform)
-    return [e1, e2]
+    return e1
 
 
 def bench_resident_chain(platform, n=4_000_000):
@@ -333,7 +355,7 @@ def bench_resident_chain(platform, n=4_000_000):
     }
 
 
-def bench_parquet_pipeline(platform, n_groups=6, rows_per_group=2_000_000):
+def bench_parquet_pipeline(platform, n_groups=4, rows_per_group=1_500_000):
     """Config-5 shape: Parquet scan -> predicate pushdown -> filter ->
     groupby-agg, streamed per row group, with and without the
     decode/compute prefetch overlap (round-3 VERDICT item 10)."""
@@ -455,6 +477,7 @@ _SUBPROCESS_CONFIGS = {
     "groupby100m": lambda p: bench_groupby(p, 100_000_000)[0],
     "transpose": bench_transpose,
     "join": bench_join,
+    "sort": bench_sort,
     "resident": bench_resident_chain,
     "parquet": bench_parquet_pipeline,
 }
@@ -532,7 +555,7 @@ def main():
         _progress("device probe failed (tunnel down/hung): retrying once")
         alive = _probe_device()
     for key in ("groupby1m", "groupby16m", "groupby100m", "transpose",
-                "join", "resident", "parquet"):
+                "join", "sort", "resident", "parquet"):
         if not alive:
             entries.append({"name": key, "error": "device unreachable"})
             continue

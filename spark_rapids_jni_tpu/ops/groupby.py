@@ -236,7 +236,9 @@ def _collect_segment(
     lens = jnp.minimum(counts, pad).astype(jnp.int32)
     mat = _nth_valid_gather(vals_sorted, valid_sorted, starts, pad)
     slot_ok = jnp.arange(pad, dtype=jnp.int32)[None, :] < lens[:, None]
-    mat = jnp.where(slot_ok, mat, 0)
+    # typed zero: a bare 0 would promote BOOL8 children to int64 and
+    # misreport list_child_dtype
+    mat = jnp.where(slot_ok, mat, jnp.zeros((), mat.dtype))
     return Column(mat, dt.DType(dt.TypeId.LIST), None, lens)
 
 

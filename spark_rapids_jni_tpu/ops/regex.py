@@ -660,6 +660,8 @@ def replace_re(col: Column, pattern: str, repl: str | bytes) -> Column:
     dropped = jnp.sum((in_match & in_str).astype(jnp.int32), axis=1)
     new_len = (lens - dropped + m * n_matches).astype(jnp.int32)
 
+    if n == 0:
+        return Column(col.data, dt.STRING, col.validity, col.lengths)
     pad_out = max(int(np.asarray(jnp.max(new_len))), 1)  # eager sync
     rows = jnp.arange(n)[:, None]
     dump = pad_out  # out-of-range scatter target, sliced off below

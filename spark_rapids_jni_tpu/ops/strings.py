@@ -1070,6 +1070,8 @@ def url_encode(col: Column) -> Column:
     ends = jnp.cumsum(widths, axis=1)
     starts = ends - widths
     new_len = ends[:, -1].astype(jnp.int32)
+    if n == 0:
+        return Column(col.data, dt.STRING, col.validity, col.lengths)
     pad_out = max(int(np.asarray(jnp.max(new_len))), 1)  # eager sync
     hexv = jnp.asarray(_HEX_UPPER)
     rows = jnp.arange(n)[:, None]

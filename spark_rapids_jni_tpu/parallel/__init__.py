@@ -21,6 +21,7 @@ from .shuffle import (
 from .distributed import (
     GroupOverflowError,
     JoinOverflowError,
+    broadcast_inner_join,
     distributed_groupby,
     distributed_inner_join,
     distributed_sort,
@@ -41,6 +42,7 @@ __all__ = [
     "ShuffleOverflowError",
     "GroupOverflowError",
     "JoinOverflowError",
+    "broadcast_inner_join",
     "distributed_groupby",
     "distributed_inner_join",
     "distributed_sort",

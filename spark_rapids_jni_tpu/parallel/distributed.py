@@ -209,6 +209,72 @@ def distributed_inner_join(
     return out, count, lov, rov
 
 
+def broadcast_inner_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    mesh: Mesh,
+    out_capacity: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
+):
+    """Broadcast-hash inner join: the small (dimension) side replicates
+    to every device, the big side stays sharded IN PLACE — zero exchange
+    of the big side over ICI.
+
+    The Spark plugin picks this plan (BroadcastHashJoinExec) whenever a
+    side fits the broadcast threshold — the TPC-DS dimension-table
+    pattern (date_dim/item/store joins in q5/q64). On the mesh the
+    replicated side rides shard_map's ``P()`` spec, so XLA materializes
+    one copy per device and every chip probes its local shard against
+    the full small table. Output sizing is the usual two-phase count
+    (``out_capacity=None`` auto-sizes to the real per-device maximum).
+
+    Returns (sharded padded join output, per-device match counts).
+    """
+    validate_on_overflow(on_overflow)
+    lsh = shard_table(left, mesh, axis)
+    count_pass = out_capacity is None
+    if count_pass:
+        cnt_fn = shard_map(
+            lambda l_local, r_full: inner_join_count(l_local, r_full, on)[
+                None
+            ],
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+        cnts = cnt_fn(lsh, right)
+        ocap = _round_capacity(int(jnp.max(cnts)))
+    else:
+        ocap = out_capacity
+
+    def body(l_local: Table, r_full: Table):
+        out, count = inner_join_capped(
+            l_local, r_full, on, capacity=ocap
+        )
+        return out, count[None]
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    out, count = fn(lsh, right)
+    if on_overflow == "raise":
+        worst = int(jnp.max(count))
+        if worst > ocap:
+            raise JoinOverflowError(
+                f"broadcast join output capacity {ocap} undersized: a "
+                f"device produced {worst} matches; pass "
+                "out_capacity=None to auto-size"
+            )
+    return out, count
+
+
 def distributed_sort(
     table: Table,
     sort_keys,

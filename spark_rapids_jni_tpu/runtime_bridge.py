@@ -11,9 +11,11 @@ travel back as host bytes.
 The wire format mirrors the reference's dtype marshaling: parallel
 (type id, scale) int arrays (RowConversionJni.cpp:56-61), little-endian
 fixed-width data buffers (FLOAT64 as IEEE-754 doubles, BOOL8 as one 0/1
-byte per value), and per-column 0/1 validity byte vectors. Fixed-width
-types only — the same gate the reference enforces at
-row_conversion.cu:514-516.
+byte per value), and per-column 0/1 validity byte vectors. Variable-width
+columns use Arrow layouts: STRING and LIST travel as int32
+offsets[n+1] + concatenated payload (for LIST the scale slot carries the
+child type id). The row transpose itself stays fixed-width-only — the
+same gate the reference enforces at row_conversion.cu:514-516.
 """
 
 from __future__ import annotations
@@ -49,41 +51,61 @@ def _wire_np(d: dt.DType) -> np.dtype:
     return np.dtype(d.storage_dtype)
 
 
+def _padded_from_offsets(
+    data: bytes, num_rows: int, child_np: np.dtype, label: str
+):
+    """Arrow offsets+payload wire buffer -> ((n, pad) matrix, lengths).
+
+    Shared by the STRING and LIST branches: int32 offsets[num_rows+1]
+    followed by the concatenated payload values, decoded into the
+    padded-matrix device layout."""
+    offs = np.frombuffer(data, np.int32, num_rows + 1)
+    lens = np.diff(offs).astype(np.int32)
+    need = 4 * (num_rows + 1) + child_np.itemsize * int(offs[-1])
+    if len(data) < need:
+        raise ValueError(
+            f"{label} wire buffer holds {len(data)} bytes, offsets "
+            f"require {need}"
+        )
+    flat = np.frombuffer(
+        data, child_np, count=int(offs[-1]), offset=4 * (num_rows + 1)
+    )
+    pad = max(int(lens.max()) if num_rows else 1, 1)
+    mat = np.zeros((num_rows, pad), child_np)
+    mask = np.arange(pad)[None, :] < lens[:, None]
+    mat[mask] = flat
+    return mat, lens
+
+
+def _padded_to_offsets(mat: np.ndarray, lens: np.ndarray) -> bytes:
+    """(n, pad) matrix + lengths -> offsets+payload wire bytes."""
+    offs = np.zeros((lens.shape[0] + 1,), np.int32)
+    np.cumsum(lens, out=offs[1:])
+    mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
+    flat = np.ascontiguousarray(mat[mask])
+    return offs.tobytes() + flat.tobytes()
+
+
+def _wire_validity(valid: Optional[bytes], num_rows: int):
+    if valid is None:
+        return None
+    return np.frombuffer(valid, np.uint8, num_rows).astype(np.bool_)
+
+
 def _column_from_wire(
     type_id: int, scale: int, data: Optional[bytes],
     valid: Optional[bytes], num_rows: int,
 ) -> Column:
     if dt.TypeId(type_id) == dt.TypeId.LIST:
         # LIST wire convention: the scale slot carries the CHILD type id
-        # (scale is meaningless for LIST), and the data buffer is
-        # Arrow-shaped: int32 offsets[num_rows+1] then the concatenated
-        # child values. Decoded into the padded-matrix device layout.
-        child = dt.DType(dt.TypeId(scale))
-        offs = np.frombuffer(data, np.int32, num_rows + 1)
-        lens = np.diff(offs).astype(np.int32)
-        w = np.dtype(child.storage_dtype).itemsize
-        need = 4 * (num_rows + 1) + w * int(offs[-1])
-        if len(data) < need:
-            raise ValueError(
-                f"LIST wire buffer holds {len(data)} bytes, offsets "
-                f"require {need}"
-            )
-        flat = np.frombuffer(
-            data, np.dtype(child.storage_dtype),
-            count=int(offs[-1]),
-            offset=4 * (num_rows + 1),
-        )
-        pad = max(int(lens.max()) if num_rows else 1, 1)
-        mat = np.zeros((num_rows, pad), np.dtype(child.storage_dtype))
-        mask = np.arange(pad)[None, :] < lens[:, None]
-        mat[mask] = flat
-        v = (
-            None
-            if valid is None
-            else np.frombuffer(valid, np.uint8, num_rows).astype(np.bool_)
-        )
+        # (scale is meaningless for LIST); payload per _padded_from_offsets.
         import jax.numpy as jnp
 
+        child = dt.DType(dt.TypeId(scale))
+        mat, lens = _padded_from_offsets(
+            data, num_rows, np.dtype(child.storage_dtype), "LIST"
+        )
+        v = _wire_validity(valid, num_rows)
         dev = jnp.asarray(mat)
         if dev.dtype != mat.dtype:
             # x64 disabled: a silent int64->int32 downgrade would corrupt
@@ -94,6 +116,19 @@ def _column_from_wire(
             )
         return Column(
             dev, dt.DType(dt.TypeId.LIST),
+            None if v is None else jnp.asarray(v), jnp.asarray(lens),
+        )
+    if dt.TypeId(type_id) == dt.TypeId.STRING:
+        # STRING wire convention (the Arrow string layout cudf's JNI
+        # marshals): offsets + concatenated UTF-8 bytes.
+        import jax.numpy as jnp
+
+        mat, lens = _padded_from_offsets(
+            data, num_rows, np.dtype(np.uint8), "STRING"
+        )
+        v = _wire_validity(valid, num_rows)
+        return Column(
+            jnp.asarray(mat), dt.STRING,
             None if v is None else jnp.asarray(v), jnp.asarray(lens),
         )
     d = dt.DType(dt.TypeId(type_id), scale)
@@ -120,14 +155,22 @@ def _column_to_wire(c: Column):
     LIST columns use the convention documented in _column_from_wire:
     scale = child type id, data = int32 offsets then child values.
     """
+    if c.dtype.id == dt.TypeId.STRING:
+        valid = (
+            None
+            if c.validity is None
+            else np.asarray(c.validity).astype(np.uint8).tobytes()
+        )
+        return (
+            int(dt.TypeId.STRING),
+            0,
+            _padded_to_offsets(
+                np.asarray(c.data), np.asarray(c.lengths).astype(np.int32)
+            ),
+            valid,
+        )
     if c.dtype.id == dt.TypeId.LIST:
         child = c.list_child_dtype
-        mat = np.asarray(c.data)
-        lens = np.asarray(c.lengths).astype(np.int32)
-        offs = np.zeros((lens.shape[0] + 1,), np.int32)
-        np.cumsum(lens, out=offs[1:])
-        mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
-        flat = np.ascontiguousarray(mat[mask])
         valid = (
             None
             if c.validity is None
@@ -136,7 +179,9 @@ def _column_to_wire(c: Column):
         return (
             int(dt.TypeId.LIST),
             int(child.id),
-            offs.tobytes() + flat.tobytes(),
+            _padded_to_offsets(
+                np.asarray(c.data), np.asarray(c.lengths).astype(np.int32)
+            ),
             valid,
         )
     host = np.ascontiguousarray(np.asarray(c.data))
@@ -201,6 +246,34 @@ def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
             c for i, c in enumerate(table.columns) if i != mask_idx
         ]
         return ops.filter_table(Table(keep), mask)
+    if name == "distinct":
+        return ops.distinct(table, op.get("keys"))
+    if name == "cast":
+        target = dt.DType(dt.TypeId(op["type_id"]), op.get("scale", 0))
+        out = list(table.columns)
+        src = table.columns[op["column"]]
+        if src.dtype.is_string or target.is_string:
+            from .ops import strings as strings_mod
+
+            out[op["column"]] = strings_mod.cast(src, target)
+        else:
+            out[op["column"]] = ops.cast(src, target)
+        return Table(out, table.names)
+    if name == "explode":
+        return ops.explode(table, op["column"])
+    if name == "rlike":
+        # filter rows whose string column matches the pattern (the
+        # Spark `WHERE col RLIKE pat` scan shape)
+        from .ops import regex as regex_mod
+
+        mask = regex_mod.contains_re(
+            table.columns[op["column"]], op["pattern"]
+        )
+        return ops.filter_table(table, mask)
+    if name == "cross_join":
+        if not rest:
+            raise ValueError("cross_join needs two input tables")
+        return ops.cross_join(table, rest[0])
     if name == "to_rows":
         # device row transpose; result = a true LIST<UINT8> column (the
         # reference's output type, row_conversion.cu:389-406)

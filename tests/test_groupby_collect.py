@@ -148,3 +148,11 @@ def test_collect_unsupported_dtype_raises():
     t = Table.from_pydict({"k": [1], "s": ["x"]})
     with pytest.raises(TypeError):
         groupby_aggregate(t, ["k"], [GroupbyAgg("s", "collect_list")])
+
+
+def test_collect_bool_child_dtype():
+    t = Table.from_pydict({"k": [1, 1, 2], "v": [True, False, True]})
+    out = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "collect_list")])
+    lc = out.columns[1]
+    assert lc.list_child_dtype == dt.BOOL8
+    assert lc.to_pylist() == [[True, False], [True]]

@@ -577,3 +577,66 @@ class TestDistributedSortStrings:
                         bytes(mats[d, i, : lens[d, i]]).decode()
                     )
         assert got == sorted(vals)
+
+
+class TestBroadcastJoin:
+    def test_matches_host_oracle(self, mesh, rng):
+        import pandas as pd
+
+        n_fact, n_dim = 4_000, 64
+        fk = rng.integers(0, 100, n_fact, dtype=np.int64)
+        fv = rng.integers(-10, 10, n_fact, dtype=np.int64)
+        dk = rng.permutation(100)[:n_dim].astype(np.int64)
+        dv = rng.integers(0, 5, n_dim, dtype=np.int64)
+        fact = Table(
+            [Column.from_numpy(fk), Column.from_numpy(fv)], ["k", "fv"]
+        )
+        dim = Table(
+            [Column.from_numpy(dk), Column.from_numpy(dv)], ["k", "dv"]
+        )
+        out, counts = parallel.broadcast_inner_join(
+            fact, dim, ["k"], mesh
+        )
+        # collect valid rows from each device's prefix
+        per_dev = np.asarray(counts)
+        k_all = np.asarray(out["k"].data)
+        fv_all = np.asarray(out["fv"].data)
+        dv_all = np.asarray(out["dv"].data)
+        cap = k_all.shape[0] // 8
+        got = []
+        for d in range(8):
+            c = int(per_dev[d])
+            s = d * cap
+            got.extend(
+                zip(k_all[s : s + c], fv_all[s : s + c], dv_all[s : s + c])
+            )
+        want_df = pd.merge(
+            pd.DataFrame({"k": fk, "fv": fv}),
+            pd.DataFrame({"k": dk, "dv": dv}),
+            on="k",
+        )
+        want = list(
+            zip(want_df["k"].to_numpy(), want_df["fv"].to_numpy(),
+                want_df["dv"].to_numpy())
+        )
+        assert sorted(got) == sorted(want)
+
+    def test_null_keys_never_match(self, mesh):
+        fk = Column.from_numpy(
+            np.array([1, 2, 3, 4] * 8, dtype=np.int64),
+            validity=np.array([True, False, True, True] * 8),
+        )
+        fact = Table([fk], ["k"])
+        dim = Table.from_pydict({"k": [2, 3]})
+        out, counts = parallel.broadcast_inner_join(fact, dim, ["k"], mesh)
+        # valid fact keys are {1, 3, 4} (the 2s are null); dim has {2, 3},
+        # so only the eight 3s match — null keys never join
+        assert int(np.asarray(counts).sum()) == 8
+
+    def test_undersized_capacity_raises(self, mesh):
+        fact = Table.from_pydict({"k": [1] * 64})
+        dim = Table.from_pydict({"k": [1, 1, 1]})
+        with pytest.raises(parallel.distributed.JoinOverflowError):
+            parallel.broadcast_inner_join(
+                fact, dim, ["k"], mesh, out_capacity=2
+            )

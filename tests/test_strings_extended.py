@@ -368,3 +368,13 @@ class TestUrl:
         col = Column.from_strings(words)
         back = url_decode(url_encode(col)).to_pylist()
         assert back == words
+
+
+def test_url_encode_and_replace_re_empty_column():
+    from spark_rapids_jni_tpu.column import Column
+    from spark_rapids_jni_tpu.ops.regex import replace_re
+    from spark_rapids_jni_tpu.ops.strings import url_encode
+
+    col = Column.from_strings([])
+    assert url_encode(col).to_pylist() == []
+    assert replace_re(col, r"\d+", "#").to_pylist() == []
