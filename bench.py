@@ -285,6 +285,50 @@ def bench_join(platform, n=None):
     return e1
 
 
+def bench_join_batched(platform, n=None):
+    """Config 3a at 100M via the batched probe path. The single-shot
+    two-phase join graph (lexsort + lex-searchsorted fused in one jit)
+    hits a TPU worker kernel fault at >=32M rows with 64-bit keys
+    (reproduced standalone; 16M probes and 100M sorts are fine), so the
+    supported 100M path sorts the build side once and probes in 16M
+    chunks — the reference's split discipline applied to joins."""
+    import os
+
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.join import inner_join_batched
+
+    if n is None:
+        n = int(os.environ.get("SRT_BENCH_JOIN_ROWS", 100_000_000))
+    rng = np.random.default_rng(11)
+    kl = rng.integers(0, n, n, dtype=np.int64)
+    kr = rng.integers(0, n, n, dtype=np.int64)
+    vl = rng.integers(-100, 100, n, dtype=np.int64)
+    vr = rng.integers(-100, 100, n, dtype=np.int64)
+    left = Table(
+        [Column.from_numpy(kl), Column.from_numpy(vl)], ["k", "lv"]
+    )
+    right = Table(
+        [Column.from_numpy(kr), Column.from_numpy(vr)], ["k", "rv"]
+    )
+    jax.block_until_ready(left.columns[0].data)
+    jax.block_until_ready(right.columns[0].data)
+
+    def run(l, r):
+        return inner_join_batched(l, r, ["k"], probe_rows=16_000_000)
+
+    med, mn, std, out = _timeit(run, [(left, right)], reps_per_input=2)
+    matches = out.row_count
+    bytes_moved = 2 * n * 16 + matches * 24
+    e = _entry(
+        3, f"inner_join_{n // 1_000_000}M_batched_probe", 2 * n, med,
+        mn, std, bytes_moved, platform,
+    )
+    e["matches"] = matches
+    return e
+
+
 def bench_resident_chain(platform, n=4_000_000):
     """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
     through device-RESIDENT table handles vs the bytes-wire path that
@@ -477,6 +521,7 @@ _SUBPROCESS_CONFIGS = {
     "groupby100m": lambda p: bench_groupby(p, 100_000_000)[0],
     "transpose": bench_transpose,
     "join": bench_join,
+    "join_batched": bench_join_batched,
     "sort": bench_sort,
     "resident": bench_resident_chain,
     "parquet": bench_parquet_pipeline,
@@ -555,7 +600,7 @@ def main():
         _progress("device probe failed (tunnel down/hung): retrying once")
         alive = _probe_device()
     for key in ("groupby1m", "groupby16m", "groupby100m", "transpose",
-                "join", "sort", "resident", "parquet"):
+                "join_batched", "sort", "resident", "parquet"):
         if not alive:
             entries.append({"name": key, "error": "device unreachable"})
             continue

@@ -24,6 +24,7 @@ from .hashing import murmur3_column, murmur3_table
 from .groupby import groupby_aggregate, GroupbyAgg
 from .join import (
     inner_join,
+    inner_join_batched,
     left_join,
     right_join,
     full_join,
@@ -41,6 +42,7 @@ from .copying import (
     sequence,
     cross_join,
     scatter,
+    slice_rows,
     split,
     sample,
 )
@@ -115,6 +117,7 @@ __all__ = [
     "groupby_aggregate",
     "GroupbyAgg",
     "inner_join",
+    "inner_join_batched",
     "left_join",
     "right_join",
     "full_join",
@@ -131,6 +134,7 @@ __all__ = [
     "sequence",
     "cross_join",
     "scatter",
+    "slice_rows",
     "split",
     "sample",
     "replace_nulls",

@@ -189,6 +189,25 @@ def scatter(source: Table, indices, target: Table) -> Table:
     return Table(out_cols, target.names)
 
 
+def slice_rows(table: Table, start: int, stop: int) -> Table:
+    """Zero-copy row range [start, stop) of every column (cudf
+    ``slice``). The single place the per-Column data/validity/lengths
+    slicing lives — chunked joins, split, and empty-schema fast paths
+    all use it."""
+    return Table(
+        [
+            Column(
+                c.data[start:stop],
+                c.dtype,
+                None if c.validity is None else c.validity[start:stop],
+                None if c.lengths is None else c.lengths[start:stop],
+            )
+            for c in table.columns
+        ],
+        table.names,
+    )
+
+
 def split(table: Table, splits: Sequence[int]) -> list[Table]:
     """Partition rows at the given boundaries (cudf ``Table.split`` /
     ``contiguous_split``, the mechanism behind the reference's 2 GB
@@ -198,19 +217,7 @@ def split(table: Table, splits: Sequence[int]) -> list[Table]:
     for a, b in zip(bounds, bounds[1:]):
         if not (0 <= a <= b <= n):
             raise ValueError(f"split: bad boundaries {splits}")
-    out = []
-    for a, b in zip(bounds, bounds[1:]):
-        cols = [
-            Column(
-                c.data[a:b],
-                c.dtype,
-                None if c.validity is None else c.validity[a:b],
-                None if c.lengths is None else c.lengths[a:b],
-            )
-            for c in table.columns
-        ]
-        out.append(Table(cols, table.names))
-    return out
+    return [slice_rows(table, a, b) for a, b in zip(bounds, bounds[1:])]
 
 
 def sample(table: Table, n: int, seed: int = 0,

@@ -446,6 +446,33 @@ def groupby_aggregate(
     """Eager groupby with exact output size (one host sync). Collect
     aggregations without an explicit ``list_capacity`` get sized from
     the largest group's valid-row count (a cheap count pre-pass)."""
+    if table.row_count == 0:
+        # 0 rows -> 0 groups, but the output SCHEMA must still be exact:
+        # run the real pipeline on one all-null dummy row (which forms
+        # one null-key group) and slice it away
+        dummy_cols = [
+            Column(
+                jnp.zeros((1,) + c.data.shape[1:], c.data.dtype),
+                c.dtype,
+                jnp.zeros((1,), jnp.bool_),
+                None
+                if c.lengths is None
+                else jnp.zeros((1,), c.lengths.dtype),
+            )
+            for c in table.columns
+        ]
+        aggs = [
+            dataclasses.replace(a, list_capacity=a.list_capacity or 1)
+            if a.op in _COLLECT_OPS
+            else a
+            for a in aggs
+        ]
+        padded, _ = groupby_aggregate_capped(
+            Table(dummy_cols, table.names), by, aggs, num_segments=1
+        )
+        from .copying import slice_rows
+
+        return slice_rows(padded, 0, 0)
     needs = [
         a for a in aggs
         if a.op in _COLLECT_OPS and a.list_capacity is None

@@ -16,6 +16,7 @@ still emit their left rows with a null right side.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Union
 
 import jax
@@ -73,6 +74,43 @@ def _lex_searchsorted(
     return lo
 
 
+def _prepare_build(
+    right: Table,
+    right_on: Sequence[Union[int, str]],
+    right_valid: Optional[jax.Array] = None,
+):
+    """Sort the build side once: (perm_r, sorted key words). Invalid
+    rows sink to the front on the leading validity word (0 < 1), outside
+    the range any valid probe (lead word 1) can reach — reusable across
+    any number of probe batches."""
+    rcols = [right.column(c) for c in right_on]
+    rwords, rvalid = _key_words(rcols)
+    if right_valid is not None:
+        rvalid = rvalid & right_valid
+    rsort_words = [rvalid.astype(jnp.uint64)] + rwords
+    perm_r = jnp.lexsort(rsort_words[::-1])
+    sorted_words = [w[perm_r] for w in rsort_words]
+    return perm_r, sorted_words
+
+
+def _probe_build(
+    sorted_words,
+    left: Table,
+    left_on: Sequence[Union[int, str]],
+    left_valid: Optional[jax.Array] = None,
+):
+    """Binary-search the prepared build side: (lo, counts, lvalid)."""
+    lcols = [left.column(c) for c in left_on]
+    lwords, lvalid = _key_words(lcols)
+    if left_valid is not None:
+        lvalid = lvalid & left_valid
+    qwords = [jnp.ones_like(lvalid, dtype=jnp.uint64)] + lwords
+    lo = _lex_searchsorted(sorted_words, qwords, "left")
+    hi = _lex_searchsorted(sorted_words, qwords, "right")
+    counts = jnp.where(lvalid, hi - lo, 0)
+    return lo, counts, lvalid
+
+
 def _match_ranges(
     left: Table,
     right: Table,
@@ -89,27 +127,10 @@ def _match_ranges(
     ahead of every valid row on the leading validity word (0 < 1), outside
     the range any valid query (probing with lead word 1) can reach.
     """
-    lcols = [left.column(c) for c in left_on]
-    rcols = [right.column(c) for c in right_on]
-    lwords, lvalid = _key_words(lcols)
-    rwords, rvalid = _key_words(rcols)
-    if left_valid is not None:
-        lvalid = lvalid & left_valid
-    if right_valid is not None:
-        rvalid = rvalid & right_valid
-
-    # sort right by (valid, words) so invalid rows sink to the front and
-    # can never fall inside a valid query's range
-    rsort_words = [rvalid.astype(jnp.uint64)] + rwords
-    perm_r = jnp.lexsort(rsort_words[::-1])
-    sorted_words = [w[perm_r] for w in rsort_words]
-    # query with valid=1 so the search space is the valid suffix; invalid
-    # left rows get their counts zeroed below regardless
-    qwords = [jnp.ones_like(lvalid, dtype=jnp.uint64)] + lwords
-
-    lo = _lex_searchsorted(sorted_words, qwords, "left")
-    hi = _lex_searchsorted(sorted_words, qwords, "right")
-    counts = jnp.where(lvalid, hi - lo, 0)
+    perm_r, sorted_words = _prepare_build(right, right_on, right_valid)
+    lo, counts, lvalid = _probe_build(
+        sorted_words, left, left_on, left_valid
+    )
     return perm_r, lo, counts, lvalid
 
 
@@ -234,6 +255,97 @@ def inner_join(
         perm_r, lo, counts, total, left_outer=False
     )
     return _join_output(left, right, right_on, left_idx, right_idx, None, None)
+
+
+@functools.lru_cache(maxsize=64)
+def _batched_prep_fn(right_on: tuple):
+    return jax.jit(lambda r: _prepare_build(r, list(right_on)))
+
+
+@functools.lru_cache(maxsize=64)
+def _batched_probe_fn(on: tuple):
+    return jax.jit(
+        lambda sw, chunk: _probe_build(list(sw), chunk, list(on))[:2]
+    )
+
+
+@jax.jit
+def _count_total(counts):
+    return jnp.sum(counts)
+
+
+@functools.lru_cache(maxsize=256)
+def _batched_materialize_fn(right_on: tuple, cap: int):
+    def fn(perm_r, lo, counts, chunk, r):
+        left_idx, right_idx, matched, in_range = _expand(
+            perm_r, lo, counts, cap, left_outer=False
+        )
+        return _join_output(
+            chunk, r, list(right_on), left_idx, right_idx, matched,
+            in_range,
+        )
+
+    return jax.jit(fn)
+
+
+def inner_join_batched(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+    probe_rows: int = 16_000_000,
+) -> Table:
+    """Eager inner join, probe side processed in ``probe_rows`` batches.
+
+    The single-shot join at 100M×100M rows needs both sides, the sorted
+    build words, AND the expanded output resident at once — past the HBM
+    of one chip (observed: the v5e worker dies). This is the reference's
+    own batching discipline (2 GB splits, row_conversion.cu:505-511)
+    applied to the probe side: the build side is sorted ONCE and every
+    probe batch binary-searches it, materializing only its own slice of
+    the output. Equal batch shapes reuse one compiled executable."""
+    from .copying import concatenate, slice_rows
+
+    right_on = right_on or on
+    n = left.row_count
+
+    def empty_result():
+        # empty output with the exact join schema — no build-side sort
+        z = jnp.zeros((0,), jnp.int32)
+        return _join_output(
+            slice_rows(left, 0, 0), right, right_on, z, z,
+            jnp.zeros((0,), jnp.bool_), jnp.zeros((0,), jnp.bool_),
+        )
+
+    if n == 0 or right.row_count == 0:
+        return empty_result()
+
+    # two jitted stages per chunk (NOT eager op-by-op: each eager
+    # dispatch pays a full host<->device round trip — ~100s at 32M over
+    # the tunnel). The jitted helpers are cached at module level keyed
+    # by the key columns / capacity bucket, so compile caches hit
+    # across chunks, repetitions, AND separate calls.
+    on_key = tuple(on)
+    ron_key = tuple(right_on)
+    perm_r, sorted_words = _batched_prep_fn(ron_key)(right)
+    sorted_words = tuple(sorted_words)
+    probe = _batched_probe_fn(on_key)
+    pieces = []
+    for start in range(0, n, probe_rows):
+        stop = min(start + probe_rows, n)
+        chunk = slice_rows(left, start, stop)
+        lo, counts = probe(sorted_words, chunk)
+        total = int(_count_total(counts))
+        if total == 0:
+            continue
+        cap = max(32, 1 << (total - 1).bit_length())  # pow2 bucket
+        padded = _batched_materialize_fn(ron_key, cap)(
+            perm_r, lo, counts, chunk, right
+        )
+        pieces.append(slice_rows(padded, 0, total))
+    if not pieces:
+        return empty_result()
+    return concatenate(pieces) if len(pieces) > 1 else pieces[0]
 
 
 def left_join(

@@ -156,3 +156,18 @@ def test_collect_bool_child_dtype():
     lc = out.columns[1]
     assert lc.list_child_dtype == dt.BOOL8
     assert lc.to_pylist() == [[True, False], [True]]
+
+
+def test_empty_table_groupby():
+    t = Table.from_pydict({
+        "k": np.array([], dtype=np.int64),
+        "v": np.array([], dtype=np.int64),
+    })
+    out = groupby_aggregate(
+        t, ["k"],
+        [GroupbyAgg("v", "sum"), GroupbyAgg("v", "collect_list")],
+    )
+    assert out.row_count == 0
+    assert out["k"].to_pylist() == []
+    assert list(out.names) == ["k", "sum_v", "collect_list_v"]
+    assert out.columns[2].dtype.id == dt.TypeId.LIST

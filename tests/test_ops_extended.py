@@ -472,3 +472,46 @@ class TestTableCopyOps:
         import pytest as _pytest
         with _pytest.raises(ValueError):
             sample(t, 101)
+
+
+class TestBatchedJoin:
+    def test_matches_single_shot(self, rng):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column, Table
+        from spark_rapids_jni_tpu.ops import inner_join, inner_join_batched
+
+        n = 10_000
+        kl = rng.integers(0, 3_000, n, dtype=np.int64)
+        kr = rng.integers(0, 3_000, n, dtype=np.int64)
+        vl = rng.integers(-9, 9, n, dtype=np.int64)
+        vr = rng.integers(-9, 9, n, dtype=np.int64)
+        lv = rng.random(n) > 0.05
+        left = Table(
+            [Column.from_numpy(kl, validity=lv), Column.from_numpy(vl)],
+            ["k", "lv"],
+        )
+        right = Table(
+            [Column.from_numpy(kr), Column.from_numpy(vr)], ["k", "rv"]
+        )
+        whole = inner_join(left, right, ["k"])
+        batched = inner_join_batched(left, right, ["k"], probe_rows=1024)
+        def rows(t):
+            return sorted(zip(t["k"].to_pylist(), t["lv"].to_pylist(),
+                              t["rv"].to_pylist()))
+        assert rows(batched) == rows(whole)
+        assert batched.row_count == whole.row_count
+
+    def test_no_matches_and_empty(self, rng):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import inner_join_batched
+
+        left = Table.from_pydict({"k": [1, 2, 3]})
+        right = Table.from_pydict({"k": [9, 8]})
+        out = inner_join_batched(left, right, ["k"], probe_rows=2)
+        assert out.row_count == 0
+        empty = Table.from_pydict({"k": np.array([], dtype=np.int64)})
+        out2 = inner_join_batched(empty, right, ["k"])
+        assert out2.row_count == 0

@@ -1,0 +1,54 @@
+"""Standalone repro: TPU worker kernel fault in the fused join-count graph.
+
+On the tunneled v5e ('axon') platform, ONE jit containing
+  64-bit key normalization -> jnp.lexsort -> two lex-searchsorted
+  binary-search loops -> masked sum
+crashes the TPU worker ("TPU worker process crashed or restarted...
+kernel fault") at n >= 32M rows. Each piece is fine in isolation at the
+same or larger sizes (lexsort alone passes at 100M, the searchsorted
+loop alone passes at 32M, and the identical graph passes at 16M or with
+32-bit keys), so this is an XLA TPU codegen/runtime fault of the fused
+graph, not HBM exhaustion.
+
+Consequence for the framework: ops/join.py:inner_join_batched sorts the
+build side in its own jit and probes in 16M-row chunks — the same
+batching discipline the reference applies at INT_MAX bytes
+(row_conversion.cu:505-511) — and bench.py uses it for the 100M config.
+
+Run: python tools/xla_join_fault_repro.py 32000000   # crashes the worker
+     python tools/xla_join_fault_repro.py 16000000   # passes
+"""
+
+import sys
+
+import spark_rapids_jni_tpu  # noqa: F401  (enables x64 before array creation)
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.ops.join import _lex_searchsorted
+
+
+def main(n: int) -> None:
+    rng = np.random.default_rng(11)
+    sign = jnp.uint64(0x8000000000000000)
+    kl = jnp.asarray(rng.integers(0, n, n, dtype=np.int64))
+    kr = jnp.asarray(rng.integers(0, n, n, dtype=np.int64))
+    jax.block_until_ready(kr)
+
+    def count(kld, krd):
+        lw = kld.astype(jnp.uint64) ^ sign
+        rw = krd.astype(jnp.uint64) ^ sign
+        ones_r = jnp.ones_like(rw)
+        perm = jnp.lexsort([ones_r, rw][::-1])
+        sw = [ones_r[perm], rw[perm]]
+        qw = [jnp.ones_like(lw), lw]
+        lo = _lex_searchsorted(sw, qw, "left")
+        hi = _lex_searchsorted(sw, qw, "right")
+        return jnp.where(jnp.ones_like(lw, dtype=bool), hi - lo, 0).sum()
+
+    print("total:", int(jax.jit(count)(kl, kr)))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 32_000_000)
