@@ -227,21 +227,12 @@ def bench_sort(platform, n=100_000_000):
                   n * 16 * 2, platform)
 
 
-def bench_join(platform, n=None):
-    """Config 3a: two-phase hash inner join at 100M rows (override
-    via SRT_BENCH_JOIN_ROWS for crash triage)."""
-    import os
-
+def _join_inputs(n):
+    """Shared config-3 join workload: both benches must measure the
+    same data shape."""
     import jax
 
-    if n is None:
-        n = int(os.environ.get("SRT_BENCH_JOIN_ROWS", 100_000_000))
-
     from spark_rapids_jni_tpu.column import Column, Table
-    from spark_rapids_jni_tpu.ops.join import (
-        inner_join_capped,
-        inner_join_count,
-    )
 
     rng = np.random.default_rng(11)
     kl = rng.integers(0, n, n, dtype=np.int64)
@@ -256,6 +247,25 @@ def bench_join(platform, n=None):
     )
     jax.block_until_ready(left.columns[0].data)
     jax.block_until_ready(right.columns[0].data)
+    return left, right
+
+
+def bench_join(platform, n=None):
+    """Config 3a: two-phase hash inner join at 100M rows (override
+    via SRT_BENCH_JOIN_ROWS for crash triage)."""
+    import os
+
+    import jax
+
+    if n is None:
+        n = int(os.environ.get("SRT_BENCH_JOIN_ROWS", 100_000_000))
+
+    from spark_rapids_jni_tpu.ops.join import (
+        inner_join_capped,
+        inner_join_count,
+    )
+
+    left, right = _join_inputs(n)
 
     count_fn = jax.jit(lambda l, r: inner_join_count(l, r, ["k"]))
     total = int(count_fn(left, right))
@@ -294,26 +304,11 @@ def bench_join_batched(platform, n=None):
     chunks — the reference's split discipline applied to joins."""
     import os
 
-    import jax
-
-    from spark_rapids_jni_tpu.column import Column, Table
     from spark_rapids_jni_tpu.ops.join import inner_join_batched
 
     if n is None:
         n = int(os.environ.get("SRT_BENCH_JOIN_ROWS", 100_000_000))
-    rng = np.random.default_rng(11)
-    kl = rng.integers(0, n, n, dtype=np.int64)
-    kr = rng.integers(0, n, n, dtype=np.int64)
-    vl = rng.integers(-100, 100, n, dtype=np.int64)
-    vr = rng.integers(-100, 100, n, dtype=np.int64)
-    left = Table(
-        [Column.from_numpy(kl), Column.from_numpy(vl)], ["k", "lv"]
-    )
-    right = Table(
-        [Column.from_numpy(kr), Column.from_numpy(vr)], ["k", "rv"]
-    )
-    jax.block_until_ready(left.columns[0].data)
-    jax.block_until_ready(right.columns[0].data)
+    left, right = _join_inputs(n)
 
     def run(l, r):
         return inner_join_batched(l, r, ["k"], probe_rows=16_000_000)

@@ -264,25 +264,27 @@ def _batched_prep_fn(right_on: tuple):
 
 @functools.lru_cache(maxsize=64)
 def _batched_probe_fn(on: tuple):
-    return jax.jit(
-        lambda sw, chunk: _probe_build(list(sw), chunk, list(on))[:2]
-    )
+    def fn(sw, chunk):
+        lo, counts, _ = _probe_build(list(sw), chunk, list(on))
+        # the chunk total rides the same dispatch — a separate jitted
+        # sum would cost one more tunnel round trip per chunk
+        return lo, counts, jnp.sum(counts)
 
-
-@jax.jit
-def _count_total(counts):
-    return jnp.sum(counts)
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
 def _batched_materialize_fn(right_on: tuple, cap: int):
     def fn(perm_r, lo, counts, chunk, r):
-        left_idx, right_idx, matched, in_range = _expand(
+        left_idx, right_idx, _, _ = _expand(
             perm_r, lo, counts, cap, left_outer=False
         )
+        # no matched/row_valid masks: rows past the chunk total are
+        # sliced away by the caller, and passing masks here would hang
+        # an all-True validity on right columns that the single-shot
+        # inner_join leaves as None (schema parity)
         return _join_output(
-            chunk, r, list(right_on), left_idx, right_idx, matched,
-            in_range,
+            chunk, r, list(right_on), left_idx, right_idx, None, None
         )
 
     return jax.jit(fn)
@@ -307,6 +309,8 @@ def inner_join_batched(
     from .copying import concatenate, slice_rows
 
     right_on = right_on or on
+    if probe_rows <= 0:
+        raise ValueError(f"probe_rows must be positive, got {probe_rows}")
     n = left.row_count
 
     def empty_result():
@@ -334,8 +338,8 @@ def inner_join_batched(
     for start in range(0, n, probe_rows):
         stop = min(start + probe_rows, n)
         chunk = slice_rows(left, start, stop)
-        lo, counts = probe(sorted_words, chunk)
-        total = int(_count_total(counts))
+        lo, counts, total_dev = probe(sorted_words, chunk)
+        total = int(total_dev)
         if total == 0:
             continue
         cap = max(32, 1 << (total - 1).bit_length())  # pow2 bucket

@@ -515,3 +515,28 @@ class TestBatchedJoin:
         empty = Table.from_pydict({"k": np.array([], dtype=np.int64)})
         out2 = inner_join_batched(empty, right, ["k"])
         assert out2.row_count == 0
+
+
+def test_batched_join_rejects_bad_probe_rows():
+    from spark_rapids_jni_tpu.column import Table
+    from spark_rapids_jni_tpu.ops import inner_join_batched
+    import pytest as _pytest
+
+    l = Table.from_pydict({"k": [1]})
+    r = Table.from_pydict({"k": [1]})
+    with _pytest.raises(ValueError):
+        inner_join_batched(l, r, ["k"], probe_rows=-1)
+    with _pytest.raises(ValueError):
+        inner_join_batched(l, r, ["k"], probe_rows=0)
+
+
+def test_batched_join_schema_parity_with_single_shot():
+    from spark_rapids_jni_tpu.column import Table
+    from spark_rapids_jni_tpu.ops import inner_join, inner_join_batched
+
+    l = Table.from_pydict({"k": [1, 2], "lv": [7, 8]})
+    r = Table.from_pydict({"k": [1, 2], "rv": [5, 6]})
+    a = inner_join(l, r, ["k"])
+    b = inner_join_batched(l, r, ["k"], probe_rows=1)
+    for ca, cb in zip(a.columns, b.columns):
+        assert (ca.validity is None) == (cb.validity is None)
