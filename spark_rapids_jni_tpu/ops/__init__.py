@@ -63,6 +63,10 @@ from .window import (
     lead,
     lag,
     row_number,
+    rank,
+    dense_rank,
+    percent_rank,
+    ntile,
 )
 from .quantiles import quantile
 from . import lists, regex
@@ -155,6 +159,10 @@ __all__ = [
     "lead",
     "lag",
     "row_number",
+    "rank",
+    "dense_rank",
+    "percent_rank",
+    "ntile",
     "quantile",
     "lists",
     "count_elements",

@@ -299,18 +299,147 @@ def row_number(
     """1-based rank within each partition, in the table's original row
     order (Spark ROW_NUMBER)."""
     from .gather import gather_column
+
+    _, starts, _, inv, idx = _window_scaffold(
+        table, partition_by, order_by
+    )
+    rn_sorted = idx - starts + 1
+    return gather_column(Column(rn_sorted, dt.INT32, None), inv)
+
+
+def _change_boundaries(table: Table, keys: Sequence) -> jnp.ndarray:
+    """(n,) bool: row i starts a new run of the key columns. Null rows
+    compare EQUAL to each other (payload words are zeroed under the
+    validity mask and the mask itself is a word) — the SQL tie rule for
+    NULL order keys, and the same normalization _partition_bounds uses."""
+    n = table.row_count
+    boundary = jnp.zeros((n,), jnp.bool_)
+    for c in (table.column(k) for k in keys):
+        cwords = column_order_keys(c)
+        if c.validity is not None:
+            cwords = [
+                jnp.where(c.validity, w, jnp.uint64(0)) for w in cwords
+            ]
+            cwords.append(c.validity.astype(jnp.uint64))
+        for w in cwords:
+            boundary = jnp.logical_or(
+                boundary,
+                jnp.concatenate(
+                    [jnp.ones((1,), jnp.bool_), w[1:] != w[:-1]]
+                ),
+            )
+    return boundary
+
+
+def _window_scaffold(table: Table, partition_by, order_by):
+    """Shared sort scaffolding for the ranking family: the table sorted
+    by (partition, order) keys, per-row partition [start, end), and the
+    inverse permutation back to the original row order."""
+    from .gather import gather_table
     from .sort import SortKey, argsort_table
 
     n = table.row_count
-    perm = argsort_table(
-        table, [SortKey(k) for k in [*partition_by, *order_by]]
-    )
-    from .gather import gather_table
-
+    sort_keys = [SortKey(k) for k in [*partition_by, *order_by]]
+    perm = argsort_table(table, sort_keys)
     sorted_t = gather_table(table, perm)
-    starts, _ = _partition_bounds(sorted_t, partition_by)
-    rn_sorted = jnp.arange(n, dtype=jnp.int32) - starts + 1
-    inv = jnp.zeros((n,), jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))
+    starts, ends = _partition_bounds(sorted_t, partition_by)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    inv = jnp.zeros((n,), jnp.int32).at[perm].set(idx)
+    return sorted_t, starts, ends, inv, idx
+
+
+def _rank_sorted(table: Table, partition_by, order_by, kind: str):
+    """Shared rank machinery: returns the rank vector in sorted order
+    plus the inverse permutation back to table order."""
+    n = table.row_count
+    sorted_t, starts, ends, inv, idx = _window_scaffold(
+        table, partition_by, order_by
+    )
+    # tie boundary: any (partition + order) key run changes — the
+    # partition-key words are part of the set, so partition starts are
+    # boundaries too
+    boundary = _change_boundaries(
+        sorted_t, [*partition_by, *order_by]
+    )
+
+    if kind == "rank":
+        # rank = position of the tie group's first row within partition
+        group_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+        r = group_start - starts + 1
+    elif kind == "dense_rank":
+        # count of tie boundaries since the partition start (inclusive)
+        cum_b = jnp.cumsum(boundary.astype(jnp.int32))
+        cum_at_start = cum_b[jnp.clip(starts, 0, max(n - 1, 0))]
+        r = cum_b - cum_at_start + 1
+    else:
+        raise ValueError(f"unknown rank kind {kind!r}")
+    return r.astype(jnp.int32), inv, starts, ends
+
+
+def rank(table: Table, partition_by: Sequence, order_by: Sequence) -> Column:
+    """SQL RANK(): 1-based with gaps after ties (Spark/cudf rank),
+    returned in the table's original row order."""
+    from .gather import gather_column
+
+    r, inv, _, _ = _rank_sorted(table, partition_by, order_by, "rank")
+    return gather_column(Column(r, dt.INT32, None), inv)
+
+
+def dense_rank(
+    table: Table, partition_by: Sequence, order_by: Sequence
+) -> Column:
+    """SQL DENSE_RANK(): 1-based, no gaps after ties."""
+    from .gather import gather_column
+
+    r, inv, _, _ = _rank_sorted(
+        table, partition_by, order_by, "dense_rank"
+    )
+    return gather_column(Column(r, dt.INT32, None), inv)
+
+
+def percent_rank(
+    table: Table, partition_by: Sequence, order_by: Sequence
+) -> Column:
+    """SQL PERCENT_RANK(): (rank - 1) / (partition rows - 1); 0.0 for
+    single-row partitions (Spark semantics)."""
+    from .gather import gather_column
+
+    r, inv, starts, ends = _rank_sorted(
+        table, partition_by, order_by, "rank"
+    )
+    size = (ends - starts).astype(jnp.float64)
+    pr = jnp.where(
+        size > 1, (r - 1).astype(jnp.float64) / jnp.maximum(size - 1, 1), 0.0
+    )
+    from . import compute
+
+    out_sorted = compute.from_values(pr, dt.FLOAT64, None)
+    return gather_column(out_sorted, inv)
+
+
+def ntile(
+    table: Table, partition_by: Sequence, order_by: Sequence, n_tiles: int
+) -> Column:
+    """SQL NTILE(n): 1-based bucket of each row within its partition,
+    larger buckets first when rows don't divide evenly (Spark/cudf)."""
+    from .gather import gather_column
+
+    if n_tiles <= 0:
+        raise ValueError("ntile: n_tiles must be positive")
+    _, starts, ends, inv, idx = _window_scaffold(
+        table, partition_by, order_by
+    )
+    pos = idx - starts  # 0-based position within partition
+    size = ends - starts
+    base = size // n_tiles
+    rem = size % n_tiles
+    # first `rem` buckets have base+1 rows
+    big_span = rem * (base + 1)
+    tile = jnp.where(
+        pos < big_span,
+        pos // jnp.maximum(base + 1, 1),
+        rem + (pos - big_span) // jnp.maximum(base, 1),
+    )
     return gather_column(
-        Column(rn_sorted, dt.INT32, None), inv
+        Column((tile + 1).astype(jnp.int32), dt.INT32, None), inv
     )

@@ -202,3 +202,94 @@ class TestVariance:
             else:
                 assert vv == pytest.approx(want[kk])
                 assert ss == pytest.approx(np.sqrt(want[kk]))
+
+
+class TestRankFamily:
+    def _table(self, rng, n=2_000):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+
+        return Table.from_pydict({
+            "p": rng.integers(0, 20, n),
+            "v": rng.integers(0, 30, n),  # many ties
+        })
+
+    def test_rank_vs_pandas(self, rng):
+        import numpy as np
+        import pandas as pd
+
+        from spark_rapids_jni_tpu.ops import dense_rank, rank
+
+        t = self._table(rng)
+        df = pd.DataFrame(t.to_pydict())
+        got_r = np.asarray(rank(t, ["p"], ["v"]).data)
+        want_r = df.groupby("p")["v"].rank(method="min").astype(int)
+        np.testing.assert_array_equal(got_r, want_r.to_numpy())
+        got_d = np.asarray(dense_rank(t, ["p"], ["v"]).data)
+        want_d = df.groupby("p")["v"].rank(method="dense").astype(int)
+        np.testing.assert_array_equal(got_d, want_d.to_numpy())
+
+    def test_percent_rank_vs_pandas(self, rng):
+        import numpy as np
+        import pandas as pd
+
+        from spark_rapids_jni_tpu.ops import percent_rank
+
+        t = self._table(rng, n=500)
+        df = pd.DataFrame(t.to_pydict())
+        got = percent_rank(t, ["p"], ["v"]).to_numpy()
+        # pandas pct uses rank/size; SQL percent_rank is (rank-1)/(size-1)
+        r = df.groupby("p")["v"].rank(method="min")
+        size = df.groupby("p")["v"].transform("size")
+        want = np.where(size > 1, (r - 1) / np.maximum(size - 1, 1), 0.0)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    def test_ntile(self, rng):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import ntile
+
+        # one partition of 10 rows into 4 tiles -> sizes 3,3,2,2
+        t = Table.from_pydict({
+            "p": [0] * 10,
+            "v": list(range(10)),
+        })
+        got = np.asarray(ntile(t, ["p"], ["v"], 4).data)
+        assert got.tolist() == [1, 1, 1, 2, 2, 2, 3, 3, 4, 4]
+        # more tiles than rows: each row its own bucket
+        t2 = Table.from_pydict({"p": [0] * 3, "v": [2, 0, 1]})
+        got2 = np.asarray(ntile(t2, ["p"], ["v"], 8).data)
+        assert got2.tolist() == [3, 1, 2]
+
+    def test_rank_jit(self, rng):
+        import jax
+        import numpy as np
+
+        from spark_rapids_jni_tpu.ops import rank
+
+        t = self._table(rng, n=256)
+        f = jax.jit(lambda tt: rank(tt, ["p"], ["v"]).data)
+        got = np.asarray(f(t))
+        assert got.min() == 1
+
+    def test_rank_null_order_keys_tie(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column, Table
+        from spark_rapids_jni_tpu.ops import dense_rank, rank
+
+        # two null order keys carrying DIFFERENT garbage payloads must
+        # still tie (SQL: all NULLs in the order key share a rank)
+        v = Column.from_numpy(
+            np.array([111, 999, 5], dtype=np.int64),
+            validity=np.array([False, False, True]),
+        )
+        p = Column.from_numpy(np.zeros(3, dtype=np.int64))
+        t = Table([p, v], ["p", "v"])
+        r = np.asarray(rank(t, ["p"], ["v"]).data)
+        d = np.asarray(dense_rank(t, ["p"], ["v"]).data)
+        # nulls sort first (ascending default): both get rank 1
+        assert r.tolist() == [1, 1, 3]
+        assert d.tolist() == [1, 1, 2]
