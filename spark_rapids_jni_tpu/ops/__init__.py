@@ -41,6 +41,7 @@ from .copying import (
     copy_if_else,
     sequence,
     cross_join,
+    repeat,
     scatter,
     slice_rows,
     split,
@@ -137,6 +138,7 @@ __all__ = [
     "copy_if_else",
     "sequence",
     "cross_join",
+    "repeat",
     "scatter",
     "slice_rows",
     "split",

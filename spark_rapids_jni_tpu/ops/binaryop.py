@@ -34,12 +34,14 @@ _ARITH_OPS = {
     "true_div",
     "floor_div",
     "mod",
+    "pmod",
     "pow",
     "bitand",
     "bitor",
     "bitxor",
     "shiftleft",
     "shiftright",
+    "shiftright_unsigned",
 }
 
 
@@ -182,11 +184,32 @@ def binary_op(op: str, a: Column, b: Column) -> Column:
             res = jnp.where(zero, 0, av // jnp.where(zero, 1, bv))
             valid = ~zero if valid is None else jnp.logical_and(valid, ~zero)
     elif op == "mod":
+        # Spark % / cudf MOD: C/Java-style — result carries the
+        # DIVIDEND's sign (jnp.mod is Python-style and would differ for
+        # mixed signs: -7 % 3 is -1 in Spark, 2 in Python)
         if is_float:
-            res = jnp.mod(av, bv)
+            res = jnp.fmod(av, bv)
         else:
             zero = bv == 0
-            res = jnp.where(zero, 0, av % jnp.where(zero, 1, bv))
+            res = jnp.where(
+                zero, 0, jax.lax.rem(av, jnp.where(zero, 1, bv))
+            )
+            valid = ~zero if valid is None else jnp.logical_and(valid, ~zero)
+    elif op == "pmod":
+        # Spark Pmod: r = a % n (Java %); negative remainders are
+        # corrected to (r + n) % n, non-negative ones returned as-is
+        # (so pmod(7, -3) = 1, pmod(-7, 3) = 2, pmod(-7, -3) = -1)
+        if is_float:
+            m = jnp.fmod(av, bv)
+            res = jnp.where(m < 0, jnp.fmod(m + bv, bv), m)
+        else:
+            zero = bv == 0
+            safe = jnp.where(zero, 1, bv)
+            m = jax.lax.rem(av, safe)
+            res = jnp.where(
+                zero, 0,
+                jnp.where(m < 0, jax.lax.rem(m + safe, safe), m),
+            )
             valid = ~zero if valid is None else jnp.logical_and(valid, ~zero)
     elif op == "pow":
         res = jnp.power(av, bv)
@@ -200,6 +223,16 @@ def binary_op(op: str, a: Column, b: Column) -> Column:
         res = av << bv
     elif op == "shiftright":
         res = av >> bv
+    elif op == "shiftright_unsigned":
+        # logical shift: reinterpret at the SAME width as unsigned so
+        # the vacated high bits fill with zeros for any int width
+        kind = np.dtype(str(av.dtype))
+        if kind.kind == "i":
+            u = np.dtype(f"uint{kind.itemsize * 8}")
+            shifted = jax.lax.bitcast_convert_type(av, u) >> bv.astype(u)
+            res = jax.lax.bitcast_convert_type(shifted, kind)
+        else:
+            res = av >> bv
     else:  # pragma: no cover
         raise AssertionError(op)
 

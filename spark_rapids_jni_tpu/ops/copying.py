@@ -220,6 +220,31 @@ def split(table: Table, splits: Sequence[int]) -> list[Table]:
     return [slice_rows(table, a, b) for a, b in zip(bounds, bounds[1:])]
 
 
+def repeat(table: Table, counts) -> Table:
+    """Each row i replicated ``counts[i]`` times, in order (cudf
+    ``Table.repeat``). A scalar count repeats every row that many times
+    (jittable: static output size); a per-row count vector is eager
+    (host-syncs the total, the cudf call model)."""
+    from .gather import gather_table
+
+    n = table.row_count
+    if np.isscalar(counts):
+        k = int(counts)
+        if k < 0:
+            raise ValueError("repeat: count must be non-negative")
+        idx = jnp.repeat(
+            jnp.arange(n, dtype=jnp.int32), k, total_repeat_length=n * k
+        )
+        return gather_table(table, idx)
+    c = np.asarray(counts)
+    if c.shape != (n,):
+        raise ValueError(f"repeat: counts shape {c.shape} != ({n},)")
+    if (c < 0).any():
+        raise ValueError("repeat: counts must be non-negative")
+    idx = jnp.asarray(np.repeat(np.arange(n, dtype=np.int32), c))
+    return gather_table(table, idx)
+
+
 def sample(table: Table, n: int, seed: int = 0,
            replacement: bool = False) -> Table:
     """Random row sample (cudf ``Table.sample``), jax PRNG keyed by

@@ -31,7 +31,11 @@ _FNS = {
     "sqrt": jnp.sqrt,
     "cbrt": jnp.cbrt,
     "exp": jnp.exp,
+    "expm1": jnp.expm1,
     "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
     "sin": jnp.sin,
     "cos": jnp.cos,
     "tan": jnp.tan,

@@ -202,19 +202,7 @@ def rolling_aggregate(
 def _partition_bounds(table: Table, partition_by: Sequence):
     """(starts, ends) per row for a table sorted by the partition keys."""
     n = table.row_count
-    words = []
-    for c in (table.column(k) for k in partition_by):
-        cwords = column_order_keys(c)
-        if c.validity is not None:
-            cwords = [jnp.where(c.validity, w, jnp.uint64(0)) for w in cwords]
-            cwords.append(c.validity.astype(jnp.uint64))
-        words.extend(cwords)
-    new_part = jnp.zeros((n,), jnp.bool_)
-    for w in words:
-        new_part = jnp.logical_or(
-            new_part,
-            jnp.concatenate([jnp.ones((1,), jnp.bool_), w[1:] != w[:-1]]),
-        )
+    new_part = _change_boundaries(table, partition_by)
     idx = jnp.arange(n, dtype=jnp.int32)
     starts = jax.lax.cummax(jnp.where(new_part, idx, 0))
     # ends: next partition start (reverse cummin of starts-after)

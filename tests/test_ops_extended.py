@@ -540,3 +540,100 @@ def test_batched_join_schema_parity_with_single_shot():
     b = inner_join_batched(l, r, ["k"], probe_rows=1)
     for ca, cb in zip(a.columns, b.columns):
         assert (ca.validity is None) == (cb.validity is None)
+
+
+class TestModAndRepeat:
+    def test_mod_spark_semantics(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import binary_op
+
+        t = Table.from_pydict({
+            "a": np.array([-7, 7, -7, 7, 5], dtype=np.int64),
+            "b": np.array([3, 3, -3, -3, 0], dtype=np.int64),
+        })
+        m = binary_op("mod", t["a"], t["b"])
+        # Java/Spark %: sign of the dividend; x % 0 is null
+        assert m.to_pylist() == [-1, 1, -1, 1, None]
+        p = binary_op("pmod", t["a"], t["b"])
+        # Spark Pmod corrects only NEGATIVE remainders: pmod(-7,3)=2,
+        # pmod(7,-3)=1 (r=1 kept as-is), pmod(-7,-3)=-1
+        assert p.to_pylist() == [2, 1, -1, 1, None]
+
+    def test_shiftright_unsigned(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import binary_op
+
+        t = Table.from_pydict({
+            "a": np.array([-8, 16], dtype=np.int64),
+            "s": np.array([1, 2], dtype=np.int64),
+        })
+        sru = binary_op("shiftright_unsigned", t["a"], t["s"])
+        assert sru.to_pylist() == [(-8 % (1 << 64)) >> 1, 4]
+        sr = binary_op("shiftright", t["a"], t["s"])
+        assert sr.to_pylist() == [-4, 4]
+
+    def test_repeat(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import repeat
+
+        t = Table.from_pydict({"v": [10, 20, 30], "s": ["a", "b", "c"]})
+        r = repeat(t, 2)
+        assert r["v"].to_pylist() == [10, 10, 20, 20, 30, 30]
+        r2 = repeat(t, np.array([0, 3, 1]))
+        assert r2["v"].to_pylist() == [20, 20, 20, 30]
+        assert r2["s"].to_pylist() == ["b", "b", "b", "c"]
+        assert repeat(t, np.array([0, 0, 0])).row_count == 0
+        with _pytest.raises(ValueError):
+            repeat(t, np.array([1, -1, 0]))
+
+    def test_unary_logs(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import unary_op
+
+        t = Table.from_pydict({"v": [1.0, 8.0, 100.0]})
+        np.testing.assert_allclose(
+            unary_op("log2", t["v"]).to_numpy(), [0.0, 3.0, np.log2(100)]
+        )
+        np.testing.assert_allclose(
+            unary_op("log10", t["v"]).to_numpy(), [0.0, np.log10(8), 2.0]
+        )
+        np.testing.assert_allclose(
+            unary_op("log1p", t["v"]).to_numpy(), np.log1p([1.0, 8.0, 100.0])
+        )
+        np.testing.assert_allclose(
+            unary_op("expm1", t["v"]).to_numpy(), np.expm1([1.0, 8.0, 100.0])
+        )
+
+    def test_shiftright_unsigned_narrow_widths(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column, Table
+        from spark_rapids_jni_tpu.ops import binary_op
+
+        a = Column.from_numpy(np.array([-8, 16], dtype=np.int16))
+        s = Column.from_numpy(np.array([1, 2], dtype=np.int16))
+        out = binary_op("shiftright_unsigned", a, s)
+        # logical shift at 16 bits: 0xFFF8 >> 1 = 0x7FFC = 32764
+        assert out.to_pylist() == [32764, 4]
+
+    def test_pmod_float(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import binary_op
+
+        t = Table.from_pydict({
+            "a": [-7.0, 7.0, -7.5],
+            "b": [3.0, -3.0, 2.0],
+        })
+        out = binary_op("pmod", t["a"], t["b"]).to_numpy()
+        np.testing.assert_allclose(out, [2.0, 1.0, 0.5])
