@@ -26,6 +26,9 @@ from .join import (
     inner_join,
     inner_join_batched,
     left_join,
+    left_join_capped,
+    left_join_count,
+    membership_mask,
     right_join,
     full_join,
     semi_join,
@@ -124,6 +127,9 @@ __all__ = [
     "inner_join",
     "inner_join_batched",
     "left_join",
+    "left_join_capped",
+    "left_join_count",
+    "membership_mask",
     "right_join",
     "full_join",
     "semi_join",

@@ -219,20 +219,28 @@ def binary_op(op: str, a: Column, b: Column) -> Column:
         res = av | bv
     elif op == "bitxor":
         res = av ^ bv
-    elif op == "shiftleft":
-        res = av << bv
-    elif op == "shiftright":
-        res = av >> bv
-    elif op == "shiftright_unsigned":
-        # logical shift: reinterpret at the SAME width as unsigned so
-        # the vacated high bits fill with zeros for any int width
-        kind = np.dtype(str(av.dtype))
-        if kind.kind == "i":
-            u = np.dtype(f"uint{kind.itemsize * 8}")
-            shifted = jax.lax.bitcast_convert_type(av, u) >> bv.astype(u)
-            res = jax.lax.bitcast_convert_type(shifted, kind)
+    elif op in ("shiftleft", "shiftright", "shiftright_unsigned"):
+        # Java/Spark shift semantics: the amount is masked to
+        # (bit width - 1), so x << 64 == x for int64 (XLA's behavior
+        # for amounts >= width is implementation-defined)
+        width = np.dtype(str(av.dtype)).itemsize * 8
+        shift = (bv & (width - 1)).astype(av.dtype)
+        if op == "shiftleft":
+            res = av << shift
+        elif op == "shiftright":
+            res = av >> shift
         else:
-            res = av >> bv
+            # logical shift: reinterpret at the SAME width as unsigned
+            # so the vacated high bits fill with zeros for any int width
+            kind = np.dtype(str(av.dtype))
+            if kind.kind == "i":
+                u = np.dtype(f"uint{width}")
+                shifted = (
+                    jax.lax.bitcast_convert_type(av, u) >> shift.astype(u)
+                )
+                res = jax.lax.bitcast_convert_type(shifted, kind)
+            else:
+                res = av >> shift
     else:  # pragma: no cover
         raise AssertionError(op)
 

@@ -135,11 +135,15 @@ def _match_ranges(
 
 
 def _expand(
-    perm_r, lo, counts, total: int, left_outer: bool
+    perm_r, lo, counts, total: int, left_outer: bool, emit=None
 ):
-    """Materialize (left_idx, right_idx, right_valid) pair arrays."""
+    """Materialize (left_idx, right_idx, right_valid) pair arrays.
+
+    ``emit`` overrides the per-left-row output count (used by the capped
+    left join to skip shuffle-padding rows entirely)."""
     n_left = counts.shape[0]
-    emit = jnp.maximum(counts, 1) if left_outer else counts
+    if emit is None:
+        emit = jnp.maximum(counts, 1) if left_outer else counts
     start = jnp.cumsum(emit) - emit
     left_idx = jnp.repeat(
         jnp.arange(n_left, dtype=jnp.int32), emit, total_repeat_length=total
@@ -214,6 +218,93 @@ def inner_join_capped(
         for c in out.columns
     ]
     return Table(cols, out.names), jnp.sum(counts)
+
+
+def left_join_capped(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    capacity: int,
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+    left_valid: Optional[jax.Array] = None,
+    right_valid: Optional[jax.Array] = None,
+) -> tuple[Table, jax.Array]:
+    """Jittable LEFT OUTER join with static output capacity; returns
+    (padded table, device row count). Every valid left row emits at
+    least once (null right side when unmatched); shuffle-padding rows
+    (``left_valid`` False) emit nothing."""
+    right_on = right_on or on
+    perm_r, lo, counts, _ = _match_ranges(
+        left, right, on, right_on, left_valid, right_valid
+    )
+    # null-KEY rows match nothing (counts already zeroed) but still
+    # emit their one left-outer row; only shuffle-PADDING rows
+    # (left_valid False) emit nothing
+    occ = (
+        left_valid
+        if left_valid is not None
+        else jnp.ones(counts.shape, jnp.bool_)
+    )
+    emit = jnp.where(occ, jnp.maximum(counts, 1), 0)
+    left_idx, right_idx, matched, in_range = _expand(
+        perm_r, lo, counts, capacity, left_outer=True, emit=emit
+    )
+    out = _join_output(
+        left, right, right_on, left_idx, right_idx,
+        jnp.logical_and(matched, in_range), in_range,
+    )
+    cols = [
+        Column(
+            c.data,
+            c.dtype,
+            in_range
+            if c.validity is None
+            else jnp.logical_and(c.validity, in_range),
+            c.lengths,
+        )
+        for c in out.columns
+    ]
+    return Table(cols, out.names), jnp.sum(emit)
+
+
+def left_join_count(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+    left_valid: Optional[jax.Array] = None,
+    right_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Jittable LEFT OUTER output-row count (phase 1 of two-phase
+    sizing): matches plus one per unmatched occupied left row (null-key
+    rows count; shuffle-padding rows don't)."""
+    right_on = right_on or on
+    _, _, counts, _ = _match_ranges(
+        left, right, on, right_on, left_valid, right_valid
+    )
+    occ = (
+        left_valid
+        if left_valid is not None
+        else jnp.ones(counts.shape, jnp.bool_)
+    )
+    return jnp.sum(jnp.where(occ, jnp.maximum(counts, 1), 0))
+
+
+def membership_mask(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+    left_valid: Optional[jax.Array] = None,
+    right_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Jittable per-left-row bool: has at least one match in right
+    (the SEMI/ANTI join predicate; fixed shape, shard_map-friendly)."""
+    right_on = right_on or on
+    _, _, counts, lvalid = _match_ranges(
+        left, right, on, right_on, left_valid, right_valid
+    )
+    return jnp.logical_and(lvalid, counts > 0)
 
 
 def inner_join_count(
@@ -368,18 +459,12 @@ def left_join(
     return _join_output(left, right, right_on, left_idx, right_idx, matched, None)
 
 
-def _membership(left, right, on, right_on):
-    right_on = right_on or on
-    _, _, counts, _ = _match_ranges(left, right, on, right_on)
-    return counts > 0
-
-
 def semi_join(left, right, on, right_on=None) -> Table:
     """Rows of ``left`` with at least one match (LEFT SEMI)."""
     from .filter import filter_table
     from .. import dtype as dt
 
-    has = _membership(left, right, on, right_on)
+    has = membership_mask(left, right, on, right_on)
     return filter_table(left, Column(has, dt.BOOL8, None))
 
 
@@ -388,7 +473,7 @@ def anti_join(left, right, on, right_on=None) -> Table:
     from .filter import filter_table
     from .. import dtype as dt
 
-    has = _membership(left, right, on, right_on)
+    has = membership_mask(left, right, on, right_on)
     return filter_table(left, Column(jnp.logical_not(has), dt.BOOL8, None))
 
 

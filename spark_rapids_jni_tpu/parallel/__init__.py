@@ -22,6 +22,9 @@ from .distributed import (
     GroupOverflowError,
     JoinOverflowError,
     broadcast_inner_join,
+    distributed_anti_join,
+    distributed_left_join,
+    distributed_semi_join,
     distributed_groupby,
     distributed_inner_join,
     distributed_sort,
@@ -43,6 +46,9 @@ __all__ = [
     "GroupOverflowError",
     "JoinOverflowError",
     "broadcast_inner_join",
+    "distributed_anti_join",
+    "distributed_left_join",
+    "distributed_semi_join",
     "distributed_groupby",
     "distributed_inner_join",
     "distributed_sort",

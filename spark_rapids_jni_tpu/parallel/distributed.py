@@ -30,7 +30,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..column import Table
 from ..ops.groupby import GroupbyAgg, groupby_aggregate_capped
-from ..ops.join import inner_join_capped, inner_join_count
+from ..ops.join import (
+    inner_join_capped,
+    inner_join_count,
+    left_join_capped,
+    left_join_count,
+    membership_mask,
+)
 from .mesh import SHUFFLE_AXIS, shard_map, shard_table
 from .shuffle import (
     _ragged_impl,
@@ -142,44 +148,17 @@ def distributed_inner_join(
     ``on_overflow="allow"``.
     """
     validate_on_overflow(on_overflow)
-    impl = _ragged_impl(None)
-    lsh = shard_table(left, mesh, axis)
-    rsh = shard_table(right, mesh, axis)
-    lcounts = partition_counts(lsh, on, mesh, axis)
-    rcounts = partition_counts(rsh, on, mesh, axis)
-    lcap = capacity or total_recv_capacity(lcounts)
-    rcap = capacity or total_recv_capacity(rcounts)
-    lpair = _round_capacity(int(jnp.max(lcounts)))
-    rpair = _round_capacity(int(jnp.max(rcounts)))
     count_pass = out_capacity is None
-
-    def exchange_body(l_local: Table, r_local: Table, lC, rC):
-        ls, locc, lov = exchange_ragged_by_hash(
-            l_local, on, lC, lcap, axis, impl, pair_capacity=lpair
-        )
-        rs, rocc, rov = exchange_ragged_by_hash(
-            r_local, on, rC, rcap, axis, impl, pair_capacity=rpair
-        )
-        cnt = (
-            inner_join_count(ls, rs, on, left_valid=locc, right_valid=rocc)
+    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = _co_partition(
+        left, right, on, mesh, capacity, axis, on_overflow,
+        count_fn=(
+            (lambda ls, locc, rs, rocc: inner_join_count(
+                ls, rs, on, left_valid=locc, right_valid=rocc
+            ))
             if count_pass
-            else jnp.zeros((), jnp.int64)
-        )
-        return ls, locc, lov[None], rs, rocc, rov[None], cnt[None]
-
-    ex_fn = shard_map(
-        exchange_body,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(), P()),
-        out_specs=P(axis),
-        check_vma=False,
+            else None
+        ),
     )
-    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = ex_fn(
-        lsh, rsh, lcounts, rcounts
-    )
-    if on_overflow == "raise":
-        check_overflow_compact(lov, lcap, "left join")
-        check_overflow_compact(rov, rcap, "right join")
     ocap = (
         _round_capacity(int(jnp.max(cnts))) if count_pass else out_capacity
     )
@@ -207,6 +186,173 @@ def distributed_inner_join(
                 f"auto-size"
             )
     return out, count, lov, rov
+
+
+def _co_partition(
+    left, right, on, mesh, capacity, axis, on_overflow, count_fn=None
+):
+    """Shared exchange for the shuffle joins: hash-exchange both sides
+    on the join keys, returning sharded shards + occupancies (each side
+    crosses the ICI exactly once; later passes reuse the shards).
+
+    ``count_fn(ls, locc, rs, rocc)`` optionally fuses a per-device
+    scalar count into the same dispatch (the inner join's two-phase
+    sizing pass rides the exchange instead of paying its own round
+    trip); its per-device results come back as the last element."""
+    impl = _ragged_impl(None)
+    lsh = shard_table(left, mesh, axis)
+    rsh = shard_table(right, mesh, axis)
+    lcounts = partition_counts(lsh, on, mesh, axis)
+    rcounts = partition_counts(rsh, on, mesh, axis)
+    lcap = capacity or total_recv_capacity(lcounts)
+    rcap = capacity or total_recv_capacity(rcounts)
+    lpair = _round_capacity(int(jnp.max(lcounts)))
+    rpair = _round_capacity(int(jnp.max(rcounts)))
+
+    def body(l_local: Table, r_local: Table, lC, rC):
+        ls, locc, lov = exchange_ragged_by_hash(
+            l_local, on, lC, lcap, axis, impl, pair_capacity=lpair
+        )
+        rs, rocc, rov = exchange_ragged_by_hash(
+            r_local, on, rC, rcap, axis, impl, pair_capacity=rpair
+        )
+        cnt = (
+            count_fn(ls, locc, rs, rocc)
+            if count_fn is not None
+            else jnp.zeros((), jnp.int64)
+        )
+        return ls, locc, lov[None], rs, rocc, rov[None], cnt[None]
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = fn(
+        lsh, rsh, lcounts, rcounts
+    )
+    if on_overflow == "raise":
+        check_overflow_compact(lov, lcap, "left side")
+        check_overflow_compact(rov, rcap, "right side")
+    return ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts
+
+
+def distributed_left_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+    out_capacity: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
+):
+    """Shuffle-shuffle LEFT OUTER join over the mesh: co-partition both
+    sides, then each chip left-joins its partitions locally (every valid
+    left row emits at least once — unmatched rows carry a null right
+    side). Two-phase sizing like distributed_inner_join. Returns
+    (sharded padded output, per-device row counts, left/right shuffle
+    overflows)."""
+    validate_on_overflow(on_overflow)
+    count_pass = out_capacity is None
+    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = _co_partition(
+        left, right, on, mesh, capacity, axis, on_overflow,
+        count_fn=(
+            (lambda ls, locc, rs, rocc: left_join_count(
+                ls, rs, on, left_valid=locc, right_valid=rocc
+            ))
+            if count_pass
+            else None
+        ),
+    )
+    ocap = (
+        _round_capacity(int(jnp.max(cnts))) if count_pass else out_capacity
+    )
+
+    def join_body(ls: Table, locc, rs: Table, rocc):
+        out, count = left_join_capped(
+            ls, rs, on, capacity=ocap, left_valid=locc, right_valid=rocc
+        )
+        return out, count[None]
+
+    join_fn = shard_map(
+        join_body,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    out, count = join_fn(ls_g, locc_g, rs_g, rocc_g)
+    if on_overflow == "raise":
+        worst = int(jnp.max(count))
+        if worst > ocap:
+            raise JoinOverflowError(
+                f"left join output capacity {ocap} undersized: a device "
+                f"produced {worst} rows; pass out_capacity=None to "
+                "auto-size"
+            )
+    return out, count, lov, rov
+
+
+def _distributed_membership_join(
+    left, right, on, mesh, capacity, axis, on_overflow, anti: bool
+):
+    validate_on_overflow(on_overflow)
+    ls_g, locc_g, lov, rs_g, rocc_g, rov, _ = _co_partition(
+        left, right, on, mesh, capacity, axis, on_overflow
+    )
+
+    def body(ls: Table, locc, rs: Table, rocc):
+        member = membership_mask(
+            ls, rs, on, left_valid=locc, right_valid=rocc
+        )
+        keep = jnp.logical_and(
+            locc, jnp.logical_not(member) if anti else member
+        )
+        return ls, keep
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )
+    out, occ = fn(ls_g, locc_g, rs_g, rocc_g)
+    return out, occ, lov, rov
+
+
+def distributed_semi_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
+):
+    """Distributed LEFT SEMI join: co-partition, then mark each left row
+    with membership. Returns (sharded left shards, occupancy of
+    surviving rows, left/right shuffle overflows) — the padded-shard
+    convention every distributed op here uses (rows stay in place, the
+    occupancy column is the result)."""
+    return _distributed_membership_join(
+        left, right, on, mesh, capacity, axis, on_overflow, anti=False
+    )
+
+
+def distributed_anti_join(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    mesh: Mesh,
+    capacity: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
+):
+    """Distributed LEFT ANTI join (rows of left with NO match)."""
+    return _distributed_membership_join(
+        left, right, on, mesh, capacity, axis, on_overflow, anti=True
+    )
 
 
 def broadcast_inner_join(

@@ -637,3 +637,20 @@ class TestModAndRepeat:
         })
         out = binary_op("pmod", t["a"], t["b"]).to_numpy()
         np.testing.assert_allclose(out, [2.0, 1.0, 0.5])
+
+    def test_shift_amount_masked_like_java(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import binary_op
+
+        t = Table.from_pydict({
+            "a": np.array([5, 5, -8], dtype=np.int64),
+            "s": np.array([64, 65, 64], dtype=np.int64),
+        })
+        # Java masks int64 shifts to amount & 63: x << 64 == x
+        assert binary_op("shiftleft", t["a"], t["s"]).to_pylist() == [5, 10, -8]
+        assert binary_op("shiftright", t["a"], t["s"]).to_pylist() == [5, 2, -8]
+        assert binary_op(
+            "shiftright_unsigned", t["a"], t["s"]
+        ).to_pylist() == [5, 2, -8]

@@ -640,3 +640,103 @@ class TestBroadcastJoin:
             parallel.broadcast_inner_join(
                 fact, dim, ["k"], mesh, out_capacity=2
             )
+
+
+class TestDistributedOuterAndMembership:
+    def _tables(self, rng, n=4_000, m=600):
+        import pandas as pd
+
+        lk = rng.integers(0, 200, n, dtype=np.int64)
+        lv = rng.integers(-9, 9, n, dtype=np.int64)
+        rk = rng.integers(100, 300, m, dtype=np.int64)  # partial overlap
+        rv = rng.integers(0, 5, m, dtype=np.int64)
+        left = Table(
+            [Column.from_numpy(lk), Column.from_numpy(lv)], ["k", "lv"]
+        )
+        right = Table(
+            [Column.from_numpy(rk), Column.from_numpy(rv)], ["k", "rv"]
+        )
+        ldf = pd.DataFrame({"k": lk, "lv": lv})
+        rdf = pd.DataFrame({"k": rk, "rv": rv})
+        return left, right, ldf, rdf
+
+    def test_left_join_oracle(self, mesh, rng):
+        import pandas as pd
+
+        left, right, ldf, rdf = self._tables(rng)
+        out, counts, lov, rov = parallel.distributed_left_join(
+            left, right, ["k"], mesh
+        )
+        per_dev = np.asarray(counts)
+        cap = out.row_count // 8
+        got = []
+        kk = np.asarray(out["k"].data)
+        lvv = np.asarray(out["lv"].data)
+        rvv = out["rv"].to_pylist()
+        rvalid = (
+            np.ones(out.row_count, bool)
+            if out["rv"].validity is None
+            else np.asarray(out["rv"].validity)
+        )
+        for d in range(8):
+            s = d * cap
+            for i in range(s, s + int(per_dev[d])):
+                got.append(
+                    (int(kk[i]), int(lvv[i]),
+                     int(rvv[i]) if rvalid[i] else None)
+                )
+        want_df = ldf.merge(rdf, on="k", how="left")
+        want = [
+            (int(r.k), int(r.lv),
+             None if pd.isna(r.rv) else int(r.rv))
+            for r in want_df.itertuples()
+        ]
+        assert sorted(got, key=str) == sorted(want, key=str)
+
+    def test_semi_anti_oracle(self, mesh, rng):
+        left, right, ldf, rdf = self._tables(rng)
+        rkeys = set(rdf["k"].tolist())
+        want_semi = sorted(
+            (int(k), int(v))
+            for k, v in zip(ldf["k"], ldf["lv"]) if int(k) in rkeys
+        )
+        want_anti = sorted(
+            (int(k), int(v))
+            for k, v in zip(ldf["k"], ldf["lv"]) if int(k) not in rkeys
+        )
+        sh, occ, _, _ = parallel.distributed_semi_join(
+            left, right, ["k"], mesh
+        )
+        occ_h = np.asarray(occ)
+        got_semi = sorted(
+            zip(
+                np.asarray(sh["k"].data)[occ_h].tolist(),
+                np.asarray(sh["lv"].data)[occ_h].tolist(),
+            )
+        )
+        assert got_semi == want_semi
+        sh2, occ2, _, _ = parallel.distributed_anti_join(
+            left, right, ["k"], mesh
+        )
+        occ2_h = np.asarray(occ2)
+        got_anti = sorted(
+            zip(
+                np.asarray(sh2["k"].data)[occ2_h].tolist(),
+                np.asarray(sh2["lv"].data)[occ2_h].tolist(),
+            )
+        )
+        assert got_anti == want_anti
+
+    def test_left_join_null_keys_emit(self, mesh):
+        lk = Column.from_numpy(
+            np.array([1, 2] * 16, dtype=np.int64),
+            validity=np.array([True, False] * 16),
+        )
+        left = Table([lk], ["k"])
+        # exactly one right row carries the overlapping key 1
+        right = Table.from_pydict({"k": [1, 30, 40, 50, 60, 70, 80, 90]})
+        out, counts, _, _ = parallel.distributed_left_join(
+            left, right, ["k"], mesh
+        )
+        # every left row emits exactly once: 16 matches + 16 null-key rows
+        assert int(np.asarray(counts).sum()) == 32
