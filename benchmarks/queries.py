@@ -26,6 +26,7 @@ from spark_rapids_jni_tpu import ops
 from spark_rapids_jni_tpu.column import Column, Table
 from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
 from spark_rapids_jni_tpu.parallel.distributed import (
+    broadcast_inner_join,
     distributed_groupby,
     distributed_inner_join,
 )
@@ -66,12 +67,19 @@ def q5(tables: dict, date_lo: int = 100, date_hi: int = 200) -> Table:
 
 def q5_distributed(tables: dict, mesh, date_lo=100, date_hi=200):
     """Distributed q5: the union + filter happen per-shard inside the
-    fact tables (cheap, embarrassingly parallel); the aggregation
-    shuffles by category over ICI."""
+    fact tables (cheap, embarrassingly parallel); the item dimension
+    join is a BROADCAST hash join (the BroadcastHashJoinExec plan Spark
+    picks for dimension tables — fact side stays sharded in place, zero
+    fact rows cross the ICI); the aggregation shuffles by category."""
     store = _date_filter(tables["store_sales"], date_lo, date_hi)
     web = _date_filter(tables["web_sales"], date_lo, date_hi)
-    allsales = ops.concatenate([store, web])
-    joined = ops.inner_join(allsales, tables["item"], ["item_sk"])
+    allsales = _pad_to_mesh(ops.concatenate([store, web]), mesh)
+    # padding rows carry _PAD_KEY, which matches no real item_sk — the
+    # inner broadcast join drops them with no special handling
+    joined_sh, counts = broadcast_inner_join(
+        allsales, tables["item"], ["item_sk"], mesh
+    )
+    joined = _unpad_join(joined_sh, counts)
     rev = ops.mul(joined["quantity"], joined["sales_price"])
     with_rev = Table([*joined.columns, rev], [*joined.names, "revenue"])
     # pad rows to a multiple of the mesh size for sharding; the
@@ -175,7 +183,9 @@ def q64(tables: dict, max_price: float = 150.0) -> Table:
 
 def q64_distributed(tables: dict, mesh, max_price: float = 150.0):
     """Distributed q64: the big fact-fact-shaped join (sales x customer)
-    shuffles both sides; the small dimension joins replicate."""
+    shuffles both sides; the small dimension joins (filtered item,
+    date_dim) are broadcast hash joins — the fact side never crosses
+    the ICI for them."""
     sales = tables["store_sales"]
     item = tables["item"]
     cheap = ops.filter_table(
@@ -187,7 +197,10 @@ def q64_distributed(tables: dict, mesh, max_price: float = 150.0):
             None,
         ),
     )
-    j1 = ops.inner_join(sales, cheap, ["item_sk"])
+    j1_sh, j1_counts = broadcast_inner_join(
+        _pad_to_mesh(sales, mesh), cheap, ["item_sk"], mesh
+    )
+    j1 = _unpad_join(j1_sh, j1_counts)
     lpad = _pad_to_mesh(j1, mesh)
     rpad = _pad_to_mesh(tables["customer"], mesh)
     num = int(np.prod(list(mesh.shape.values())))
@@ -205,7 +218,10 @@ def q64_distributed(tables: dict, mesh, max_price: float = 150.0):
         out_capacity=lpad.row_count + (num - 1) ** 2,
     )
     out = _unpad_join(joined, counts)
-    j3 = ops.inner_join(out, tables["date_dim"], ["date_sk"])
+    j3_sh, j3_counts = broadcast_inner_join(
+        _pad_to_mesh(out, mesh), tables["date_dim"], ["date_sk"], mesh
+    )
+    j3 = _unpad_join(j3_sh, j3_counts)
     rev = ops.mul(j3["quantity"], j3["sales_price"])
     t = Table([*j3.columns, rev], [*j3.names, "revenue"])
     return ops.groupby_aggregate(

@@ -220,6 +220,20 @@ def inner_join_capped(
     return Table(cols, out.names), jnp.sum(counts)
 
 
+def _left_emit(counts, left_valid):
+    """Per-left-row output count of a LEFT OUTER join — the single
+    definition both sizing phases share: null-KEY rows match nothing
+    (counts already zeroed by _match_ranges) but still emit their one
+    left-outer row; only shuffle-PADDING rows (left_valid False) emit
+    nothing."""
+    occ = (
+        left_valid
+        if left_valid is not None
+        else jnp.ones(counts.shape, jnp.bool_)
+    )
+    return jnp.where(occ, jnp.maximum(counts, 1), 0)
+
+
 def left_join_capped(
     left: Table,
     right: Table,
@@ -237,15 +251,7 @@ def left_join_capped(
     perm_r, lo, counts, _ = _match_ranges(
         left, right, on, right_on, left_valid, right_valid
     )
-    # null-KEY rows match nothing (counts already zeroed) but still
-    # emit their one left-outer row; only shuffle-PADDING rows
-    # (left_valid False) emit nothing
-    occ = (
-        left_valid
-        if left_valid is not None
-        else jnp.ones(counts.shape, jnp.bool_)
-    )
-    emit = jnp.where(occ, jnp.maximum(counts, 1), 0)
+    emit = _left_emit(counts, left_valid)
     left_idx, right_idx, matched, in_range = _expand(
         perm_r, lo, counts, capacity, left_outer=True, emit=emit
     )
@@ -282,12 +288,7 @@ def left_join_count(
     _, _, counts, _ = _match_ranges(
         left, right, on, right_on, left_valid, right_valid
     )
-    occ = (
-        left_valid
-        if left_valid is not None
-        else jnp.ones(counts.shape, jnp.bool_)
-    )
-    return jnp.sum(jnp.where(occ, jnp.maximum(counts, 1), 0))
+    return jnp.sum(_left_emit(counts, left_valid))
 
 
 def membership_mask(

@@ -147,12 +147,26 @@ def distributed_inner_join(
     device-resident shards. Explicit undersized values raise unless
     ``on_overflow="allow"``.
     """
+    return _shuffle_join(
+        left, right, on, mesh, capacity, out_capacity, axis,
+        on_overflow, inner_join_count, inner_join_capped, "join",
+    )
+
+
+def _shuffle_join(
+    left, right, on, mesh, capacity, out_capacity, axis, on_overflow,
+    count_fn, capped_fn, label: str,
+):
+    """Shared shuffle-join driver: co-partition (count pass fused into
+    the exchange), size the output, run the local capped join per chip,
+    check overflow — the one copy of the two-phase sizing contract the
+    inner and left outer joins share."""
     validate_on_overflow(on_overflow)
     count_pass = out_capacity is None
     ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = _co_partition(
         left, right, on, mesh, capacity, axis, on_overflow,
         count_fn=(
-            (lambda ls, locc, rs, rocc: inner_join_count(
+            (lambda ls, locc, rs, rocc: count_fn(
                 ls, rs, on, left_valid=locc, right_valid=rocc
             ))
             if count_pass
@@ -164,7 +178,7 @@ def distributed_inner_join(
     )
 
     def join_body(ls: Table, locc, rs: Table, rocc):
-        out, count = inner_join_capped(
+        out, count = capped_fn(
             ls, rs, on, capacity=ocap, left_valid=locc, right_valid=rocc
         )
         return out, count[None]
@@ -181,9 +195,9 @@ def distributed_inner_join(
         worst = int(jnp.max(count))
         if worst > ocap:
             raise JoinOverflowError(
-                f"join output capacity {ocap} undersized: a device "
-                f"produced {worst} matches; pass out_capacity=None to "
-                f"auto-size"
+                f"{label} output capacity {ocap} undersized: a device "
+                f"produced {worst} rows; pass out_capacity=None to "
+                "auto-size"
             )
     return out, count, lov, rov
 
@@ -255,45 +269,10 @@ def distributed_left_join(
     side). Two-phase sizing like distributed_inner_join. Returns
     (sharded padded output, per-device row counts, left/right shuffle
     overflows)."""
-    validate_on_overflow(on_overflow)
-    count_pass = out_capacity is None
-    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = _co_partition(
-        left, right, on, mesh, capacity, axis, on_overflow,
-        count_fn=(
-            (lambda ls, locc, rs, rocc: left_join_count(
-                ls, rs, on, left_valid=locc, right_valid=rocc
-            ))
-            if count_pass
-            else None
-        ),
+    return _shuffle_join(
+        left, right, on, mesh, capacity, out_capacity, axis,
+        on_overflow, left_join_count, left_join_capped, "left join",
     )
-    ocap = (
-        _round_capacity(int(jnp.max(cnts))) if count_pass else out_capacity
-    )
-
-    def join_body(ls: Table, locc, rs: Table, rocc):
-        out, count = left_join_capped(
-            ls, rs, on, capacity=ocap, left_valid=locc, right_valid=rocc
-        )
-        return out, count[None]
-
-    join_fn = shard_map(
-        join_body,
-        mesh=mesh,
-        in_specs=P(axis),
-        out_specs=P(axis),
-        check_vma=False,
-    )
-    out, count = join_fn(ls_g, locc_g, rs_g, rocc_g)
-    if on_overflow == "raise":
-        worst = int(jnp.max(count))
-        if worst > ocap:
-            raise JoinOverflowError(
-                f"left join output capacity {ocap} undersized: a device "
-                f"produced {worst} rows; pass out_capacity=None to "
-                "auto-size"
-            )
-    return out, count, lov, rov
 
 
 def _distributed_membership_join(
@@ -308,17 +287,18 @@ def _distributed_membership_join(
         member = membership_mask(
             ls, rs, on, left_valid=locc, right_valid=rocc
         )
-        keep = jnp.logical_and(
+        # only the mask leaves the shard_map — returning ls too would
+        # materialize a second copy of the co-partitioned fact shards
+        return jnp.logical_and(
             locc, jnp.logical_not(member) if anti else member
         )
-        return ls, keep
 
     fn = shard_map(
         body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
         check_vma=False,
     )
-    out, occ = fn(ls_g, locc_g, rs_g, rocc_g)
-    return out, occ, lov, rov
+    occ = fn(ls_g, locc_g, rs_g, rocc_g)
+    return ls_g, occ, lov, rov
 
 
 def distributed_semi_join(
