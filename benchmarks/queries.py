@@ -29,6 +29,7 @@ from spark_rapids_jni_tpu.parallel.distributed import (
     broadcast_inner_join,
     distributed_groupby,
     distributed_inner_join,
+    distributed_semi_join,
 )
 
 
@@ -135,7 +136,15 @@ def q23_distributed(tables: dict, mesh, min_count: int = 4):
         freq,
         Column(freq["count_item_sk"].data >= min_count, dt.BOOL8, None),
     )
-    hot_sales = ops.semi_join(sales, hot, ["item_sk"])
+    # distributed LEFT SEMI against the hot-item list: both sides
+    # hash-exchange by item over ICI, then membership lands in the
+    # occupancy column of the exchanged shards (the compaction below is
+    # a host-side convenience for the next stage)
+    hot_pad = _pad_to_mesh(hot, mesh)
+    sales_sh, occ, _, _ = distributed_semi_join(
+        sales_padded, hot_pad, ["item_sk"], mesh
+    )
+    hot_sales = _unpad_occupancy(sales_sh, occ)
     spend = ops.mul(hot_sales["quantity"], hot_sales["sales_price"])
     t = Table([*hot_sales.columns, spend], [*hot_sales.names, "spend"])
     # customer_sk is uniform (~rows/20 distinct): the balanced default
@@ -263,6 +272,14 @@ def _pad_to_mesh(table: Table, mesh) -> Table:
     return ops.concatenate([table, pad])
 
 
+def _real_mask(table: Table):
+    """Per-row bool: not a _PAD_KEY padding row (keyed off the first
+    column, which _pad_to_mesh fills with the sentinel)."""
+    return table.columns[0].data != jnp.asarray(
+        _PAD_KEY, table.columns[0].data.dtype
+    )
+
+
 def _unpad_groupby(padded: Table, counts) -> Table:
     """Compact the sharded padded result: keep each device's first
     count rows, drop padding groups (the _PAD_KEY key). Device-side
@@ -272,13 +289,23 @@ def _unpad_groupby(padded: Table, counts) -> Table:
     per = padded.row_count // n_dev
     slot = jnp.arange(padded.row_count, dtype=jnp.int32)
     occupied = (slot % per) < cnt[slot // per]
-    real = padded.columns[0].data != jnp.asarray(
-        _PAD_KEY, padded.columns[0].data.dtype
+    mask = Column(
+        jnp.logical_and(occupied, _real_mask(padded)), dt.BOOL8, None
     )
-    mask = Column(jnp.logical_and(occupied, real), dt.BOOL8, None)
     return ops.filter_table(padded, mask)
 
 
 def _unpad_join(padded: Table, counts) -> Table:
     """Same shard-stacking for distributed join output."""
     return _unpad_groupby(padded, counts)
+
+
+def _unpad_occupancy(sharded: Table, occ) -> Table:
+    """Compact a padded-shard result by its occupancy column (the
+    semi/anti join convention), dropping _PAD_KEY padding rows too."""
+    mask = Column(
+        jnp.logical_and(jnp.asarray(occ), _real_mask(sharded)),
+        dt.BOOL8,
+        None,
+    )
+    return ops.filter_table(sharded, mask)

@@ -188,6 +188,39 @@ def _join_output(
     return Table(out_cols, out_names)
 
 
+def inner_join_from_ranges(
+    left: Table,
+    right: Table,
+    right_on: Sequence[Union[int, str]],
+    perm_r,
+    lo,
+    counts,
+    capacity: int,
+) -> tuple[Table, jax.Array]:
+    """Materialize a capped inner join from ALREADY-COMPUTED match
+    ranges (a prior _prepare_build + _probe_build pass) — the
+    share-the-probe half of two-phase sizing. Jittable; pairs past the
+    count are padding (nulled)."""
+    left_idx, right_idx, matched, in_range = _expand(
+        perm_r, lo, counts, capacity, left_outer=False
+    )
+    out = _join_output(
+        left, right, right_on, left_idx, right_idx, matched, in_range
+    )
+    cols = [
+        Column(
+            c.data,
+            c.dtype,
+            in_range
+            if c.validity is None
+            else jnp.logical_and(c.validity, in_range),
+            c.lengths,
+        )
+        for c in out.columns
+    ]
+    return Table(cols, out.names), jnp.sum(counts)
+
+
 def inner_join_capped(
     left: Table,
     right: Table,
@@ -203,21 +236,9 @@ def inner_join_capped(
     perm_r, lo, counts, _ = _match_ranges(
         left, right, on, right_on, left_valid, right_valid
     )
-    left_idx, right_idx, matched, in_range = _expand(
-        perm_r, lo, counts, capacity, left_outer=False
+    return inner_join_from_ranges(
+        left, right, right_on, perm_r, lo, counts, capacity
     )
-    out = _join_output(left, right, right_on, left_idx, right_idx, matched, in_range)
-    # null out padding rows entirely
-    cols = [
-        Column(
-            c.data,
-            c.dtype,
-            in_range if c.validity is None else jnp.logical_and(c.validity, in_range),
-            c.lengths,
-        )
-        for c in out.columns
-    ]
-    return Table(cols, out.names), jnp.sum(counts)
 
 
 def _left_emit(counts, left_valid):

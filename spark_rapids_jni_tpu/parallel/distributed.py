@@ -358,38 +358,69 @@ def broadcast_inner_join(
 
     Returns (sharded padded join output, per-device match counts).
     """
+    from ..ops.join import (
+        _prepare_build,
+        _probe_build,
+        inner_join_from_ranges,
+    )
+
     validate_on_overflow(on_overflow)
     lsh = shard_table(left, mesh, axis)
     count_pass = out_capacity is None
+    on_l = list(on)
     if count_pass:
+        # the count dispatch keeps its device-resident probe results
+        # (lo, counts) so the materialize dispatch reuses them instead
+        # of re-sorting the build side and re-probing the fact shards
+        def count_body(l_local: Table, r_full: Table):
+            _, sw = _prepare_build(r_full, on_l)
+            lo, counts, _ = _probe_build(sw, l_local, on_l)
+            return lo, counts, jnp.sum(counts)[None]
+
         cnt_fn = shard_map(
-            lambda l_local, r_full: inner_join_count(l_local, r_full, on)[
-                None
-            ],
+            count_body,
             mesh=mesh,
             in_specs=(P(axis), P()),
             out_specs=P(axis),
             check_vma=False,
         )
-        cnts = cnt_fn(lsh, right)
+        lo_g, counts_g, cnts = cnt_fn(lsh, right)
         ocap = _round_capacity(int(jnp.max(cnts)))
+
+        def body(l_local: Table, r_full: Table, lo, counts):
+            # only the (cheap, small-side) build sort re-runs here; the
+            # O(n log m) probe of the fact shard does not
+            perm_r, _ = _prepare_build(r_full, on_l)
+            out, count = inner_join_from_ranges(
+                l_local, r_full, on_l, perm_r, lo, counts, ocap
+            )
+            return out, count[None]
+
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P(axis), P(axis)),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+        out, count = fn(lsh, right, lo_g, counts_g)
     else:
         ocap = out_capacity
 
-    def body(l_local: Table, r_full: Table):
-        out, count = inner_join_capped(
-            l_local, r_full, on, capacity=ocap
-        )
-        return out, count[None]
+        def body(l_local: Table, r_full: Table):
+            out, count = inner_join_capped(
+                l_local, r_full, on, capacity=ocap
+            )
+            return out, count[None]
 
-    fn = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(axis),
-        check_vma=False,
-    )
-    out, count = fn(lsh, right)
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+        out, count = fn(lsh, right)
     if on_overflow == "raise":
         worst = int(jnp.max(count))
         if worst > ocap:
