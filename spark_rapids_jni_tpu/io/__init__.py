@@ -1,7 +1,7 @@
 """Columnar file I/O: the TPU-native analog of cudf's io layer.
 
-The reference artifact ships compressed columnar file decode (Parquet/ORC
-et al.) via libcudf + nvcomp + optional GPUDirect Storage (SURVEY.md §2.3:
+The reference artifact ships compressed columnar file decode (Parquet/
+ORC/CSV/JSON/Avro/Arrow-IPC here) via libcudf + nvcomp + optional GPUDirect Storage (SURVEY.md §2.3:
 nvcomp include CMakeLists.txt:91, USE_GDS pom.xml:84; parquet-avro +
 hadoop-common test deps pom.xml:112-123 feed the cudf Java I/O tests).
 

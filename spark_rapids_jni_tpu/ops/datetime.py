@@ -167,3 +167,54 @@ def add_calendrical_months(col: Column, months: Column | int) -> Column:
             else jnp.logical_and(valid, months.validity)
         )
     return Column(out, col.dtype, valid)
+
+
+def quarter(col: Column) -> Column:
+    """Quarter 1-4 (Spark ``quarter`` / cudf ``extract_quarter``)."""
+    return _field(
+        col,
+        lambda days, secs: (_civil_from_days(days)[1] - 1) // 3 + 1,
+    )
+
+
+def truncate(col: Column, unit: str) -> Column:
+    """Round the timestamp DOWN to the unit boundary (Spark
+    ``date_trunc`` / cudf ``floor_temporal``). Units: year, quarter,
+    month, week (ISO Monday), day, hour, minute, second. Result keeps
+    the input timestamp type."""
+    _require_timestamp(col)
+    days, secs = _days_and_seconds(col)
+    unit = unit.lower()
+    if unit in ("year", "quarter", "month"):
+        y, m, _ = _civil_from_days(days)
+        if unit == "year":
+            m_out = jnp.ones_like(m)
+        elif unit == "quarter":
+            m_out = ((m - 1) // 3) * 3 + 1
+        else:
+            m_out = m
+        new_days = _days_from_civil(y, m_out, jnp.ones_like(m))
+        new_secs = jnp.zeros_like(secs)
+    elif unit == "week":
+        # ISO week starts Monday; 1970-01-01 was a Thursday (weekday 3
+        # with Monday=0)
+        dow = (days + 3) % 7
+        new_days = days - dow
+        new_secs = jnp.zeros_like(secs)
+    elif unit == "day":
+        new_days, new_secs = days, jnp.zeros_like(secs)
+    elif unit in ("hour", "minute", "second"):
+        step = {"hour": 3600, "minute": 60, "second": 1}[unit]
+        new_days = days
+        new_secs = (secs // step) * step
+    else:
+        raise ValueError(f"date_trunc: unknown unit {unit!r}")
+    per_day = _TICKS_PER_DAY[col.dtype.id]
+    if col.dtype.id == dt.TypeId.TIMESTAMP_DAYS:
+        ticks = new_days
+    else:
+        per_sec = _TICKS_PER_SECOND[col.dtype.id]
+        ticks = new_days * per_day + new_secs * per_sec
+    return Column(
+        ticks.astype(col.data.dtype), col.dtype, col.validity
+    )

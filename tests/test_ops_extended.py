@@ -654,3 +654,78 @@ class TestModAndRepeat:
         assert binary_op(
             "shiftright_unsigned", t["a"], t["s"]
         ).to_pylist() == [5, 2, -8]
+
+
+class TestDateTrunc:
+    def test_truncate_vs_python(self):
+        import datetime as _dt
+
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops import datetime as sdt
+
+        stamps = [
+            _dt.datetime(2024, 7, 30, 13, 45, 56),
+            _dt.datetime(1969, 5, 14, 23, 59, 59),
+            _dt.datetime(2000, 1, 1, 0, 0, 0),
+            _dt.datetime(1987, 11, 9, 6, 30, 15),
+        ]
+        epoch = _dt.datetime(1970, 1, 1)
+        secs = np.array(
+            [int((d - epoch).total_seconds()) for d in stamps], np.int64
+        )
+        c = Column(secs, dt.DType(dt.TypeId.TIMESTAMP_SECONDS), None)
+
+        def back(out):
+            return [
+                epoch + _dt.timedelta(seconds=int(v))
+                for v in np.asarray(out.data)
+            ]
+
+        assert back(sdt.truncate(c, "day")) == [
+            d.replace(hour=0, minute=0, second=0) for d in stamps
+        ]
+        assert back(sdt.truncate(c, "month")) == [
+            d.replace(day=1, hour=0, minute=0, second=0) for d in stamps
+        ]
+        assert back(sdt.truncate(c, "year")) == [
+            d.replace(month=1, day=1, hour=0, minute=0, second=0)
+            for d in stamps
+        ]
+        assert back(sdt.truncate(c, "hour")) == [
+            d.replace(minute=0, second=0) for d in stamps
+        ]
+        # ISO week: Monday 00:00 on or before the stamp
+        assert back(sdt.truncate(c, "week")) == [
+            (d - _dt.timedelta(days=d.weekday())).replace(
+                hour=0, minute=0, second=0
+            )
+            for d in stamps
+        ]
+        assert back(sdt.truncate(c, "quarter")) == [
+            d.replace(
+                month=((d.month - 1) // 3) * 3 + 1, day=1,
+                hour=0, minute=0, second=0,
+            )
+            for d in stamps
+        ]
+
+    def test_quarter(self):
+        import datetime as _dt
+
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops import datetime as sdt
+
+        days = np.array(
+            [
+                (_dt.date(2024, m, 15) - _dt.date(1970, 1, 1)).days
+                for m in range(1, 13)
+            ],
+            np.int32,
+        )
+        c = Column(days, dt.TIMESTAMP_DAYS, None)
+        got = sdt.quarter(c).to_pylist()
+        assert got == [1, 1, 1, 2, 2, 2, 3, 3, 3, 4, 4, 4]
