@@ -1094,3 +1094,63 @@ def url_encode(col: Column) -> Column:
         jnp.arange(pad_out)[None, :] < new_len[:, None], data, 0
     )
     return Column(data.astype(jnp.uint8), dt.STRING, col.validity, new_len)
+
+
+def concat_ws(sep: str | bytes, *cols: Column) -> Column:
+    """Separator-joined rowwise concatenation (Spark ``concat_ws``):
+    null inputs are SKIPPED (not propagated — unlike ``concat``) and
+    the result is never null — rows where every input is null yield
+    the empty string."""
+    if not cols:
+        raise ValueError("concat_ws needs at least one column")
+    for c in cols:
+        _require_string(c)
+    sep_b = _literal_bytes(sep)
+    n = cols[0].data.shape[0]
+    sep_pad = max(len(sep_b), 1)
+    sep_col = Column(
+        jnp.broadcast_to(
+            jnp.zeros((sep_pad,), jnp.uint8).at[: len(sep_b)].set(
+                jnp.asarray(sep_b)
+            ),
+            (n, sep_pad),
+        ),
+        dt.STRING,
+        None,
+        jnp.full((n,), len(sep_b), jnp.int32),
+    )
+
+    out = None
+    started = jnp.zeros((n,), jnp.bool_)  # any non-null piece emitted yet
+    for c in cols:
+        have = compute.valid_mask(c)
+        piece = Column(c.data, dt.STRING, None,
+                       jnp.where(have, c.lengths, 0))
+        if out is None:
+            out = piece
+            started = have
+            continue
+        # separator only between emitted pieces
+        use_sep = started & have
+        sepc = Column(
+            sep_col.data, dt.STRING, None,
+            jnp.where(use_sep, sep_col.lengths, 0),
+        )
+        out = concat(concat(out, sepc), piece)
+        started = started | have
+    return Column(out.data, dt.STRING, None, out.lengths)
+
+
+def substring_column(col: Column, starts: Column, lengths: Column) -> Column:
+    """Per-row substring with 0-based start and length COLUMNS (the
+    dynamic form of ``substring``; cudf ``slice_strings`` with column
+    offsets). Out-of-range starts clamp; null starts/lengths propagate."""
+    _require_string(col)
+    n, pad_w = col.data.shape
+    s = jnp.clip(starts.data.astype(jnp.int32), 0, None)
+    s = jnp.minimum(s, col.lengths)
+    want = jnp.clip(lengths.data.astype(jnp.int32), 0, None)
+    new_len = jnp.minimum(want, col.lengths - s)
+    out = _shift_left(col, s, new_len)
+    valid = compute.merge_validity(col, starts, lengths)
+    return Column(out.data, dt.STRING, valid, out.lengths)

@@ -378,3 +378,50 @@ def test_url_encode_and_replace_re_empty_column():
     col = Column.from_strings([])
     assert url_encode(col).to_pylist() == []
     assert replace_re(col, r"\d+", "#").to_pylist() == []
+
+
+class TestConcatWsAndSlice:
+    def test_concat_ws_skips_nulls(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import concat_ws
+
+        a = Column.from_strings(["x", None, "p", None])
+        b = Column.from_strings(["y", "m", None, None])
+        c = Column.from_strings(["z", "n", "q", None])
+        out = concat_ws("-", a, b, c).to_pylist()
+        # Spark concat_ws skips nulls; all-null row yields ''
+        assert out == ["x-y-z", "m-n", "p-q", ""]
+
+    def test_concat_ws_multibyte_sep(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import concat_ws
+
+        a = Column.from_strings(["a", "bb"])
+        b = Column.from_strings(["c", "dd"])
+        assert concat_ws(", ", a, b).to_pylist() == ["a, c", "bb, dd"]
+
+    def test_substring_column(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import substring_column
+
+        col = Column.from_strings(["hello", "world", "hi", None])
+        starts = Column.from_numpy(np.array([1, 0, 5, 0], np.int32))
+        lens = Column.from_numpy(np.array([3, 2, 4, 1], np.int32))
+        out = substring_column(col, starts, lens).to_pylist()
+        assert out == ["ell", "wo", "", None]
+
+    def test_substring_column_null_offsets(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import substring_column
+
+        col = Column.from_strings(["abcdef", "ghij"])
+        starts = Column.from_numpy(
+            np.array([2, 0], np.int32), validity=np.array([True, False])
+        )
+        lens = Column.from_numpy(np.array([2, 2], np.int32))
+        out = substring_column(col, starts, lens).to_pylist()
+        assert out == ["cd", None]
