@@ -1124,8 +1124,17 @@ def concat_ws(sep: str | bytes, *cols: Column) -> Column:
     started = jnp.zeros((n,), jnp.bool_)  # any non-null piece emitted yet
     for c in cols:
         have = compute.valid_mask(c)
-        piece = Column(c.data, dt.STRING, None,
-                       jnp.where(have, c.lengths, 0))
+        lens = jnp.where(have, c.lengths, 0)
+        # re-zero bytes past the (possibly nulled-to-0) lengths: null
+        # rows may carry real bytes under their mask, and the string
+        # invariant (column.py: bytes past lengths[i] are zero) is load-
+        # bearing for order keys and equality
+        data = jnp.where(
+            jnp.arange(c.data.shape[1])[None, :] < lens[:, None],
+            c.data,
+            0,
+        ).astype(jnp.uint8)
+        piece = Column(data, dt.STRING, None, lens)
         if out is None:
             out = piece
             started = have

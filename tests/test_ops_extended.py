@@ -729,3 +729,65 @@ class TestDateTrunc:
         c = Column(days, dt.TIMESTAMP_DAYS, None)
         got = sdt.quarter(c).to_pylist()
         assert got == [1, 1, 1, 2, 2, 2, 3, 3, 3, 4, 4, 4]
+
+
+class TestSortVariadicPayload:
+    def test_matches_argsort_gather(self, rng):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column, Table
+        from spark_rapids_jni_tpu.ops import SortKey, sort_table
+        from spark_rapids_jni_tpu.ops.gather import gather_table
+        from spark_rapids_jni_tpu.ops.sort import argsort_table
+
+        n = 5_000
+        t = Table(
+            [
+                Column.from_numpy(
+                    rng.integers(0, 100, n),
+                    validity=rng.random(n) > 0.1,
+                ),
+                Column.from_numpy(rng.standard_normal(n)),
+                Column.from_strings(
+                    ["s%d" % i for i in rng.integers(0, 50, n)]
+                ),
+                Column.from_decimal128(
+                    [
+                        int(a) * (10**10) + int(b)
+                        for a, b in zip(
+                            rng.integers(-(10**9), 10**9, n),
+                            rng.integers(0, 10**9, n),
+                        )
+                    ]
+                ),
+            ],
+            ["k", "f", "s", "d"],
+        )
+        keys = [SortKey("k"), SortKey("f", ascending=False)]
+        fast = sort_table(t, keys)
+        ref = gather_table(t, argsort_table(t, keys))
+        assert fast.to_pydict() == ref.to_pydict()
+
+    def test_stability(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Table
+        from spark_rapids_jni_tpu.ops import SortKey, sort_table
+
+        t = Table.from_pydict({
+            "k": [1, 0, 1, 0, 1],
+            "tag": [0, 1, 2, 3, 4],
+        })
+        out = sort_table(t, [SortKey("k")])
+        assert out["tag"].to_pylist() == [1, 3, 0, 2, 4]
+
+    def test_payload_table(self, rng):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.column import Column, Table
+        from spark_rapids_jni_tpu.ops import SortKey, sort_table
+
+        keys = Table.from_pydict({"k": [3, 1, 2]})
+        payload = Table.from_pydict({"v": [30, 10, 20]})
+        out = sort_table(keys, [SortKey("k")], payload=payload)
+        assert out["v"].to_pylist() == [10, 20, 30]

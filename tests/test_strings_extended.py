@@ -425,3 +425,18 @@ class TestConcatWsAndSlice:
         lens = Column.from_numpy(np.array([2, 2], np.int32))
         out = substring_column(col, starts, lens).to_pylist()
         assert out == ["cd", None]
+
+    def test_concat_ws_single_column_rezeroes_null_bytes(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import binary_op, concat, concat_ws
+
+        # concat leaves real bytes under null rows; concat_ws of that
+        # single column must re-zero them so '' equality holds
+        a = Column.from_strings(["x", None])
+        b = Column.from_strings(["y", "zz"])
+        c = concat(a, b)  # row 1 null but carries 'zz' bytes
+        out = concat_ws("-", c)
+        assert out.to_pylist() == ["xy", ""]
+        empty = Column.from_strings(["xy", ""])
+        eq = binary_op("eq", out, empty)
+        assert eq.to_pylist() == [True, True]
