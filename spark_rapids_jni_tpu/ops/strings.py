@@ -1125,15 +1125,19 @@ def concat_ws(sep: str | bytes, *cols: Column) -> Column:
     for c in cols:
         have = compute.valid_mask(c)
         lens = jnp.where(have, c.lengths, 0)
-        # re-zero bytes past the (possibly nulled-to-0) lengths: null
-        # rows may carry real bytes under their mask, and the string
-        # invariant (column.py: bytes past lengths[i] are zero) is load-
-        # bearing for order keys and equality
-        data = jnp.where(
-            jnp.arange(c.data.shape[1])[None, :] < lens[:, None],
-            c.data,
-            0,
-        ).astype(jnp.uint8)
+        data = c.data
+        if c.validity is not None:
+            # re-zero bytes past the nulled-to-0 lengths: null rows may
+            # carry real bytes under their mask, and the string
+            # invariant (column.py: bytes past lengths[i] are zero) is
+            # load-bearing for order keys and equality. (concat()
+            # re-zeroes its own output, so this matters on the
+            # single-column direct-return path.)
+            data = jnp.where(
+                jnp.arange(c.data.shape[1])[None, :] < lens[:, None],
+                c.data,
+                0,
+            ).astype(jnp.uint8)
         piece = Column(data, dt.STRING, None, lens)
         if out is None:
             out = piece

@@ -342,6 +342,63 @@ int main() {
     std::printf("jni_harness: DECIMAL128 wire sort ok\n");
   }
 
+  /* -- 3d. STRING columns over the JNI wire (Arrow offsets+bytes) +
+   *        the regex row filter (rlike) --------------------------------*/
+  {
+    const int64_t sn = 4;
+    const char* words[4] = {"id=42", "nope", "id=7", "xid="};
+    std::vector<int32_t> offs(sn + 1, 0);
+    std::string payload;
+    for (int i = 0; i < sn; ++i) {
+      payload += words[i];
+      offs[i + 1] = static_cast<int32_t>(payload.size());
+    }
+    std::vector<uint8_t> swire(4 * (sn + 1) + payload.size());
+    std::memcpy(swire.data(), offs.data(), 4 * (sn + 1));
+    std::memcpy(swire.data() + 4 * (sn + 1), payload.data(),
+                payload.size());
+    std::vector<int64_t> skeys = {0, 1, 2, 3};
+    srt_handle hsk = srt_buffer_create(skeys.data(), sn * 8, "s-k");
+    srt_handle hss = srt_buffer_create(swire.data(),
+                                       static_cast<int64_t>(swire.size()),
+                                       "s-s");
+    CHECK(hsk != 0 && hss != 0, "string wire buffers");
+    jintArray sid = srt_mock::make_int_array({kInt64, 23 /* STRING */});
+    jintArray ssc = srt_mock::make_int_array({0, 0});
+    jlongArray sdat = srt_mock::make_long_array({hsk, hss});
+    jlongArray sval = srt_mock::make_long_array({0, 0});
+    jlongArray sres = Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(
+        env, cls,
+        srt_mock::make_string(
+            "{\"op\": \"rlike\", \"column\": 1, "
+            "\"pattern\": \"^id=\\\\d+$\"}"),
+        sid, ssc, sdat, sval, sn);
+    CHECK(!srt_mock::exception_pending() && sres != nullptr,
+          "string rlike dispatch");
+    std::vector<jlong> sv = srt_mock::long_array_values(sres);
+    CHECK(sv[0] == 2 && sv[1] == 2, "rlike result shape (2 rows kept)");
+    CHECK(sv[2] == kInt64 && sv[3] == 23, "rlike type echo");
+    const int64_t scols = sv[0];
+    const auto* fk =
+        static_cast<const int64_t*>(srt_buffer_data(sv[2 + 2 * scols]));
+    CHECK(fk[0] == 0 && fk[1] == 2, "rlike kept the matching rows");
+    const auto* fs = static_cast<const uint8_t*>(
+        srt_buffer_data(sv[2 + 2 * scols + 1]));
+    const auto* foffs = reinterpret_cast<const int32_t*>(fs);
+    CHECK(foffs[0] == 0 && foffs[1] == 5 && foffs[2] == 9,
+          "filtered string offsets");
+    CHECK(std::memcmp(fs + 4 * 3, "id=42id=7", 9) == 0,
+          "filtered string payload");
+    for (int64_t i = 0; i < scols; ++i) {
+      srt_buffer_release(sv[2 + 2 * scols + i]);
+      if (sv[2 + 3 * scols + i] != 0)
+        srt_buffer_release(sv[2 + 3 * scols + i]);
+    }
+    srt_buffer_release(hsk);
+    srt_buffer_release(hss);
+    std::printf("jni_harness: STRING wire rlike ok\n");
+  }
+
   /* -- 4. error paths must record pending Java exceptions ------------ */
   CHECK_THROWS(
       Java_com_nvidia_spark_rapids_jni_DeviceTable_tableOpNative(

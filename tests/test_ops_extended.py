@@ -733,8 +733,6 @@ class TestDateTrunc:
 
 class TestSortVariadicPayload:
     def test_matches_argsort_gather(self, rng):
-        import numpy as np
-
         from spark_rapids_jni_tpu.column import Column, Table
         from spark_rapids_jni_tpu.ops import SortKey, sort_table
         from spark_rapids_jni_tpu.ops.gather import gather_table
@@ -769,8 +767,6 @@ class TestSortVariadicPayload:
         assert fast.to_pydict() == ref.to_pydict()
 
     def test_stability(self):
-        import numpy as np
-
         from spark_rapids_jni_tpu.column import Table
         from spark_rapids_jni_tpu.ops import SortKey, sort_table
 
@@ -782,8 +778,6 @@ class TestSortVariadicPayload:
         assert out["tag"].to_pylist() == [1, 3, 0, 2, 4]
 
     def test_payload_table(self, rng):
-        import numpy as np
-
         from spark_rapids_jni_tpu.column import Column, Table
         from spark_rapids_jni_tpu.ops import SortKey, sort_table
 
