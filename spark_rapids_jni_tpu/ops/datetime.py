@@ -218,3 +218,60 @@ def truncate(col: Column, unit: str) -> Column:
     return Column(
         ticks.astype(col.data.dtype), col.dtype, col.validity
     )
+
+
+def _subsecond_ticks(col: Column):
+    """Ticks past the whole second (floor semantics), in the column's
+    own resolution; zero for second/day resolutions."""
+    _require_timestamp(col)
+    per_sec = _TICKS_PER_SECOND.get(col.dtype.id, 1)
+    if per_sec == 1:
+        return jnp.zeros(col.data.shape, jnp.int64)
+    ticks = col.data.astype(jnp.int64)
+    secs = ticks // per_sec
+    return ticks - secs * per_sec
+
+
+def millisecond_fraction(col: Column) -> Column:
+    """Milliseconds past the second, 0-999 (cudf
+    ``extract_millisecond_fraction``)."""
+    per_sec = _TICKS_PER_SECOND.get(col.dtype.id, 1)
+    # sub-second ticks are already zero below millisecond resolution,
+    # so the unconditional formula covers every unit
+    out = _subsecond_ticks(col) * 1_000 // max(per_sec, 1_000)
+    return Column(out.astype(jnp.int16), dt.INT16, col.validity)
+
+
+def microsecond_fraction(col: Column) -> Column:
+    """Microseconds within the millisecond, 0-999 (cudf
+    ``extract_microsecond_fraction``)."""
+    _require_timestamp(col)
+    per_sec = _TICKS_PER_SECOND.get(col.dtype.id, 1)
+    if per_sec < 1_000_000:
+        out = jnp.zeros(col.data.shape, jnp.int16)
+        return Column(out, dt.INT16, col.validity)
+    us = _subsecond_ticks(col) * 1_000_000 // per_sec
+    return Column(
+        (us % 1_000).astype(jnp.int16), dt.INT16, col.validity
+    )
+
+
+def nanosecond_fraction(col: Column) -> Column:
+    """Nanoseconds within the microsecond, 0-999 (cudf
+    ``extract_nanosecond_fraction``)."""
+    if col.dtype.id != dt.TypeId.TIMESTAMP_NANOSECONDS:
+        _require_timestamp(col)
+        return Column(
+            jnp.zeros(col.data.shape, jnp.int16), dt.INT16, col.validity
+        )
+    ns = _subsecond_ticks(col)
+    return Column(
+        (ns % 1_000).astype(jnp.int16), dt.INT16, col.validity
+    )
+
+
+def day_of_week_sunday(col: Column) -> Column:
+    """Spark ``dayofweek``: 1=Sunday .. 7=Saturday (vs ``weekday``'s
+    ISO 1=Monday .. 7=Sunday)."""
+    # 1970-01-01 was a Thursday: Sunday-based index 5 (Sun=1)
+    return _field(col, lambda d, s: ((d + 4) % 7) + 1)

@@ -785,3 +785,50 @@ class TestSortVariadicPayload:
         payload = Table.from_pydict({"v": [30, 10, 20]})
         out = sort_table(keys, [SortKey("k")], payload=payload)
         assert out["v"].to_pylist() == [10, 20, 30]
+
+
+class TestSubsecondDatetime:
+    def test_fractions(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops import datetime as sdt
+
+        # 1.234567891 seconds past epoch, in ns resolution
+        ns = np.array([1_234_567_891, -1_500_000_000], np.int64)
+        c = Column(ns, dt.DType(dt.TypeId.TIMESTAMP_NANOSECONDS), None)
+        assert sdt.millisecond_fraction(c).to_pylist() == [234, 500]
+        assert sdt.microsecond_fraction(c).to_pylist() == [567, 0]
+        assert sdt.nanosecond_fraction(c).to_pylist() == [891, 0]
+        # second-resolution columns have zero fractions
+        cs = Column(
+            np.array([5], np.int64),
+            dt.DType(dt.TypeId.TIMESTAMP_SECONDS), None,
+        )
+        assert sdt.millisecond_fraction(cs).to_pylist() == [0]
+
+    def test_day_of_week_sunday(self):
+        import datetime as _dt
+
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops import datetime as sdt
+
+        days = np.array(
+            [
+                (_dt.date(2024, 7, 28) - _dt.date(1970, 1, 1)).days + i
+                for i in range(7)
+            ],
+            np.int32,
+        )  # 2024-07-28 is a Sunday
+        c = Column(days, dt.TIMESTAMP_DAYS, None)
+        assert sdt.day_of_week_sunday(c).to_pylist() == [
+            1, 2, 3, 4, 5, 6, 7,
+        ]
+
+    def test_fraction_type_guard(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops import datetime as sdt
+
+        bad = Column.from_numpy(np.array([1, 2], np.int64))
+        for fn in (sdt.millisecond_fraction, sdt.microsecond_fraction,
+                   sdt.nanosecond_fraction):
+            with pytest.raises(TypeError):
+                fn(bad)
