@@ -997,6 +997,26 @@ def _hex_val(m):
     return jnp.where((m >= ord("A")) & (m <= ord("F")), upper_l, out)
 
 
+def _compact_bytes(values, keep):
+    """Left-compact the kept bytes of each row via the cumsum-positioned
+    dump-column scatter (shared by url_decode and translate): returns
+    ((n, pad) uint8 data zero-padded past the new lengths, (n,) int32
+    lengths)."""
+    n, pad_w = values.shape
+    out_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    new_len = jnp.sum(keep.astype(jnp.int32), axis=1).astype(jnp.int32)
+    rows = jnp.arange(n)[:, None]
+    idx = jnp.where(keep, out_pos, pad_w)
+    out = jnp.zeros((n, pad_w + 1), jnp.uint8)
+    out = out.at[rows, idx].set(
+        jnp.where(keep, values, 0).astype(jnp.uint8)
+    )
+    data = jnp.where(
+        jnp.arange(pad_w)[None, :] < new_len[:, None], out[:, :pad_w], 0
+    )
+    return data.astype(jnp.uint8), new_len
+
+
 def url_decode(col: Column) -> Column:
     """Percent-decoding: ``%XX`` -> byte, ``+`` -> space (cudf
     ``url_decode`` / java.net.URLDecoder). Malformed escapes pass
@@ -1034,19 +1054,8 @@ def url_decode(col: Column) -> Column:
         esc_start, decoded,
         jnp.where(m == ord("+"), jnp.uint8(ord(" ")), m),
     )
-    out_pos = jnp.cumsum(emits.astype(jnp.int32), axis=1) - 1
-    new_len = jnp.sum(emits.astype(jnp.int32), axis=1)
-    rows = jnp.arange(n)[:, None]
-    dump = pad_w
-    idx = jnp.where(emits, out_pos, dump)
-    out = jnp.zeros((n, pad_w + 1), jnp.uint8)
-    out = out.at[rows, idx].set(jnp.where(emits, out_val, 0))
-    data = out[:, :pad_w]
-    data = jnp.where(j < new_len[:, None], data, 0)
-    return Column(
-        data.astype(jnp.uint8), dt.STRING, col.validity,
-        new_len.astype(jnp.int32),
-    )
+    data, new_len = _compact_bytes(out_val, emits)
+    return Column(data, dt.STRING, col.validity, new_len)
 
 
 def url_encode(col: Column) -> Column:
@@ -1167,3 +1176,39 @@ def substring_column(col: Column, starts: Column, lengths: Column) -> Column:
     out = _shift_left(col, s, new_len)
     valid = compute.merge_validity(col, starts, lengths)
     return Column(out.data, dt.STRING, valid, out.lengths)
+
+
+def translate(col: Column, from_chars: str | bytes,
+              to_chars: str | bytes) -> Column:
+    """Per-byte mapping (Spark ``translate``): byte ``from_chars[i]``
+    becomes ``to_chars[i]``; positions of ``from_chars`` beyond
+    ``len(to_chars)`` are DELETED. One 256-entry LUT gather does the
+    mapping; deletions compact with the cumsum-positioned scatter the
+    url codec uses."""
+    _require_string(col)
+    for name, s in (("from_chars", from_chars), ("to_chars", to_chars)):
+        if isinstance(s, str) and not s.isascii():
+            raise ValueError(
+                f"translate: {name} must be ASCII (byte-level op; "
+                "multi-byte UTF-8 chars would corrupt unrelated bytes)"
+            )
+    f = _literal_bytes(from_chars)
+    t = _literal_bytes(to_chars)
+    lut = np.arange(256, dtype=np.int32)  # identity; -1 = delete
+    seen: set = set()
+    for i, ch in enumerate(f):
+        if ch in seen:
+            continue  # first occurrence wins (Spark/Oracle TRANSLATE)
+        seen.add(ch)
+        lut[ch] = t[i] if i < len(t) else -1
+    lut_d = jnp.asarray(lut)
+    n, pad_w = col.data.shape
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < col.lengths[:, None]
+    mapped = lut_d[col.data.astype(jnp.int32)]
+    if not (lut < 0).any():
+        data = jnp.where(in_str, mapped, 0).astype(jnp.uint8)
+        return Column(data, dt.STRING, col.validity, col.lengths)
+    keep = in_str & (mapped >= 0)
+    data, new_len = _compact_bytes(mapped, keep)
+    return Column(data, dt.STRING, col.validity, new_len)

@@ -440,3 +440,37 @@ class TestConcatWsAndSlice:
         empty = Column.from_strings(["xy", ""])
         eq = binary_op("eq", out, empty)
         assert eq.to_pylist() == [True, True]
+
+
+class TestTranslate:
+    def test_mapping_and_deletion(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import translate
+
+        col = Column.from_strings(["abcabc", "xyz", None, ""])
+        # a->1, b->2, c deleted (to shorter than from)
+        out = translate(col, "abc", "12").to_pylist()
+        want = [w.translate(str.maketrans("ab", "12", "c"))
+                if w is not None else None
+                for w in ["abcabc", "xyz", None, ""]]
+        assert out == want
+
+    def test_pure_mapping_no_deletion(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import translate
+
+        col = Column.from_strings(["hello world"])
+        out = translate(col, "lo ", "01_").to_pylist()
+        assert out == ["hello world".translate(str.maketrans("lo ", "01_"))]
+
+    def test_first_occurrence_wins_and_ascii_guard(self):
+        from spark_rapids_jni_tpu.column import Column
+        from spark_rapids_jni_tpu.ops.strings import translate
+        import pytest as _pytest
+
+        col = Column.from_strings(["aaa"])
+        # Spark TRANSLATE: first duplicate mapping wins
+        assert translate(col, "aba", "xyz").to_pylist() == ["xxx"]
+        assert translate(col, "aa", "x").to_pylist() == ["xxx"]
+        with _pytest.raises(ValueError):
+            translate(col, "é", "e")
