@@ -1205,7 +1205,7 @@ def translate(col: Column, from_chars: str | bytes,
     n, pad_w = col.data.shape
     j = jnp.arange(pad_w)[None, :]
     in_str = j < col.lengths[:, None]
-    mapped = lut_d[col.data.astype(jnp.int32)]
+    mapped = lut_d[col.data]  # uint8 indexes the 256-entry LUT directly
     if not (lut < 0).any():
         data = jnp.where(in_str, mapped, 0).astype(jnp.uint8)
         return Column(data, dt.STRING, col.validity, col.lengths)

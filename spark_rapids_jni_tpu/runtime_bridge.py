@@ -274,6 +274,25 @@ def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
         if not rest:
             raise ValueError("cross_join needs two input tables")
         return ops.cross_join(table, rest[0])
+    if name == "slice":
+        n = table.row_count
+        start = int(op.get("start", 0))
+        stop = int(op.get("stop", n))
+        if start < 0 or stop < 0:
+            raise ValueError(
+                f"slice: negative bounds not supported (start={start}, "
+                f"stop={stop})"
+            )
+        start = min(start, n)
+        stop = max(start, min(stop, n))
+        return ops.slice_rows(table, start, stop)
+    if name == "repeat":
+        return ops.repeat(table, int(op["count"]))
+    if name == "sample":
+        return ops.sample(
+            table, int(op["n"]), seed=int(op.get("seed", 0)),
+            replacement=bool(op.get("replacement", False)),
+        )
     if name == "to_rows":
         # device row transpose; result = a true LIST<UINT8> column (the
         # reference's output type, row_conversion.cu:389-406)

@@ -108,3 +108,37 @@ def test_explode_over_wire():
     )
     assert out_t == [I64] and n == 3
     assert np.frombuffer(out_d[0], np.int64, 3).tolist() == [5, 6, 9]
+
+
+def test_slice_repeat_sample_over_wire():
+    k = np.arange(10, dtype=np.int64)
+    op = json.dumps({"op": "slice", "start": 2, "stop": 5})
+    _, _, out_d, _, n = rb.table_op_wire(
+        op, [I64], [0], [k.tobytes()], [None], 10
+    )
+    assert n == 3
+    assert np.frombuffer(out_d[0], np.int64, n).tolist() == [2, 3, 4]
+
+    op2 = json.dumps({"op": "repeat", "count": 2})
+    _, _, out2, _, n2 = rb.table_op_wire(
+        op2, [I64], [0], [k[:3].tobytes()], [None], 3
+    )
+    assert n2 == 6
+    assert np.frombuffer(out2[0], np.int64, n2).tolist() == [0, 0, 1, 1, 2, 2]
+
+    op3 = json.dumps({"op": "sample", "n": 4, "seed": 7})
+    _, _, out3, _, n3 = rb.table_op_wire(
+        op3, [I64], [0], [k.tobytes()], [None], 10
+    )
+    assert n3 == 4
+    vals = np.frombuffer(out3[0], np.int64, n3)
+    assert len(set(vals.tolist())) == 4 and all(0 <= v < 10 for v in vals)
+
+
+def test_slice_negative_bounds_raise():
+    k = np.arange(4, dtype=np.int64)
+    with pytest.raises(Exception):
+        rb.table_op_wire(
+            json.dumps({"op": "slice", "start": -2}),
+            [I64], [0], [k.tobytes()], [None], 4,
+        )
