@@ -77,6 +77,7 @@ from . import lists, regex
 from .lists import (
     count_elements,
     explode,
+    split_explode,
     explode_outer,
     explode_position,
     extract_list_element,
@@ -175,6 +176,7 @@ __all__ = [
     "lists",
     "count_elements",
     "explode",
+    "split_explode",
     "explode_outer",
     "explode_position",
     "extract_list_element",

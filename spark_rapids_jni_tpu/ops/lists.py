@@ -61,9 +61,52 @@ def extract_list_element(col: Column, index: int) -> Column:
     return Column(vals, col.list_child_dtype, validity)
 
 
+def _replication_plan(slots: np.ndarray):
+    """Host-synced (parent, slot-within-parent) plan for exploding
+    ``slots[i]`` output rows per input row (two-phase: count, then
+    gather — the filter/join eager discipline)."""
+    total = int(slots.sum())
+    offsets = np.concatenate([[0], np.cumsum(slots)])
+    out_idx = np.arange(total)
+    parent = np.searchsorted(offsets, out_idx, side="right") - 1
+    element = out_idx - offsets[parent]
+    return parent.astype(np.int32), element.astype(np.int32)
+
+
+def _replicate_siblings(table: Table, ci: int, parent_j, new_col: Column,
+                        leading: list | None = None):
+    """Rebuild a table with row ``parent_j`` replication, the column at
+    ``ci`` replaced by ``new_col`` (optionally preceded by ``leading``
+    (name, Column) pairs) — shared by the explode family."""
+    out_cols, out_names = [], []
+    names = table.names
+    for i, c in enumerate(table.columns):
+        name = names[i] if names is not None else f"c{i}"
+        if i == ci:
+            for lname, lcol_ in leading or []:
+                out_cols.append(lcol_)
+                out_names.append(lname)
+            out_cols.append(new_col)
+            out_names.append(name)
+        else:
+            data = (
+                c.data[parent_j]
+                if c.data.ndim == 1
+                else c.data[parent_j, :]
+            )
+            validity = (
+                c.validity[parent_j] if c.validity is not None else None
+            )
+            lengths = (
+                c.lengths[parent_j] if c.lengths is not None else None
+            )
+            out_cols.append(Column(data, c.dtype, validity, lengths))
+            out_names.append(name)
+    return Table(out_cols, out_names if names is not None else None)
+
+
 def _explode_gather(col: Column, outer: bool):
-    """Host-synced parent/element index plan for explode (two-phase:
-    count, then gather — the filter/join eager discipline)."""
+    """Explode index plan: (parent, element, element-valid mask)."""
     lens = np.asarray(col.lengths).astype(np.int64)
     valid = (
         np.ones(len(lens), dtype=bool)
@@ -71,20 +114,11 @@ def _explode_gather(col: Column, outer: bool):
         else np.asarray(col.validity)
     )
     lens = np.where(valid, lens, 0)
-    if outer:
-        # empty/null lists contribute ONE null output row
-        slots = np.maximum(lens, 1)
-    else:
-        slots = lens
-    total = int(slots.sum())
-    offsets = np.concatenate([[0], np.cumsum(slots)])
-    out_idx = np.arange(total)
-    parent = np.searchsorted(offsets, out_idx, side="right") - 1
-    element = out_idx - offsets[parent]
-    # element is in-range except the placeholder row of an empty/null
-    # parent under outer semantics
+    # under outer semantics empty/null lists contribute ONE null row
+    slots = np.maximum(lens, 1) if outer else lens
+    parent, element = _replication_plan(slots)
     elem_valid = element < lens[parent]
-    return parent.astype(np.int32), element.astype(np.int32), elem_valid
+    return parent, element, elem_valid
 
 
 def _explode_table(
@@ -109,42 +143,18 @@ def _explode_table(
         None if bool(elem_valid.all()) else elem_valid_j,
     )
 
-    out_cols, out_names = [], []
-    names = table.names
-    for i, c in enumerate(table.columns):
-        name = names[i] if names is not None else f"c{i}"
-        if i == ci:
-            if position:
-                pos_validity = (
-                    None if bool(elem_valid.all()) else elem_valid_j
-                )
-                out_cols.append(
-                    Column(
-                        jnp.where(elem_valid_j, element_j, 0).astype(
-                            jnp.int32
-                        ),
-                        dt.INT32,
-                        pos_validity,
-                    )
-                )
-                out_names.append("pos")
-            out_cols.append(child)
-            out_names.append(name)
-        else:
-            data = (
-                c.data[parent_j]
-                if c.data.ndim == 1
-                else c.data[parent_j, :]
-            )
-            validity = (
-                c.validity[parent_j] if c.validity is not None else None
-            )
-            lengths = (
-                c.lengths[parent_j] if c.lengths is not None else None
-            )
-            out_cols.append(Column(data, c.dtype, validity, lengths))
-            out_names.append(name)
-    return Table(out_cols, out_names if names is not None else None)
+    leading = []
+    if position:
+        pos_validity = None if bool(elem_valid.all()) else elem_valid_j
+        leading.append((
+            "pos",
+            Column(
+                jnp.where(elem_valid_j, element_j, 0).astype(jnp.int32),
+                dt.INT32,
+                pos_validity,
+            ),
+        ))
+    return _replicate_siblings(table, ci, parent_j, child, leading)
 
 
 def explode(table: Table, column: Union[int, str]) -> Table:
@@ -166,3 +176,61 @@ def explode_position(
     """Explode with a leading ``pos`` INT32 column of element indexes
     (cudf ``explode_position``; Spark ``posexplode``)."""
     return _explode_table(table, column, outer=outer, position=True)
+
+
+def split_explode(
+    table: Table, column: Union[int, str], delimiter: str | bytes
+) -> Table:
+    """Split a string column on a single-byte delimiter and explode the
+    tokens to rows in one op — the fused form of Spark's
+    ``explode(split(col, d))`` (and of cudf ``strings::split_record`` +
+    ``explode``), which sidesteps materializing a LIST<STRING> column
+    under the static-shape regime. Null strings produce no rows (Spark
+    explode of a null array); empty strings produce one empty token.
+
+    The exploded column keeps its name; sibling columns replicate per
+    token. Eager (host-syncs the token total, the cudf call model)."""
+    from .join import _resolve_col
+    from .strings import _literal_bytes, _require_string, _shift_left
+
+    ci = _resolve_col(table, column)
+    scol = table.columns[ci]
+    _require_string(scol)
+    d = _literal_bytes(delimiter)
+    if len(d) != 1:
+        raise ValueError("split_explode: single-byte delimiter only")
+
+    n, pad = scol.data.shape
+    j = jnp.arange(pad)[None, :]
+    in_str = j < scol.lengths[:, None]
+    is_delim = (scol.data == d[0]) & in_str
+    ntokens = jnp.sum(is_delim.astype(jnp.int32), axis=1) + 1
+    valid = (
+        np.ones(n, bool)
+        if scol.validity is None
+        else np.asarray(scol.validity)
+    )
+    counts = np.where(valid, np.asarray(ntokens), 0).astype(np.int64)
+    parent, tok = _replication_plan(counts)
+    parent_j = jnp.asarray(parent)
+    tok_j = jnp.asarray(tok)
+
+    # token-id per byte computed ONCE on the (n, pad) matrix, then
+    # gathered — not recomputed over the exploded (total, pad) matrix
+    field_n = jnp.cumsum(is_delim.astype(jnp.int32), axis=1) - is_delim.astype(
+        jnp.int32
+    )
+    gdata = scol.data[parent_j]
+    glens = scol.lengths[parent_j]
+    gin = is_delim[parent_j]  # delimiter mask, gathered
+    gfield = field_n[parent_j]
+    in_g = jnp.arange(pad)[None, :] < glens[:, None]
+    keep = in_g & ~gin & (gfield == tok_j[:, None])
+    tok_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    has = jnp.any(keep, axis=1)
+    start = jnp.where(has, jnp.argmax(keep, axis=1), 0).astype(jnp.int32)
+    tokens = _shift_left(
+        Column(gdata, dt.STRING, None, glens), start, tok_len
+    )
+
+    return _replicate_siblings(table, ci, parent_j, tokens)

@@ -110,3 +110,57 @@ def test_non_list_raises():
     t = _table()
     with pytest.raises(TypeError):
         L.explode(t, "k")
+
+
+class TestSplitExplode:
+    def test_basic(self):
+        from spark_rapids_jni_tpu.ops import split_explode
+
+        t = Table(
+            [
+                Column.from_numpy(np.array([1, 2, 3, 4], dtype=np.int64)),
+                Column.from_strings(["a,b,c", "", None, "x,,y"]),
+            ],
+            ["k", "s"],
+        )
+        out = split_explode(t, "s", ",")
+        # null -> no rows; "" -> one empty token; "x,,y" -> x, "", y
+        assert out["k"].to_pylist() == [1, 1, 1, 2, 4, 4, 4]
+        assert out["s"].to_pylist() == ["a", "b", "c", "", "x", "", "y"]
+
+    def test_oracle(self, rng):
+        from spark_rapids_jni_tpu.ops import split_explode
+
+        words = []
+        for _ in range(300):
+            k = int(rng.integers(0, 5))
+            words.append(
+                ",".join(
+                    "".join(rng.choice(list("abc"), int(rng.integers(0, 4))))
+                    for _ in range(k + 1)
+                )
+                if rng.random() > 0.1
+                else None
+            )
+        keys = np.arange(len(words), dtype=np.int64)
+        t = Table(
+            [Column.from_numpy(keys), Column.from_strings(words)],
+            ["k", "s"],
+        )
+        out = split_explode(t, "s", ",")
+        want_k, want_s = [], []
+        for key, w in zip(keys.tolist(), words):
+            if w is None:
+                continue
+            for tok in w.split(","):
+                want_k.append(key)
+                want_s.append(tok)
+        assert out["k"].to_pylist() == want_k
+        assert out["s"].to_pylist() == want_s
+
+    def test_multibyte_delim_rejected(self):
+        from spark_rapids_jni_tpu.ops import split_explode
+
+        t = Table([Column.from_strings(["ab"])], ["s"])
+        with pytest.raises(ValueError):
+            split_explode(t, "s", "--")
