@@ -191,7 +191,7 @@ def split_explode(
     The exploded column keeps its name; sibling columns replicate per
     token. Eager (host-syncs the token total, the cudf call model)."""
     from .join import _resolve_col
-    from .strings import _literal_bytes, _require_string, _shift_left
+    from .strings import _literal_bytes, _require_string
 
     ci = _resolve_col(table, column)
     scol = table.columns[ci]
@@ -215,22 +215,13 @@ def split_explode(
     parent_j = jnp.asarray(parent)
     tok_j = jnp.asarray(tok)
 
-    # token-id per byte computed ONCE on the (n, pad) matrix, then
-    # gathered — not recomputed over the exploded (total, pad) matrix
-    field_n = jnp.cumsum(is_delim.astype(jnp.int32), axis=1) - is_delim.astype(
-        jnp.int32
-    )
-    gdata = scol.data[parent_j]
-    glens = scol.lengths[parent_j]
-    gin = is_delim[parent_j]  # delimiter mask, gathered
-    gfield = field_n[parent_j]
-    in_g = jnp.arange(pad)[None, :] < glens[:, None]
-    keep = in_g & ~gin & (gfield == tok_j[:, None])
-    tok_len = jnp.sum(keep.astype(jnp.int32), axis=1)
-    has = jnp.any(keep, axis=1)
-    start = jnp.where(has, jnp.argmax(keep, axis=1), 0).astype(jnp.int32)
-    tokens = _shift_left(
-        Column(gdata, dt.STRING, None, glens), start, tok_len
+    # token extraction = the shared split_get kernel over the
+    # parent-gathered byte matrix with a per-row token index
+    from .strings import _extract_token
+
+    tokens = _extract_token(
+        scol.data[parent_j], scol.lengths[parent_j], None,
+        int(d[0]), tok_j,
     )
 
     return _replicate_siblings(table, ci, parent_j, tokens)

@@ -807,6 +807,36 @@ def replace(col: Column, old: str | bytes, new: str | bytes) -> Column:
     return Column.from_strings(out)
 
 
+def _extract_token(
+    data, lengths, validity, delim_byte: int, token_index
+) -> Column:
+    """The k-th delimiter-separated token of each row (shared by
+    split_get and lists.split_explode): ``token_index`` may be a scalar
+    or a per-row array. Out-of-range tokens are empty strings."""
+    pad_w = data.shape[1]
+    j = jnp.arange(pad_w)[None, :]
+    in_str = j < lengths[:, None]
+    is_delim = (data == delim_byte) & in_str
+    # field id of each byte = number of delimiters before it
+    field = jnp.cumsum(is_delim.astype(jnp.int32), axis=1) - is_delim.astype(
+        jnp.int32
+    )
+    idx = (
+        token_index
+        if jnp.ndim(token_index) == 0
+        else token_index[:, None]
+    )
+    keep = in_str & ~is_delim & (field == idx)
+    tok_len = jnp.sum(keep, axis=1)
+    has = jnp.any(keep, axis=1)
+    start = jnp.where(has, jnp.argmax(keep, axis=1), 0)
+    return _shift_left(
+        Column(data, dt.STRING, validity, lengths),
+        start.astype(jnp.int32),
+        tok_len.astype(jnp.int32),
+    )
+
+
 def split_get(col: Column, delimiter: str | bytes, index: int) -> Column:
     """k-th field after splitting on a single-byte delimiter (Spark
     ``split_part`` with 0-based index); empty string when out of range."""
@@ -814,23 +844,8 @@ def split_get(col: Column, delimiter: str | bytes, index: int) -> Column:
     d = _literal_bytes(delimiter)
     if len(d) != 1:
         raise ValueError("split_get: single-byte delimiter only")
-    n, pad_w = col.data.shape
-    j = jnp.arange(pad_w)[None, :]
-    in_str = j < col.lengths[:, None]
-    is_delim = (col.data == d[0]) & in_str
-    # field id of each byte = number of delimiters before it
-    field = jnp.cumsum(is_delim.astype(jnp.int32), axis=1) - is_delim.astype(
-        jnp.int32
-    )
-    keep = in_str & ~is_delim & (field == index)
-    tok_len = jnp.sum(keep, axis=1)
-    # start = first kept position (or 0)
-    has = jnp.any(keep, axis=1)
-    start = jnp.where(has, jnp.argmax(keep, axis=1), 0)
-    return _shift_left(
-        Column(col.data, dt.STRING, col.validity, col.lengths),
-        start.astype(jnp.int32),
-        tok_len.astype(jnp.int32),
+    return _extract_token(
+        col.data, col.lengths, col.validity, int(d[0]), index
     )
 
 

@@ -23,6 +23,7 @@ from .distributed import (
     JoinOverflowError,
     broadcast_inner_join,
     distributed_anti_join,
+    distributed_distinct,
     distributed_left_join,
     distributed_semi_join,
     distributed_groupby,
@@ -47,6 +48,7 @@ __all__ = [
     "JoinOverflowError",
     "broadcast_inner_join",
     "distributed_anti_join",
+    "distributed_distinct",
     "distributed_left_join",
     "distributed_semi_join",
     "distributed_groupby",

@@ -335,6 +335,37 @@ def distributed_anti_join(
     )
 
 
+def distributed_distinct(
+    table: Table,
+    keys: Optional[Sequence[Union[int, str]]] = None,
+    mesh: Mesh = None,
+    capacity: Optional[int] = None,
+    axis: str = SHUFFLE_AXIS,
+    on_overflow: str = "raise",
+):
+    """Distributed DISTINCT (Spark dropDuplicates / cudf distinct):
+    hash-exchange by the key columns so every duplicate lands on one
+    device, then local dedup — expressed as a groupby with no
+    aggregations, which reuses the lossless exchange + occupancy
+    machinery wholesale. Returns (sharded padded key table, per-device
+    distinct counts, shuffle overflow)."""
+    if mesh is None:
+        raise TypeError(
+            "distributed_distinct: mesh is required "
+            "(keys defaults to all columns, mesh does not default)"
+        )
+    if keys is None:
+        keys = (
+            list(table.names)
+            if table.names is not None
+            else list(range(table.num_columns))
+        )
+    return distributed_groupby(
+        table, keys, [], mesh, capacity=capacity, axis=axis,
+        on_overflow=on_overflow,
+    )
+
+
 def broadcast_inner_join(
     left: Table,
     right: Table,

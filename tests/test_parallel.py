@@ -740,3 +740,28 @@ class TestDistributedOuterAndMembership:
         )
         # every left row emits exactly once: 16 matches + 16 null-key rows
         assert int(np.asarray(counts).sum()) == 32
+
+
+class TestDistributedDistinct:
+    def test_matches_host_oracle(self, mesh, rng):
+        n = 1600
+        k = rng.integers(0, 60, n, dtype=np.int64)
+        s = ["tag%d" % (v % 7) for v in rng.integers(0, 100, n)]
+        t = Table(
+            [Column.from_numpy(k), Column.from_strings(s)], ["k", "s"]
+        )
+        out, counts, overflow = parallel.distributed_distinct(
+            t, ["k", "s"], mesh
+        )
+        assert int(np.asarray(overflow).max()) <= 0
+        per_dev = np.asarray(counts)
+        got = set()
+        kk = np.asarray(out["k"].data)
+        ss = out["s"].to_pylist()
+        cap = out.row_count // 8
+        for d in range(8):
+            base = d * cap
+            for i in range(base, base + int(per_dev[d])):
+                got.add((int(kk[i]), ss[i]))
+        want = set(zip(k.tolist(), s))
+        assert got == want
