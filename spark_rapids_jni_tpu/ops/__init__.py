@@ -17,6 +17,7 @@ from .binaryop import binary_op, add, sub, mul, div, eq, ne, lt, le, gt, ge
 from .unaryop import unary_op, is_null, is_not_null
 from .cast import cast
 from .reductions import reduce as reduce_column
+from .reductions import arg_extreme, extreme_by
 from .filter import filter_table, filter_table_capped
 from .gather import gather_table, gather_column
 from .sort import sort_table, argsort_table, SortKey, is_sorted, merge_sorted
@@ -59,7 +60,7 @@ from .replace import (
 )
 from .search import lower_bound, upper_bound, contains_column
 from .scan import scan
-from .compaction import distinct, distinct_capped, distinct_count
+from .compaction import distinct, distinct_capped, distinct_count, drop_nulls
 from . import window
 from .window import (
     rolling_aggregate,
@@ -112,6 +113,8 @@ __all__ = [
     "is_not_null",
     "cast",
     "reduce_column",
+    "arg_extreme",
+    "extreme_by",
     "filter_table",
     "filter_table_capped",
     "gather_table",
@@ -162,6 +165,7 @@ __all__ = [
     "distinct",
     "distinct_capped",
     "distinct_count",
+    "drop_nulls",
     "window",
     "rolling_aggregate",
     "grouped_rolling_aggregate",

@@ -77,3 +77,32 @@ def distinct_count(
     table = obj if isinstance(obj, Table) else Table([obj], ["c"])
     mask = _first_of_run_mask(table, keys)
     return jnp.sum(mask.data).astype(jnp.int32)
+
+
+def drop_nulls(
+    table: Table,
+    keys: Optional[Sequence[Union[int, str]]] = None,
+    keep_threshold: Optional[int] = None,
+) -> Table:
+    """Rows where the key columns are non-null (cudf ``drop_nulls`` /
+    Spark ``dropna``). By default every key column must be valid;
+    ``keep_threshold`` keeps rows with at least that many valid key
+    values (cudf's threshold semantics)."""
+    from . import compute
+
+    cols = (
+        [table.column(k) for k in keys]
+        if keys is not None
+        else list(table.columns)
+    )
+    if keep_threshold is None:
+        merged = compute.merge_validity(*cols)
+        if merged is None:
+            return table  # no key column carries nulls
+        keep = merged
+    else:
+        n_valid = jnp.zeros((table.row_count,), jnp.int32)
+        for c in cols:
+            n_valid = n_valid + compute.valid_mask(c).astype(jnp.int32)
+        keep = n_valid >= keep_threshold
+    return filter_table(table, Column(keep, dt.BOOL8, None))

@@ -91,3 +91,50 @@ def reduce(col: Column, op: str) -> Column:
     masked = jnp.where(valid, vals, jnp.asarray(sentinel, vals.dtype))
     out = jnp.min(masked) if op == "min" else jnp.max(masked)
     return compute.from_values(out[None], col.dtype, has_result)
+
+
+def arg_extreme(col: Column, op: str) -> Column:
+    """Row index of the min/max valid value (``argmin``/``argmax``;
+    the index half of Spark's ``min_by``/``max_by``). 1-row INT64
+    column; null when every value is null. Ties resolve to the
+    earliest row (Spark semantics).
+
+    Two passes over u64 order keys, not a sentinel argmin: a sentinel
+    collides with legitimate extreme values (INT64_MAX, +/-inf) and
+    would return a NULL row's index on the tie. Pass 1 takes the min
+    masked key; pass 2 picks the earliest VALID row holding it —
+    collision-free even when nulls share the masked key value."""
+    from . import keys as keys_mod
+
+    if op not in ("argmin", "argmax"):
+        raise ValueError(f"arg_extreme: unknown op {op!r}")
+    words = keys_mod.column_order_keys(col)
+    if len(words) != 1:
+        raise TypeError(
+            f"arg_extreme: unsupported by-column type {col.dtype} "
+            "(single-word order keys only)"
+        )
+    valid = compute.valid_mask(col)
+    key = words[0] if op == "argmin" else ~words[0]
+    masked = jnp.where(valid, key, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    m = jnp.min(masked)
+    hit = jnp.logical_and(valid, masked == m)
+    idx = jnp.argmax(hit).astype(jnp.int64)
+    has = jnp.any(valid)
+    return Column(idx[None], dt.INT64, has[None])
+
+
+def extreme_by(value_col: Column, by_col: Column, op: str) -> Column:
+    """Spark ``min_by``/``max_by``: the value of ``value_col`` at the
+    row where ``by_col`` is minimal/maximal. 1-row column of
+    ``value_col``'s type."""
+    from .gather import gather_column
+
+    if op not in ("min_by", "max_by"):
+        raise ValueError(f"extreme_by: unknown op {op!r}")
+    which = "argmin" if op == "min_by" else "argmax"
+    idx = arg_extreme(by_col, which)
+    out = gather_column(
+        value_col, idx.data.astype(jnp.int32), index_valid=idx.validity
+    )
+    return out

@@ -832,3 +832,58 @@ class TestSubsecondDatetime:
                    sdt.nanosecond_fraction):
             with pytest.raises(TypeError):
                 fn(bad)
+
+
+class TestDropNullsAndExtremeBy:
+    def test_drop_nulls(self):
+        t = Table(
+            [
+                Column.from_numpy(
+                    np.array([1, 2, 3, 4], np.int64),
+                    validity=np.array([True, False, True, True]),
+                ),
+                Column.from_numpy(
+                    np.array([9, 8, 7, 6], np.int64),
+                    validity=np.array([True, True, False, True]),
+                ),
+            ],
+            ["a", "b"],
+        )
+        out = ops.drop_nulls(t)
+        assert out["a"].to_pylist() == [1, 4]
+        only_a = ops.drop_nulls(t, keys=["a"])
+        assert only_a["a"].to_pylist() == [1, 3, 4]
+        thresh = ops.drop_nulls(t, keep_threshold=1)
+        assert thresh.row_count == 4  # every row has >=1 valid value
+
+    def test_arg_extreme_and_extreme_by(self):
+        by = Column.from_numpy(
+            np.array([5, 1, 9, 1], np.int64),
+            validity=np.array([True, True, True, False]),
+        )
+        val = Column.from_strings(["a", "b", "c", "d"])
+        assert ops.arg_extreme(by, "argmin").to_pylist() == [1]
+        assert ops.arg_extreme(by, "argmax").to_pylist() == [2]
+        assert ops.extreme_by(val, by, "min_by").to_pylist() == ["b"]
+        assert ops.extreme_by(val, by, "max_by").to_pylist() == ["c"]
+        # all-null by column -> null result
+        allnull = Column.from_numpy(
+            np.array([1, 2], np.int64),
+            validity=np.array([False, False]),
+        )
+        assert ops.arg_extreme(allnull, "argmin").to_pylist() == [None]
+
+    def test_arg_extreme_sentinel_collision(self):
+        # a valid INT64_MAX must win argmin ties against null rows
+        by = Column.from_numpy(
+            np.array([0, np.iinfo(np.int64).max], np.int64),
+            validity=np.array([False, True]),
+        )
+        assert ops.arg_extreme(by, "argmin").to_pylist() == [1]
+        byf = Column.from_numpy(
+            np.array([0.0, -np.inf], np.float64),
+            validity=np.array([False, True]),
+        )
+        assert ops.arg_extreme(byf, "argmax").to_pylist() == [1]
+        with pytest.raises(TypeError):
+            ops.arg_extreme(Column.from_strings(["a"]), "argmin")
