@@ -28,7 +28,7 @@ from .gather import gather_table
 
 _AGG_OPS = {
     "sum", "count", "min", "max", "mean", "variance", "std",
-    "collect_list", "collect_set", "nunique",
+    "collect_list", "collect_set", "nunique", "first", "last",
 }
 _COLLECT_OPS = {"collect_list", "collect_set"}
 
@@ -163,21 +163,32 @@ def _sorted_segment_extreme(masked_vals, seg, ends, is_min: bool):
     return scanned[jnp.clip(ends - 1, 0, max(n - 1, 0))]
 
 
-def _nth_valid_gather(vals_sorted, valid_sorted, starts, pad: int):
-    """Scatter-free within-segment compaction: the value of the j-th
-    VALID row of each segment, found by binary search over the running
-    valid count (rank r lives at the first row where cumsum(valid) == r).
-    Returns ((num_segments, pad) values, (num_segments, pad) slot-filled
-    mask is the caller's job via per-segment valid counts)."""
+def _valid_rank_rows(valid_sorted, starts, ranks):
+    """Scatter-free within-segment compaction core: the sorted-row index
+    of each segment's r-th VALID row, found by binary search over the
+    running valid count (rank r lives at the first row where
+    cumsum(valid) reaches base + r). ``ranks`` is (num_segments, k);
+    out-of-range ranks clip to arbitrary rows — masking is the
+    caller's job via per-segment valid counts."""
     n = valid_sorted.shape[0]
     cvalid = jnp.cumsum(valid_sorted.astype(jnp.int32))
     base = jnp.where(
         starts > 0, cvalid[jnp.clip(starts - 1, 0, max(n - 1, 0))], 0
     )
-    target = base[:, None] + jnp.arange(1, pad + 1, dtype=jnp.int32)[None, :]
+    target = base[:, None] + ranks
     row_idx = jnp.searchsorted(cvalid, target.reshape(-1), side="left")
-    row_idx = jnp.clip(row_idx, 0, max(n - 1, 0)).astype(jnp.int32)
-    return vals_sorted[row_idx].reshape(target.shape)
+    return (
+        jnp.clip(row_idx, 0, max(n - 1, 0))
+        .astype(jnp.int32)
+        .reshape(target.shape)
+    )
+
+
+def _nth_valid_gather(vals_sorted, valid_sorted, starts, pad: int):
+    """The value of the j-th VALID row of each segment, j = 1..pad."""
+    ranks = jnp.arange(1, pad + 1, dtype=jnp.int32)[None, :]
+    rows = _valid_rank_rows(valid_sorted, starts, ranks)
+    return vals_sorted[rows]
 
 
 def _first_occurrence(col, seg, vals_sorted, valid_sorted):
@@ -278,6 +289,22 @@ def _aggregate_segment(
 
     if op == "count":
         return Column(n_valid, dt.INT64, None)
+
+    if op in ("first", "last"):
+        # first/last VALID value per group (Spark first()/last() with
+        # ignoreNulls): the collect_list rank machinery at a single
+        # per-segment rank — 1 for first, n_valid for last
+        ranks = (
+            jnp.ones_like(n_valid)[:, None]
+            if op == "first"
+            else n_valid.astype(jnp.int32)[:, None]
+        )
+        row = _valid_rank_rows(valid, starts, ranks)[:, 0]
+        if is_dec128:
+            lo, hi_l = vals
+            data = jnp.stack([lo[row], hi_l[row]], axis=1)
+            return Column(data, col.dtype, has)
+        return compute.from_values(vals[row], col.dtype, has)
 
     if op in _COLLECT_OPS or op == "nunique":
         if is_dec128 or col.dtype.is_string:

@@ -171,3 +171,50 @@ def test_empty_table_groupby():
     assert out["k"].to_pylist() == []
     assert list(out.names) == ["k", "sum_v", "collect_list_v"]
     assert out.columns[2].dtype.id == dt.TypeId.LIST
+
+
+def test_first_last():
+    t = Table.from_pydict({
+        "k": [1, 1, 1, 2, 2, 3],
+        "v": [None, 10, 30, 7, None, None],
+    })
+    out = groupby_aggregate(
+        t, ["k"],
+        [GroupbyAgg("v", "first", name="f"), GroupbyAgg("v", "last", name="l")],
+    )
+    got = dict(zip(out["k"].to_pylist(),
+                   zip(out["f"].to_pylist(), out["l"].to_pylist())))
+    # null-skipping first/last; all-null group -> null
+    assert got == {1: (10, 30), 2: (7, 7), 3: (None, None)}
+
+
+def test_first_last_float_and_dec128(rng):
+    import pandas as pd
+
+    n = 2_000
+    k = rng.integers(0, 30, n)
+    v = rng.standard_normal(n)
+    mask = rng.random(n) > 0.2
+    t = Table(
+        [Column.from_numpy(k), Column.from_numpy(v, validity=mask)],
+        ["k", "v"],
+    )
+    out = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "first", name="f")])
+    df = pd.DataFrame({"k": k, "v": np.where(mask, v, np.nan)})
+    want = df.dropna().groupby("k")["v"].first()
+    got = dict(zip(out["k"].to_pylist(), out["f"].to_pylist()))
+    for kk in np.unique(k):
+        w = want.get(int(kk))
+        g = got[int(kk)]
+        if w is None or (isinstance(w, float) and np.isnan(w)):
+            assert g is None
+        else:
+            assert abs(g - w) < 1e-12, kk
+    # decimal128 first
+    d = Column.from_decimal128([10**20, None, 5, 7, None, 3],
+                               scale=-2)
+    t2 = Table([Column.from_numpy(np.array([1, 1, 1, 2, 2, 2],
+                                           dtype=np.int64)), d], ["k", "d"])
+    out2 = groupby_aggregate(t2, ["k"], [GroupbyAgg("d", "first", name="f")])
+    got2 = dict(zip(out2["k"].to_pylist(), out2.columns[1].to_pylist()))
+    assert got2[1] == 10**20 and got2[2] == 7
