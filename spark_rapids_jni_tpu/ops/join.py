@@ -28,6 +28,49 @@ from . import compute
 from . import keys as keys_mod
 from .gather import gather_table
 
+# The fused single-shot join graph (key normalization + lexsort +
+# lex-searchsorted in one compiled region) reproducibly kills the TPU
+# worker at >= 32M rows with 64-bit keys (tools/xla_join_fault_repro.py;
+# every sub-graph passes in isolation at the same sizes — an XLA
+# codegen/runtime fault, not OOM). 16M passes. Above this threshold the
+# eager join APIs route themselves through the chunk-probed path so no
+# public join API can crash the worker at any size — the reference's own
+# discipline of never letting callers choose safety (its 2 GB batch
+# splits are automatic, row_conversion.cu:476-479,505-511).
+# Module-level so tests can lower it to pin the routing.
+#
+# Scope of the fence: it removes the XLA codegen fault by keeping every
+# compiled probe graph at or below this row count. The OUTER joins'
+# materialization (expand + gathers over the full pair count) still runs
+# single-shot, so a pathological fan-out can exhaust HBM — that sizing
+# concern belongs to the memory planner (utils/hbm.py), not this fence.
+FUSED_PROBE_MAX_ROWS = 16_000_000
+
+
+def _on_accelerator() -> bool:
+    """CPU runs the fused graph fine (and tests rely on it); only real
+    accelerator backends need the fault fence."""
+    return jax.default_backend() != "cpu"
+
+
+def _is_tracing(table: Table) -> bool:
+    return isinstance(table.columns[0].data, jax.core.Tracer)
+
+
+def _needs_chunked_probe(left: Table, right: Table) -> bool:
+    """True when the eager API must avoid the fused single-shot graph.
+
+    Under jit (tracers) the fence cannot host-sync, so the caller keeps
+    the fused graph — jittable ``*_capped`` users (e.g. shard_map
+    per-device shards) stay below the threshold by construction."""
+    if _is_tracing(left) or _is_tracing(right):
+        return False
+    if not _on_accelerator():
+        return False
+    return (
+        max(left.row_count, right.row_count) > FUSED_PROBE_MAX_ROWS
+    )
+
 
 def _key_words(cols: Sequence[Column]) -> tuple[list[jax.Array], jax.Array]:
     """(order-key words with null payloads zeroed, all-valid mask)."""
@@ -132,6 +175,80 @@ def _match_ranges(
         sorted_words, left, left_on, left_valid
     )
     return perm_r, lo, counts, lvalid
+
+
+@functools.lru_cache(maxsize=64)
+def _chunk_ranges_fn(on: tuple, with_valid: bool):
+    """Jitted per-chunk probe: (lo, counts, lvalid, chunk total). The
+    single probe wrapper every chunked caller shares (one jit cache):
+    ``_match_ranges_safe`` uses the full triple, ``inner_join_batched``
+    the count sum — returning both costs two extra scalars."""
+    def fn(sw, chunk, chunk_valid=None):
+        lo, counts, lvalid = _probe_build(
+            list(sw), chunk, list(on), chunk_valid
+        )
+        return lo, counts, lvalid, jnp.sum(counts)
+
+    if with_valid:
+        return jax.jit(fn)
+    return jax.jit(lambda sw, chunk: fn(sw, chunk))
+
+
+@functools.lru_cache(maxsize=64)
+def _batched_prep_valid_fn(right_on: tuple):
+    return jax.jit(
+        lambda r, rv: _prepare_build(r, list(right_on), rv)
+    )
+
+
+def _match_ranges_safe(
+    left: Table,
+    right: Table,
+    left_on: Sequence[Union[int, str]],
+    right_on: Sequence[Union[int, str]],
+    left_valid: Optional[jax.Array] = None,
+    right_valid: Optional[jax.Array] = None,
+):
+    """Eager ``_match_ranges`` that never builds the faulting fused
+    graph: build side sorted in its own jit, probe side searched in
+    ``FUSED_PROBE_MAX_ROWS`` chunks (each a known-safe graph), results
+    concatenated. Drop-in for the eager outer joins and count APIs;
+    occupancy masks ride along (sliced per probe chunk)."""
+    if not _needs_chunked_probe(left, right):
+        return _match_ranges(
+            left, right, left_on, right_on, left_valid, right_valid
+        )
+    from .copying import slice_rows
+
+    if right_valid is not None:
+        perm_r, sorted_words = _batched_prep_valid_fn(tuple(right_on))(
+            right, right_valid
+        )
+    else:
+        perm_r, sorted_words = _batched_prep_fn(tuple(right_on))(right)
+    sorted_words = tuple(sorted_words)
+    probe = _chunk_ranges_fn(tuple(left_on), left_valid is not None)
+    n = left.row_count
+    step = FUSED_PROBE_MAX_ROWS
+    los, counts, lvalids = [], [], []
+    for start in range(0, n, step):
+        stop = min(start + step, n)
+        chunk = slice_rows(left, start, stop)
+        if left_valid is not None:
+            lo_c, cnt_c, lv_c, _ = probe(
+                sorted_words, chunk, left_valid[start:stop]
+            )
+        else:
+            lo_c, cnt_c, lv_c, _ = probe(sorted_words, chunk)
+        los.append(lo_c)
+        counts.append(cnt_c)
+        lvalids.append(lv_c)
+    return (
+        perm_r,
+        jnp.concatenate(los),
+        jnp.concatenate(counts),
+        jnp.concatenate(lvalids),
+    )
 
 
 def _expand(
@@ -306,7 +423,7 @@ def left_join_count(
     sizing): matches plus one per unmatched occupied left row (null-key
     rows count; shuffle-padding rows don't)."""
     right_on = right_on or on
-    _, _, counts, _ = _match_ranges(
+    _, _, counts, _ = _match_ranges_safe(
         left, right, on, right_on, left_valid, right_valid
     )
     return jnp.sum(_left_emit(counts, left_valid))
@@ -323,7 +440,9 @@ def membership_mask(
     """Jittable per-left-row bool: has at least one match in right
     (the SEMI/ANTI join predicate; fixed shape, shard_map-friendly)."""
     right_on = right_on or on
-    _, _, counts, lvalid = _match_ranges(
+    # eager big-table calls take the fault-fenced chunked probe; under
+    # jit (tracers) _match_ranges_safe falls through to the fused graph
+    _, _, counts, lvalid = _match_ranges_safe(
         left, right, on, right_on, left_valid, right_valid
     )
     return jnp.logical_and(lvalid, counts > 0)
@@ -341,7 +460,7 @@ def inner_join_count(
     (the generalization of row_conversion.cu:505-511): count on device,
     host-sync once, then materialize with a static capacity."""
     right_on = right_on or on
-    _, _, counts, _ = _match_ranges(
+    _, _, counts, _ = _match_ranges_safe(
         left, right, on, right_on, left_valid, right_valid
     )
     return jnp.sum(counts)
@@ -353,8 +472,14 @@ def inner_join(
     on: Sequence[Union[int, str]],
     right_on: Optional[Sequence[Union[int, str]]] = None,
 ) -> Table:
-    """Eager inner equi-join (host-syncs the match count)."""
+    """Eager inner equi-join (host-syncs the match count).
+
+    Above ``FUSED_PROBE_MAX_ROWS`` on an accelerator backend this routes
+    itself through :func:`inner_join_batched` — the fused single-shot
+    graph faults the TPU worker at >= 32M rows (see module constant)."""
     right_on = right_on or on
+    if _needs_chunked_probe(left, right):
+        return inner_join_batched(left, right, on, right_on)
     perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
     total = int(jnp.sum(counts))
     if total == 0:
@@ -373,17 +498,6 @@ def inner_join(
 @functools.lru_cache(maxsize=64)
 def _batched_prep_fn(right_on: tuple):
     return jax.jit(lambda r: _prepare_build(r, list(right_on)))
-
-
-@functools.lru_cache(maxsize=64)
-def _batched_probe_fn(on: tuple):
-    def fn(sw, chunk):
-        lo, counts, _ = _probe_build(list(sw), chunk, list(on))
-        # the chunk total rides the same dispatch — a separate jitted
-        # sum would cost one more tunnel round trip per chunk
-        return lo, counts, jnp.sum(counts)
-
-    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
@@ -408,9 +522,11 @@ def inner_join_batched(
     right: Table,
     on: Sequence[Union[int, str]],
     right_on: Optional[Sequence[Union[int, str]]] = None,
-    probe_rows: int = 16_000_000,
+    probe_rows: Optional[int] = None,
 ) -> Table:
-    """Eager inner join, probe side processed in ``probe_rows`` batches.
+    """Eager inner join, probe side processed in ``probe_rows`` batches
+    (default: ``FUSED_PROBE_MAX_ROWS``, resolved at call time so tuning
+    the fence threshold shrinks the batched chunks with it).
 
     The single-shot join at 100M×100M rows needs both sides, the sorted
     build words, AND the expanded output resident at once — past the HBM
@@ -422,6 +538,8 @@ def inner_join_batched(
     from .copying import concatenate, slice_rows
 
     right_on = right_on or on
+    if probe_rows is None:
+        probe_rows = FUSED_PROBE_MAX_ROWS
     if probe_rows <= 0:
         raise ValueError(f"probe_rows must be positive, got {probe_rows}")
     n = left.row_count
@@ -446,12 +564,12 @@ def inner_join_batched(
     ron_key = tuple(right_on)
     perm_r, sorted_words = _batched_prep_fn(ron_key)(right)
     sorted_words = tuple(sorted_words)
-    probe = _batched_probe_fn(on_key)
+    probe = _chunk_ranges_fn(on_key, False)
     pieces = []
     for start in range(0, n, probe_rows):
         stop = min(start + probe_rows, n)
         chunk = slice_rows(left, start, stop)
-        lo, counts, total_dev = probe(sorted_words, chunk)
+        lo, counts, _, total_dev = probe(sorted_words, chunk)
         total = int(total_dev)
         if total == 0:
             continue
@@ -471,9 +589,10 @@ def left_join(
     on: Sequence[Union[int, str]],
     right_on: Optional[Sequence[Union[int, str]]] = None,
 ) -> Table:
-    """Eager left outer equi-join."""
+    """Eager left outer equi-join (fault-fenced: chunked probe above
+    ``FUSED_PROBE_MAX_ROWS`` on accelerator backends)."""
     right_on = right_on or on
-    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    perm_r, lo, counts, _ = _match_ranges_safe(left, right, on, right_on)
     total = int(jnp.sum(jnp.maximum(counts, 1)))
     left_idx, right_idx, matched, _ = _expand(
         perm_r, lo, counts, total, left_outer=True
@@ -586,7 +705,7 @@ def _unmatched_right(left, right, on, right_on):
     """Bool mask over right rows with NO match in left (probe reversed).
     Null/invalid right keys never match, so they are unmatched — exactly
     the rows a FULL/RIGHT OUTER join must still emit."""
-    _, _, counts, _ = _match_ranges(right, left, right_on, on)
+    _, _, counts, _ = _match_ranges_safe(right, left, right_on, on)
     return counts == 0
 
 
@@ -599,7 +718,7 @@ def right_join(
     """Eager RIGHT OUTER equi-join: inner pairs + unmatched right rows
     with a null left side (keys coalesced from the right)."""
     right_on = right_on or on
-    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    perm_r, lo, counts, _ = _match_ranges_safe(left, right, on, right_on)
     total_in = int(jnp.sum(counts))
     run = _unmatched_right(left, right, on, right_on)
     n_run = int(jnp.sum(run))
@@ -631,7 +750,7 @@ def full_join(
     """Eager FULL OUTER equi-join: inner pairs + unmatched left rows
     (null right side) + unmatched right rows (null left side)."""
     right_on = right_on or on
-    perm_r, lo, counts, _ = _match_ranges(left, right, on, right_on)
+    perm_r, lo, counts, _ = _match_ranges_safe(left, right, on, right_on)
     total_pairs = int(jnp.sum(jnp.maximum(counts, 1)))  # inner + left-unmatched
     run = _unmatched_right(left, right, on, right_on)
     n_run = int(jnp.sum(run))
