@@ -130,6 +130,53 @@ def bench_groupby(platform, n, n_inputs=2):
                   n * 16, platform), med
 
 
+def bench_groupby_chunked(platform, n=100_000_000, n_inputs=2):
+    """Config 1 at scale via the two-level chunked design (round-4
+    headline): C batched VMEM-sized sorts + a combine pass, vs the
+    single giant variadic sort of ``bench_groupby``."""
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+    from spark_rapids_jni_tpu.ops.groupby_chunked import (
+        groupby_aggregate_capped_chunked,
+    )
+
+    n_keys = 10_000
+    chunk_rows = 1 << 18
+    chunk_segments = 1 << 15  # 10k keys/chunk worst case + headroom
+    rng = np.random.default_rng(42)
+    hosts = []
+    inputs = []
+    for _ in range(n_inputs):
+        k = rng.integers(0, n_keys, n, dtype=np.int64)
+        v = rng.integers(-1000, 1000, n, dtype=np.int64)
+        hosts.append((k, v))
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        jax.block_until_ready(t.columns[0].data)
+        inputs.append((t,))
+
+    step = jax.jit(
+        lambda t: groupby_aggregate_capped_chunked(
+            t,
+            ["k"],
+            [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")],
+            num_segments=n_keys,
+            chunk_rows=chunk_rows,
+            chunk_segments=chunk_segments,
+        )
+    )
+    med, mn, std, out = _timeit(step, inputs)
+    agg, ngroups, max_chunk = out
+    assert int(max_chunk) <= chunk_segments, "chunk capacity overflow"
+    total = int(np.asarray(agg["sum_v"].data)[: int(ngroups)].sum())
+    assert total == int(hosts[-1][1].sum()), "groupby-sum mismatch vs numpy"
+    return _entry(
+        1, f"groupby_sum_{n // 1_000_000}M_chunked", n, med, mn, std,
+        n * 16, platform,
+    )
+
+
 def arrow_baseline(n):
     """CPU Arrow groupby throughput (rows/s) on the config-1 shape."""
     try:
@@ -208,23 +255,46 @@ def bench_transpose(platform, n=4_000_000, n_inputs=2):
 
 
 def bench_sort(platform, n=100_000_000):
-    """Config 3b: 100M-row single-chip sort (u64-normalized keys)."""
+    """Config 3b: 100M-row single-chip sort (u64-normalized keys),
+    payload formulation (what ``sort_table`` ships)."""
+    return _bench_sort_formulation(platform, n, "payload")
+
+
+def bench_sort_gather(platform, n=100_000_000):
+    """Config 3b A/B arm: the argsort+gather formulation ``sort_table``
+    used before 241d4b6 — measured so the payload-vs-gather switch rests
+    on a direct on-chip number, not the round-3 indirect inference
+    (groupby's payload sort at 1.08s vs this form's 5.71s)."""
+    return _bench_sort_formulation(platform, n, "gather")
+
+
+def _bench_sort_formulation(platform, n, form):
     import jax
 
     from spark_rapids_jni_tpu.column import Column, Table
-    from spark_rapids_jni_tpu.ops.sort import SortKey, sort_table
+    from spark_rapids_jni_tpu.ops.gather import gather_table
+    from spark_rapids_jni_tpu.ops.sort import (
+        SortKey,
+        argsort_table,
+        sort_table,
+    )
 
     rng = np.random.default_rng(13)
     k = rng.integers(0, n, n, dtype=np.int64)
     v = rng.integers(-100, 100, n, dtype=np.int64)
     t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
     jax.block_until_ready(t.columns[0].data)
-    sort_fn = jax.jit(lambda tt: sort_table(tt, [SortKey("k")]))
+    if form == "payload":
+        sort_fn = jax.jit(lambda tt: sort_table(tt, [SortKey("k")]))
+    else:
+        sort_fn = jax.jit(
+            lambda tt: gather_table(tt, argsort_table(tt, [SortKey("k")]))
+        )
     med, mn, std, out = _timeit(sort_fn, [(t,)], reps_per_input=2)
     head = np.asarray(out["k"].data[:1000])
     assert (np.diff(head) >= 0).all(), "sort output not ordered"
-    return _entry(3, f"sort_{n // 1_000_000}M_int64", n, med, mn, std,
-                  n * 16 * 2, platform)
+    return _entry(3, f"sort_{n // 1_000_000}M_int64_{form}", n, med, mn,
+                  std, n * 16 * 2, platform)
 
 
 def _join_inputs(n):
@@ -444,7 +514,9 @@ def bench_parquet_pipeline(platform, n_groups=4, rows_per_group=1_500_000):
         assert t1 == t2
     return {
         "config": 5,
-        "name": "parquet_scan_filter_agg",
+        # workload size in the name: the r3 shrink from 6x2M to 4x1.5M
+        # silently broke round-over-round comparability (ADVICE r3)
+        "name": f"parquet_scan_filter_agg_{n_groups}x{rows_per_group // 1000}k",
         "rows": n,
         "serial_seconds": round(serial_s, 3),
         "prefetch_seconds": round(overlap_s, 3),
@@ -514,13 +586,24 @@ _SUBPROCESS_CONFIGS = {
     "groupby1m": lambda p: bench_groupby(p, 1_000_000)[0],
     "groupby16m": lambda p: bench_groupby(p, 16_000_000)[0],
     "groupby100m": lambda p: bench_groupby(p, 100_000_000)[0],
+    "groupby100m_chunked": bench_groupby_chunked,
+    "groupby16m_chunked": lambda p: bench_groupby_chunked(p, 16_000_000),
     "transpose": bench_transpose,
     "join": bench_join,
     "join_batched": bench_join_batched,
     "sort": bench_sort,
+    "sort_gather": bench_sort_gather,
     "resident": bench_resident_chain,
     "parquet": bench_parquet_pipeline,
 }
+
+# the on-chip ladder main()/the daemon walk, in order (chunked groupby
+# first: it is the round-4 headline measurement)
+_LADDER = (
+    "groupby100m_chunked", "groupby16m_chunked", "groupby1m",
+    "groupby16m", "groupby100m", "transpose",
+    "join_batched", "sort", "sort_gather", "resident", "parquet",
+)
 
 _CONFIG_TIMEOUT_S = 1800
 
@@ -568,6 +651,134 @@ def _spawn_config(entries, name: str):
     return got
 
 
+# ---------------------------------------------------------------------------
+# Self-healing state (round-4 VERDICT item 2): every successful config
+# run is merged into a state file the moment it finishes, and a daemon
+# mode keeps re-probing the flaky tunnel until a deadline. One outage
+# can then no longer blank a round: the round-end main() reuses any
+# entry the daemon captured while the chip was up.
+# ---------------------------------------------------------------------------
+
+import os
+
+_STATE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+    "bench_state.json",
+)
+_DAEMON_PID_PATH = _STATE_PATH + ".pid"
+
+
+def _load_state() -> dict:
+    try:
+        with open(_STATE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"entries": {}}
+
+
+def _merge_state(config: str, got: list) -> None:
+    """Merge one config's entries into the state file atomically
+    (tmp+rename: a reader never sees a half-written file)."""
+    state = _load_state()
+    state["entries"][config] = {
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "results": got,
+    }
+    os.makedirs(os.path.dirname(_STATE_PATH), exist_ok=True)
+    tmp = _STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, _STATE_PATH)
+
+
+def _note_failure(config: str) -> None:
+    state = _load_state()
+    fails = state.setdefault("failures", {})
+    fails[config] = fails.get(config, 0) + 1
+    os.makedirs(os.path.dirname(_STATE_PATH), exist_ok=True)
+    tmp = _STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, _STATE_PATH)
+
+
+def _failure_count(config: str) -> int:
+    return _load_state().get("failures", {}).get(config, 0)
+
+
+def _state_results(config: str):
+    got = _load_state()["entries"].get(config)
+    if not got:
+        return None
+    results = [dict(r) for r in got["results"]]
+    for r in results:
+        r["source"] = "daemon_retry_loop"
+        r["measured_at"] = got["measured_at"]
+    return results
+
+
+def _stop_daemon() -> None:
+    """Kill a live daemon before a foreground ladder run: two processes
+    contending for the single tunneled chip corrupt both timings."""
+    import signal
+
+    try:
+        with open(_DAEMON_PID_PATH) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, signal.SIGTERM)
+        _progress(f"stopped bench daemon pid {pid}")
+        time.sleep(2)
+    except (OSError, ValueError):
+        pass
+
+
+def daemon(deadline_s: float, probe_every_s: float = 300.0) -> None:
+    """Retry-until-deadline loop: probe the tunnel, run every ladder
+    config that has no successful state entry yet (one subprocess each,
+    merged into the state file as it lands), sleep, repeat. Exits at the
+    deadline or when the ladder is complete."""
+    deadline = time.time() + deadline_s
+    os.makedirs(os.path.dirname(_STATE_PATH), exist_ok=True)
+    with open(_DAEMON_PID_PATH, "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        while time.time() < deadline:
+            pending = [c for c in _LADDER if not _state_results(c)]
+            if not pending:
+                _progress("daemon: ladder complete")
+                return
+            if not _probe_device():
+                _progress(
+                    f"daemon: device down; {len(pending)} pending; "
+                    f"sleeping {probe_every_s:.0f}s"
+                )
+                time.sleep(min(probe_every_s, max(deadline - time.time(), 0)))
+                continue
+            progressed = False
+            for cfg in pending:
+                if time.time() >= deadline:
+                    return
+                if _failure_count(cfg) >= 3:
+                    continue  # deterministic failure: stop burning chip time
+                entries: list = []
+                got = _spawn_config(entries, cfg)
+                if got:
+                    _merge_state(cfg, got)
+                    progressed = True
+                else:
+                    _note_failure(cfg)
+                    # crash/timeout with the device up: re-probe before
+                    # trying anything else (the worker may be poisoned)
+                    break
+            if not progressed:
+                time.sleep(min(probe_every_s, max(deadline - time.time(), 0)))
+    finally:
+        try:
+            os.remove(_DAEMON_PID_PATH)
+        except OSError:
+            pass
+
+
 def _probe_device(timeout_s: int = 150) -> bool:
     """Cheap liveness check: the axon tunnel sometimes hangs jax.devices()
     forever — probe in a killable subprocess before paying per-config
@@ -590,20 +801,39 @@ def main():
 
     med_big = None
     platform = None
+    _stop_daemon()  # no chip contention with a live retry loop
     alive = _probe_device()
     if not alive:
         _progress("device probe failed (tunnel down/hung): retrying once")
         alive = _probe_device()
-    for key in ("groupby1m", "groupby16m", "groupby100m", "transpose",
-                "join_batched", "sort", "resident", "parquet"):
-        if not alive:
-            entries.append({"name": key, "error": "device unreachable"})
-            continue
-        got = _spawn_config(entries, key)
+    for key in _LADDER:
+        got = None
+        if alive:
+            got = _spawn_config(entries, key)
+            if got:
+                _merge_state(key, got)
+        if not got:
+            # fall back to what the retry daemon captured while the
+            # chip was up earlier in the round (VERDICT r3 item 2: an
+            # outage at round end must not blank already-measured work)
+            got = _state_results(key)
+            if got:
+                if alive:
+                    entries.pop()  # replace the live-failure entry
+                _progress(f"  {key}: reusing daemon-captured result")
+                entries.extend(got)
+            elif not alive:
+                entries.append({"name": key, "error": "device unreachable"})
         if got and platform is None:
             platform = got[0].get("platform")
-        if key == "groupby100m" and got:
-            med_big = got[0]["seconds_median"]
+        if (
+            key in ("groupby100m", "groupby100m_chunked")
+            and got
+            and "seconds_median" in got[0]
+        ):
+            # headline = best 100M groupby formulation measured
+            s = got[0]["seconds_median"]
+            med_big = s if med_big is None else min(med_big, s)
     platform = platform or "unreachable"
     _guard(entries, "config 4: distributed zipf skew, 8-device CPU mesh",
            bench_distributed_skew)
@@ -647,5 +877,10 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--one":
         _run_one(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--daemon":
+        # python bench.py --daemon <deadline_seconds> [probe_every_s]
+        dl = float(sys.argv[2]) if len(sys.argv) >= 3 else 6 * 3600
+        every = float(sys.argv[3]) if len(sys.argv) >= 4 else 300.0
+        daemon(dl, every)
     else:
         main()

@@ -465,6 +465,12 @@ def groupby_aggregate_capped(
     return Table(out_cols, out_names), num_groups
 
 
+# above this, decomposable aggregations route through the two-level
+# chunked design (ops/groupby_chunked.py) — one giant variadic sort
+# becomes C batched VMEM-sized sorts plus a small combine pass
+CHUNKED_MIN_ROWS = 4_000_000
+
+
 def groupby_aggregate(
     table: Table,
     by: Sequence[Union[int, str]],
@@ -472,7 +478,21 @@ def groupby_aggregate(
 ) -> Table:
     """Eager groupby with exact output size (one host sync). Collect
     aggregations without an explicit ``list_capacity`` get sized from
-    the largest group's valid-row count (a cheap count pre-pass)."""
+    the largest group's valid-row count (a cheap count pre-pass).
+
+    Large inputs with decomposable aggregations take the two-level
+    chunked path automatically (exact; falls back here when chunk
+    cardinality is too high for chunking to win)."""
+    if table.row_count > CHUNKED_MIN_ROWS:
+        from .groupby_chunked import (
+            chunked_groupby_supported,
+            groupby_aggregate_chunked,
+        )
+
+        if chunked_groupby_supported(table, aggs):
+            out = groupby_aggregate_chunked(table, by, aggs)
+            if out is not None:
+                return out
     if table.row_count == 0:
         # 0 rows -> 0 groups, but the output SCHEMA must still be exact:
         # run the real pipeline on one all-null dummy row (which forms

@@ -1,0 +1,313 @@
+"""Two-level (chunked) group-by — the high-throughput path.
+
+The single-pass design in ops/groupby.py pays one variadic stable sort
+over ALL rows: at 100M rows that is ~log2(1e8)^2 ≈ 700 compare passes
+over ~2 GB resident in HBM, which measured at ~0.2% of v5e HBM peak
+(round-3 bench) — a design ceiling, not a tuning problem.
+
+This module replaces the one giant sort with the classic two-level
+aggregation, shaped for the TPU memory hierarchy:
+
+  phase 1  rows reshaped to (C, T) chunks; the EXISTING capped groupby
+           runs per-chunk under ``jax.vmap`` — C independent T-row
+           sorts batched by XLA instead of one n-row sort. Small sorts
+           cut the bitonic pass count quadratically (log2(T)^2 vs
+           log2(n)^2) and fit VMEM (~16 MB/core) so passes stop
+           round-tripping HBM.
+  phase 2  the C×S chunk partials (at most `chunk_segments` groups per
+           chunk) concatenate into one small table that a single capped
+           groupby combines: sums of sums, min of mins, etc.
+
+Exactness: every aggregate here is algebraically decomposable —
+integer/decimal sums are associative mod 2^64/2^128, counts/min/max/
+first/last trivially so (chunk-major row order preserves first/last
+semantics); float sums re-associate, like any parallel reduction.
+``variance``/``nunique``/``collect_*`` are NOT decomposable and stay on
+the single-pass path (the eager router checks).
+
+Capacity: a chunk holding more than ``chunk_segments`` distinct keys
+would silently truncate, so the jittable API returns the max per-chunk
+group count for the caller to check; the eager wrapper probes one chunk
+to size the capacity, verifies after the fact, and falls back to the
+exact single-pass path when cardinality is too high for chunking to
+win.
+
+Reference parity: cudf's groupby hash-aggregates per thread block then
+merges across blocks — same two-level shape, re-expressed as batched
+sorts + segment reductions because TPU has no device-wide atomic hash
+tables (SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtype as dt
+from ..column import Column, Table
+from . import compute
+from .groupby import GroupbyAgg, groupby_aggregate_capped
+
+# aggregations with an exact two-level decomposition
+DECOMPOSABLE_OPS = {"sum", "count", "min", "max", "mean", "first", "last"}
+
+# phase-1 partial op + phase-2 combine op per user-facing op
+_COMBINE = {
+    "sum": "sum",
+    "count": "sum",
+    "min": "min",
+    "max": "max",
+    "first": "first",
+    "last": "last",
+}
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def _pad_chunks(table: Table, chunk_rows: int):
+    """(chunked table with (C, T) leaves, (C, T) occupancy mask)."""
+    n = table.row_count
+    c = -(-n // chunk_rows)
+    padded = c * chunk_rows
+
+    def pad_reshape(x):
+        if x is None:
+            return None
+        pad_width = [(0, padded - n)] + [(0, 0)] * (x.ndim - 1)
+        y = jnp.pad(x, pad_width)
+        return y.reshape((c, chunk_rows) + x.shape[1:])
+
+    cols = [
+        Column(
+            pad_reshape(col.data),
+            col.dtype,
+            pad_reshape(col.validity),
+            pad_reshape(col.lengths),
+        )
+        for col in table.columns
+    ]
+    occ = (
+        jnp.arange(padded, dtype=jnp.int32).reshape(c, chunk_rows) < n
+    )
+    return Table(cols, table.names), occ
+
+
+def _phase1_plan(table: Table, by, aggs: Sequence[GroupbyAgg]):
+    """The partial aggregations phase 1 must compute: every requested
+    decomposable op, plus a count per mean column (mean = Σsum/Σcount).
+    Returns (phase-1 agg list, per-user-agg plan entries)."""
+    p1: list[GroupbyAgg] = []
+    names: dict = {}
+
+    def need(column, op) -> str:
+        key = (id(table.column(column)), op)
+        if key not in names:
+            nm = f"__p1_{len(p1)}_{op}"
+            names[key] = nm
+            p1.append(GroupbyAgg(column, op, name=nm))
+        return names[key]
+
+    plan = []
+    for a in aggs:
+        if a.op not in DECOMPOSABLE_OPS:
+            raise ValueError(
+                f"{a.op} has no two-level decomposition; route through "
+                "the single-pass groupby"
+            )
+        if a.op == "mean":
+            if table.column(a.column).dtype.id == dt.TypeId.DECIMAL128:
+                raise ValueError(
+                    "DECIMAL128 mean is not chunkable (needs the 128-bit "
+                    "limb decode); use the single-pass groupby"
+                )
+            plan.append(
+                ("mean", a, need(a.column, "sum"), need(a.column, "count"))
+            )
+        else:
+            plan.append((a.op, a, need(a.column, a.op), None))
+    return p1, plan
+
+
+def groupby_aggregate_capped_chunked(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+    num_segments: int,
+    chunk_rows: int = 1 << 18,
+    chunk_segments: int = 1 << 14,
+) -> tuple[Table, jax.Array, jax.Array]:
+    """Jittable two-level groupby.
+
+    Returns ``(padded result of num_segments rows, total group count,
+    max per-chunk group count)``. The result is EXACT iff the last
+    value is <= ``chunk_segments`` — a chunk with more distinct keys
+    than that would have truncated groups, so callers must check (the
+    eager wrapper does; bench asserts it).
+    """
+    key_names = [
+        c if isinstance(c, str) else (table.names[c] if table.names else f"key{c}")
+        for c in by
+    ]
+    p1_aggs, plan = _phase1_plan(table, by, aggs)
+
+    chunked, occ = _pad_chunks(table, chunk_rows)
+    c = occ.shape[0]
+
+    def one_chunk(tbl, rv):
+        return groupby_aggregate_capped(
+            tbl, by, p1_aggs, num_segments=chunk_segments, row_valid=rv
+        )
+    partial, chunk_groups = jax.vmap(one_chunk)(chunked, occ)
+
+    # flatten (C, S, ...) partials to one (C*S, ...) table; chunk-major
+    # order keeps first/last semantics (earlier chunks = earlier rows)
+    flat_cols = jax.tree.map(
+        lambda x: x.reshape((c * chunk_segments,) + x.shape[2:]), partial
+    )
+    seg_iota = jnp.arange(chunk_segments, dtype=jnp.int32)[None, :]
+    p2_valid = (seg_iota < chunk_groups[:, None]).reshape(-1)
+
+    # phase 2: combine partials with one small capped groupby
+    p2_aggs = []
+    for i, a in enumerate(p1_aggs):
+        p2_aggs.append(
+            GroupbyAgg(a.name, _COMBINE[a.op], name=f"__p2_{i}")
+        )
+    combined, num_groups = groupby_aggregate_capped(
+        flat_cols, key_names, p2_aggs, num_segments=num_segments,
+        row_valid=p2_valid,
+    )
+
+    # assemble the user-facing schema (same as the single-pass capped API)
+    out_cols = list(combined.columns[: len(by)])
+    out_names = list(combined.names[: len(by)])
+    p2_of = {f"__p2_{i}": combined.column(f"__p2_{i}") for i in range(len(p1_aggs))}
+    p1_name_to_p2 = {
+        a.name: p2_of[f"__p2_{i}"] for i, a in enumerate(p1_aggs)
+    }
+    for op, a, main_name, count_name in plan:
+        colref = a.column
+        base = (
+            colref
+            if isinstance(colref, str)
+            else (table.names[colref] if table.names else f"c{colref}")
+        )
+        out_name = a.name or f"{a.op}_{base}"
+        if op == "mean":
+            total = p1_name_to_p2[main_name]
+            cnt = p1_name_to_p2[count_name]
+            n_valid = compute.values(cnt)
+            mean = compute.values(total).astype(jnp.float64) / jnp.maximum(
+                n_valid, 1
+            )
+            src_dtype = table.column(colref).dtype
+            if src_dtype.is_decimal and src_dtype.id != dt.TypeId.DECIMAL128:
+                mean = mean * (10.0 ** src_dtype.scale)
+            has = jnp.logical_and(compute.valid_mask(cnt), n_valid > 0)
+            out_cols.append(compute.from_values(mean, dt.FLOAT64, has))
+        else:
+            out_cols.append(p1_name_to_p2[main_name])
+        out_names.append(out_name)
+    return (
+        Table(out_cols, out_names),
+        num_groups,
+        jnp.max(chunk_groups),
+    )
+
+
+def chunked_groupby_supported(table: Table, aggs: Sequence[GroupbyAgg]) -> bool:
+    for a in aggs:
+        if a.op not in DECOMPOSABLE_OPS:
+            return False
+        if (
+            a.op == "mean"
+            and table.column(a.column).dtype.id == dt.TypeId.DECIMAL128
+        ):
+            # dec128 mean needs the 128-bit->f64 decode of the summed
+            # limbs (int128.to_float64); only the single-pass path has it
+            return False
+    return True
+
+
+def groupby_aggregate_chunked(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+    chunk_rows: int = 1 << 18,
+    chunk_segments: Optional[int] = None,
+) -> Optional[Table]:
+    """Eager two-level groupby with exact output size, or ``None`` when
+    chunking cannot win (cardinality too high — caller should use the
+    single-pass path).
+
+    Capacity protocol (the two-phase sizing discipline of the *_capped
+    APIs, applied to cardinality instead of byte counts):
+      1. probe chunk 0 at full capacity for its exact group count;
+      2. size ``chunk_segments`` with 4x headroom, run all chunks;
+      3. the returned max per-chunk count PROVES sufficiency; one
+         doubling retry on overflow, else fall back.
+    """
+    from .copying import slice_rows
+
+    n = table.row_count
+    if n <= chunk_rows:
+        return None
+    if not chunked_groupby_supported(table, aggs):
+        return None
+
+    if chunk_segments is None:
+        probe = slice_rows(table, 0, chunk_rows)
+        _, g0 = groupby_aggregate_capped(
+            probe, by, [GroupbyAgg(by[0], "count")],
+            num_segments=chunk_rows,
+        )
+        g0 = int(g0)
+        if g0 > chunk_rows // 4:
+            return None  # near-distinct keys: chunking only adds passes
+        chunk_segments = min(chunk_rows, _ceil_pow2(4 * g0 + 64))
+
+    c = -(-n // chunk_rows)
+    for _ in range(2):
+        cap = min(c * chunk_segments, n)
+        out, num_groups, max_chunk = _jit_capped_chunked(
+            table, tuple(by), tuple(aggs), cap, chunk_rows, chunk_segments
+        )
+        if int(max_chunk) <= chunk_segments:
+            g = int(num_groups)
+            cols = [
+                Column(
+                    col.data[:g],
+                    col.dtype,
+                    None if col.validity is None else col.validity[:g],
+                    None if col.lengths is None else col.lengths[:g],
+                )
+                for col in out.columns
+            ]
+            return Table(cols, out.names)
+        if chunk_segments >= chunk_rows:
+            break
+        chunk_segments = min(chunk_rows, _ceil_pow2(int(max_chunk)))
+    return None
+
+
+def _jit_capped_chunked(table, by, aggs, num_segments, chunk_rows, chunk_segments):
+    """One jitted dispatch for the whole two-level pipeline (compile
+    cache keyed by the static args via jit's weak cache)."""
+    fn = _capped_chunked_fn(by, aggs, num_segments, chunk_rows, chunk_segments)
+    return fn(table)
+
+
+@functools.lru_cache(maxsize=256)
+def _capped_chunked_fn(by, aggs, num_segments, chunk_rows, chunk_segments):
+    def fn(tbl):
+        return groupby_aggregate_capped_chunked(
+            tbl, list(by), list(aggs), num_segments,
+            chunk_rows, chunk_segments,
+        )
+
+    return jax.jit(fn)

@@ -1,0 +1,219 @@
+"""Two-level chunked groupby (ops/groupby_chunked.py, round-4 headline).
+
+Oracle-checked against pandas on randomized data with nulls, plus the
+capacity/fallback protocol and the eager router. Exact aggregations
+(int sums, counts, min/max, first/last) must match bit-for-bit; float
+means re-associate like any parallel reduction (documented), so they
+compare at tight rtol.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops import groupby as groupby_mod
+from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg, groupby_aggregate
+from spark_rapids_jni_tpu.ops.groupby_chunked import (
+    chunked_groupby_supported,
+    groupby_aggregate_capped_chunked,
+    groupby_aggregate_chunked,
+)
+
+
+def _table(n=40_000, n_keys=300, seed=0, null_frac=0.15):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, n_keys, n).astype(np.int64)
+    v = rng.integers(-1000, 1000, n).astype(np.int64)
+    f = rng.standard_normal(n)
+    vv = rng.random(n) > null_frac
+    t = Table(
+        [
+            Column.from_numpy(k),
+            Column.from_numpy(v, validity=vv),
+            Column.from_numpy(f),
+        ],
+        ["k", "v", "f"],
+    )
+    df = pd.DataFrame({"k": k, "v": np.where(vv, v, np.nan), "f": f})
+    return t, df
+
+
+_AGGS = [
+    GroupbyAgg("v", "sum"),
+    GroupbyAgg("v", "count"),
+    GroupbyAgg("v", "min"),
+    GroupbyAgg("v", "max"),
+    GroupbyAgg("f", "mean"),
+    GroupbyAgg("v", "first"),
+    GroupbyAgg("v", "last"),
+]
+
+
+def _oracle(df):
+    return (
+        df.groupby("k")
+        .agg(
+            sum_v=("v", "sum"),
+            count_v=("v", "count"),
+            min_v=("v", "min"),
+            max_v=("v", "max"),
+            mean_f=("f", "mean"),
+            first_v=("v", "first"),
+            last_v=("v", "last"),
+        )
+        .sort_index()
+    )
+
+
+def _check(out, df):
+    g = _oracle(df)
+    assert out.row_count == len(g)
+    order = np.argsort(np.asarray(out["k"].to_numpy()))
+    for name in ("sum_v", "count_v", "min_v", "max_v", "first_v", "last_v"):
+        got = np.asarray(out[name].to_numpy(), dtype=np.float64)[order]
+        np.testing.assert_array_equal(got, g[name].to_numpy(np.float64), err_msg=name)
+    np.testing.assert_allclose(
+        np.asarray(out["mean_f"].to_numpy())[order],
+        g["mean_f"].to_numpy(),
+        rtol=1e-9,
+    )
+
+
+def test_chunked_matches_pandas():
+    t, df = _table()
+    out = groupby_aggregate_chunked(t, ["k"], _AGGS, chunk_rows=1 << 13)
+    assert out is not None
+    _check(out, df)
+
+
+def test_chunked_matches_single_pass_exactly():
+    """Integer aggregations must be bit-identical to the one-pass path."""
+    t, df = _table(seed=7)
+    chunked = groupby_aggregate_chunked(
+        t, ["k"], _AGGS[:4], chunk_rows=1 << 12
+    )
+    direct = groupby_aggregate(t, ["k"], _AGGS[:4])
+    for name in chunked.names:
+        np.testing.assert_array_equal(
+            np.asarray(chunked[name].to_numpy(), np.float64),
+            np.asarray(direct[name].to_numpy(), np.float64),
+            err_msg=name,
+        )
+
+
+def test_capped_chunked_reports_overflow():
+    """max per-chunk group count > chunk_segments flags truncation."""
+    t, _ = _table(n=4096, n_keys=4000, seed=1)
+    _, _, max_chunk = groupby_aggregate_capped_chunked(
+        t, ["k"], [GroupbyAgg("v", "sum")],
+        num_segments=4096, chunk_rows=1024, chunk_segments=64,
+    )
+    assert int(max_chunk) > 64  # proof the caller CAN detect it
+
+
+def test_eager_falls_back_on_high_cardinality():
+    """Near-distinct keys: chunking can't win; wrapper must defer."""
+    rng = np.random.default_rng(3)
+    n = 20_000
+    k = rng.permutation(n).astype(np.int64)  # all distinct
+    t = Table([Column.from_numpy(k), Column.from_numpy(k)], ["k", "v"])
+    out = groupby_aggregate_chunked(
+        t, ["k"], [GroupbyAgg("v", "sum")], chunk_rows=1 << 12
+    )
+    assert out is None
+    # ... and the public API still answers correctly via single-pass
+    full = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "sum")])
+    assert full.row_count == n
+
+
+def test_router_uses_chunked_path(monkeypatch):
+    """Above CHUNKED_MIN_ROWS the public eager API takes the new path."""
+    t, df = _table(n=30_000, seed=5)
+    monkeypatch.setattr(groupby_mod, "CHUNKED_MIN_ROWS", 10_000)
+    calls = {}
+
+    import spark_rapids_jni_tpu.ops.groupby_chunked as gc
+    real = gc.groupby_aggregate_chunked
+
+    def spy(*a, **k):
+        calls["hit"] = True
+        return real(*a, **k)
+
+    monkeypatch.setattr(gc, "groupby_aggregate_chunked", spy)
+    out = groupby_aggregate(t, ["k"], _AGGS)
+    assert calls.get("hit"), "router did not take the chunked path"
+    _check(out, df)
+
+
+def test_router_keeps_single_pass_for_nondecomposable(monkeypatch):
+    t, _ = _table(n=30_000, seed=6)
+    monkeypatch.setattr(groupby_mod, "CHUNKED_MIN_ROWS", 10_000)
+    assert not chunked_groupby_supported(
+        t, [GroupbyAgg("v", "variance")]
+    )
+    out = groupby_aggregate(t, ["k"], [GroupbyAgg("v", "variance")])
+    g = pd.DataFrame(
+        {"k": np.asarray(t["k"].to_numpy())}
+    )  # row count only; variance itself is covered in test_ops
+    assert out.row_count == g.k.nunique()
+
+
+def test_multi_key_and_string_free_path():
+    """Two int keys; exactness across chunk boundaries."""
+    rng = np.random.default_rng(11)
+    n = 25_000
+    k1 = rng.integers(0, 40, n).astype(np.int64)
+    k2 = rng.integers(0, 25, n).astype(np.int32)
+    v = rng.integers(-50, 50, n).astype(np.int64)
+    t = Table(
+        [Column.from_numpy(k1), Column.from_numpy(k2), Column.from_numpy(v)],
+        ["a", "b", "v"],
+    )
+    out = groupby_aggregate_chunked(
+        t, ["a", "b"], [GroupbyAgg("v", "sum")], chunk_rows=1 << 12
+    )
+    assert out is not None
+    df = (
+        pd.DataFrame({"a": k1, "b": k2, "v": v})
+        .groupby(["a", "b"])
+        .v.sum()
+        .reset_index()
+    )
+    assert out.row_count == len(df)
+    got = pd.DataFrame(
+        {
+            "a": np.asarray(out["a"].to_numpy()),
+            "b": np.asarray(out["b"].to_numpy()),
+            "v": np.asarray(out["sum_v"].to_numpy()),
+        }
+    ).sort_values(["a", "b"]).reset_index(drop=True)
+    want = df.sort_values(["a", "b"]).reset_index(drop=True)
+    np.testing.assert_array_equal(got.v.to_numpy(), want.v.to_numpy())
+
+
+def test_null_keys_form_one_group():
+    rng = np.random.default_rng(13)
+    n = 12_000
+    k = rng.integers(0, 50, n).astype(np.int64)
+    kv = rng.random(n) > 0.1  # 10% null keys
+    v = rng.integers(0, 100, n).astype(np.int64)
+    t = Table(
+        [Column.from_numpy(k, validity=kv), Column.from_numpy(v)],
+        ["k", "v"],
+    )
+    out = groupby_aggregate_chunked(
+        t, ["k"], [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")],
+        chunk_rows=1 << 11,
+    )
+    assert out is not None
+    df = pd.DataFrame({"k": np.where(kv, k, np.nan), "v": v})
+    g = df.groupby("k", dropna=False).v.agg(["sum", "count"])
+    assert out.row_count == len(g)
+    # the null-key group's total
+    kvalid = np.asarray(out["k"].validity) if out["k"].validity is not None else None
+    null_rows = np.where(~kvalid)[0] if kvalid is not None else []
+    assert len(null_rows) == 1
+    got_null_sum = int(np.asarray(out["sum_v"].to_numpy())[null_rows[0]])
+    assert got_null_sum == int(df[df.k.isna()].v.sum())
