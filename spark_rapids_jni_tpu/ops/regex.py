@@ -47,6 +47,14 @@ from .strings import _require_string, _shift_left
 _MAX_DFA_STATES = 1024
 _MAX_COUNTED_REPEAT = 64
 
+
+class UnsupportedPatternError(ValueError):
+    """Pattern outside the engine's documented subset (or beyond its
+    DFA-size budget). Typed so a Spark layer can catch it and fall back
+    to CPU evaluation instead of failing the query — the posture cudf
+    takes for its unsupported regex corners. Subclasses ValueError so
+    existing raise-on-unsupported callers keep working."""
+
 _DIGIT = frozenset(range(ord("0"), ord("9") + 1))
 _WORD = frozenset(
     set(_DIGIT)
@@ -81,7 +89,9 @@ class _Parser:
         return c
 
     def _error(self, msg):
-        raise ValueError(f"regex: {msg} at position {self.i} in {self.p!r}")
+        raise UnsupportedPatternError(
+            f"regex: {msg} at position {self.i} in {self.p!r}"
+        )
 
     def parse(self):
         node = self._alt()
@@ -254,6 +264,50 @@ class _Parser:
         return frozenset(_ALL - members if negate else members)
 
 
+def _split_top_level(pattern: str) -> list[str]:
+    """Split on ``|`` at nesting depth 0 (host-side, respecting escapes,
+    groups and character classes) — how Java scopes anchors: in
+    ``^a|b`` the ``^`` binds only the first branch."""
+    branches = []
+    depth = 0
+    in_class = False
+    cur = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            cur.append(pattern[i : i + 2])
+            i += 2
+            continue
+        if in_class:
+            if c == "]":
+                in_class = False
+        elif c == "[":
+            in_class = True
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            depth = max(0, depth - 1)
+        elif c == "|" and depth == 0:
+            branches.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    branches.append("".join(cur))
+    return branches
+
+
+def _branch_anchored(branch: str) -> bool:
+    if branch.startswith("^"):
+        return True
+    if branch.endswith("$"):
+        nbs = len(branch[:-1]) - len(branch[:-1].rstrip("\\"))
+        return nbs % 2 == 0
+    return False
+
+
 def _strip_anchors(pattern: str):
     """Peel ``^``/``$`` off the pattern ends (the only positions the
     subset supports; elsewhere the parser errors out)."""
@@ -396,7 +450,7 @@ def _determinize(nfa: _NFA, start: int, accept: int, class_map) -> tuple:
             nxt = nfa.closure(moved) if moved else frozenset()
             if nxt not in ids:
                 if len(ids) >= _MAX_DFA_STATES:
-                    raise ValueError(
+                    raise UnsupportedPatternError(
                         f"regex too complex: DFA exceeds {_MAX_DFA_STATES} states"
                     )
                 ids[nxt] = len(ids)
@@ -442,14 +496,14 @@ def _group_geometry(node):
     items = node[1] if node[0] == "cat" else [node]
     gidx = [i for i, it in enumerate(items) if it[0] == "group"]
     if len(gidx) != 1:
-        raise ValueError(
+        raise UnsupportedPatternError(
             "extract_re: pattern must contain exactly one capture group"
         )
     g = gidx[0]
     pre_lo, pre_hi = _node_len_range(("cat", items[:g]))
     suf_lo, suf_hi = _node_len_range(("cat", items[g + 1 :]))
     if pre_lo != pre_hi or suf_lo != suf_hi:
-        raise ValueError(
+        raise UnsupportedPatternError(
             "extract_re: text before/after the capture group must have a "
             "fixed match length (use {m} instead of open quantifiers there)"
         )
@@ -466,6 +520,16 @@ def compile_re(
     body, anch_s, anch_e = _strip_anchors(pattern)
     parser = _Parser(body)
     ast = parser.parse()
+    if (anch_s or anch_e) and ast[0] == "alt":
+        # '^a|b' must NOT become '^(a|b)': Java/Spark scope anchors to
+        # one branch (ADVICE r3). contains_re/matches_re split branches
+        # before reaching here; span ops (extract/replace) surface the
+        # typed error instead of silently changing match semantics.
+        raise UnsupportedPatternError(
+            "anchor over a top-level alternation: in Java the anchor "
+            "binds one branch, which the single-DFA span engine cannot "
+            "express — split the pattern into per-branch calls"
+        )
     pre = suf = None
     if with_group:
         pre, suf = _group_geometry(ast)
@@ -503,8 +567,19 @@ def _dfa_tables(rx: CompiledRegex):
 def contains_re(col: Column, pattern: str) -> Column:
     """True where the pattern matches anywhere in the string — Spark
     ``rlike`` / cudf ``strings::contains_re``. One DFA state per row,
-    ``pad`` scan steps of one gather each."""
+    ``pad`` scan steps of one gather each.
+
+    Anchored top-level alternations (``^a|b``, ``a$|^b``) evaluate one
+    DFA per branch and OR the results — the anchor binds its own branch
+    only, matching Java (``re.search('^a|b', 'zb')`` is True)."""
     _require_string(col)
+    branches = _split_top_level(pattern)
+    if len(branches) > 1 and any(_branch_anchored(b) for b in branches):
+        out = contains_re(col, branches[0])
+        for b in branches[1:]:
+            nxt = contains_re(col, b)
+            out = Column(out.data | nxt.data, dt.BOOL8, col.validity)
+        return out
     rx = compile_re(pattern, search_prefix=True)
     cmap, tflat, acc, C = _dfa_tables(rx)
     n, pad = col.data.shape
@@ -533,8 +608,18 @@ def contains_re(col: Column, pattern: str) -> Column:
 
 def matches_re(col: Column, pattern: str) -> Column:
     """Anchored full-string match — cudf ``strings::matches_re`` (Java
-    ``String.matches``): equivalent to ``^pattern$``."""
+    ``String.matches``): the whole string must match the pattern. A
+    top-level alternation full-matches if ANY branch full-matches
+    (``"a".matches("^a|b")`` is True in Java), so each branch gets its
+    own ``^...$`` wrap rather than one ambiguous concatenation."""
     _require_string(col)
+    branches = _split_top_level(pattern)
+    if len(branches) > 1:
+        out = matches_re(col, branches[0])
+        for b in branches[1:]:
+            nxt = matches_re(col, b)
+            out = Column(out.data | nxt.data, dt.BOOL8, col.validity)
+        return out
     body, _, _ = _strip_anchors(pattern)
     return contains_re(col, "^" + body + "$")
 

@@ -199,3 +199,94 @@ def test_dfa_state_cap():
     # exponential-subset pattern: (a|b)*a(a|b){n} needs ~2^n DFA states
     with pytest.raises(ValueError):
         rx.compile_re(r"(?:a|b)*a(?:a|b){12}")
+
+
+# ---------------------------------------------------------------------------
+# round 4: anchor scoping over alternation (ADVICE r3 medium) + typed
+# errors + generated differential corpus (VERDICT r3 item 8)
+# ---------------------------------------------------------------------------
+
+
+def test_anchor_binds_one_branch():
+    """Java/Spark semantics: '^a|b' is '(^a)|b', NOT '^(a|b)'."""
+    col = _col(["zb", "az", "za", "b", "a", ""])
+    for pattern in [r"^a|b", r"b|^a", r"a$|b", r"^a|b$", r"a$|^b"]:
+        got = np.asarray(rx.contains_re(col, pattern).data).tolist()
+        want = [re.search(pattern, w) is not None for w in col.to_pylist()]
+        assert got == want, pattern
+
+
+def test_matches_re_alternation_per_branch():
+    """Full match succeeds iff ANY branch full-matches."""
+    col = _col(["a", "b", "ab", "ba", ""])
+    for pattern in [r"a|b", r"a+|b", r"^a|b", r"a|"]:
+        got = np.asarray(rx.matches_re(col, pattern).data).tolist()
+        want = [
+            re.fullmatch(f"(?:{pattern})", w) is not None
+            for w in col.to_pylist()
+        ]
+        assert got == want, pattern
+
+
+def test_typed_unsupported_pattern_error():
+    col = _col(["x"])
+    for bad in [r"(a", r"a{1,999}", r"mid^dle"]:
+        with pytest.raises(rx.UnsupportedPatternError):
+            rx.contains_re(col, bad)
+    with pytest.raises(rx.UnsupportedPatternError):
+        rx.compile_re(r"(?:a|b)*a(?:a|b){12}")  # DFA state overflow
+    # span ops can't distribute anchors: typed error, not wrong results
+    with pytest.raises(rx.UnsupportedPatternError):
+        rx.replace_re(col, r"^a|b", "X")
+
+
+def _gen_pattern(rng):
+    """Random pattern clamped to the documented subset."""
+    atoms = [
+        "a", "b", "0", "_", ".", r"\d", r"\w", r"\s", "[ab]", "[^a]",
+        "[a-c]", r"\.",
+    ]
+    quants = ["", "", "", "*", "+", "?", "{2}", "{1,3}"]
+
+    def branch():
+        k = int(rng.integers(1, 5))
+        out = []
+        for _ in range(k):
+            a = atoms[int(rng.integers(0, len(atoms)))]
+            q = quants[int(rng.integers(0, len(quants)))]
+            if q and int(rng.integers(0, 4)) == 0:
+                a = f"(?:{a}{atoms[int(rng.integers(0, len(atoms)))]})"
+            out.append(a + q)
+        return "".join(out)
+
+    nb = int(rng.integers(1, 4))
+    branches = [branch() for _ in range(nb)]
+    # per-branch anchors, like Java scopes them
+    branches = [
+        ("^" if int(rng.integers(0, 5)) == 0 else "")
+        + b
+        + ("$" if int(rng.integers(0, 5)) == 0 else "")
+        for b in branches
+    ]
+    return "|".join(branches)
+
+
+def test_differential_corpus_vs_python_re():
+    """200 generated patterns x 60 random strings: the DFA engine must
+    agree with Python re.search on every (pattern, string) pair inside
+    the documented subset. No '\\n' in the corpus: Python's '$' matches
+    before a trailing newline, ours means hard string end."""
+    rng = np.random.default_rng(20260730)
+    strings = _rand_strings(rng, n=60, alphabet="ab01 _.", max_len=10)
+    col = _col(strings)
+    checked = 0
+    for _ in range(200):
+        pattern = _gen_pattern(rng)
+        try:
+            got = np.asarray(rx.contains_re(col, pattern).data).tolist()
+        except rx.UnsupportedPatternError:
+            continue  # outside the enforced subset: allowed to refuse
+        want = [re.search(pattern, s) is not None for s in strings]
+        assert got == want, f"divergence for {pattern!r}"
+        checked += 1
+    assert checked > 150  # the subset must actually cover the grammar
