@@ -526,6 +526,76 @@ def bench_parquet_pipeline(platform, n_groups=4, rows_per_group=1_500_000):
     }
 
 
+def bench_strings(platform, n=10_000_000, pad=128):
+    """Round-4 VERDICT item 5 bench: literal contains at pad=128 via the
+    shift-or scan, and a 10M x 10M string-key join through automatic
+    dictionary encoding."""
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops import strings as strings_mod
+    from spark_rapids_jni_tpu.ops.join import inner_join
+
+    from spark_rapids_jni_tpu import dtype as dt_mod
+
+    rng = np.random.default_rng(17)
+    # contains: random a-z bytes, lengths ~uniform(0, pad)
+    lens = rng.integers(0, pad + 1, n).astype(np.int32)
+    mat = rng.integers(97, 123, (n, pad), dtype=np.uint8)
+    mat[np.arange(pad)[None, :] >= lens[:, None]] = 0
+    col = Column(
+        jax.numpy.asarray(mat), dt_mod.STRING, None,
+        jax.numpy.asarray(lens),
+    )
+    jax.block_until_ready(col.data)
+    fn = jax.jit(lambda c: strings_mod.contains(c, "qzx"))
+    med, mn, std, out = _timeit(fn, [(col,)], reps_per_input=3)
+    e1 = _entry(
+        "strings", f"contains_{n // 1_000_000}M_pad{pad}", n, med, mn,
+        std, n * pad, platform,
+    )
+
+    # string-key join: 100k distinct 12-byte keys (byte matrix built
+    # host-side in numpy; 10M python strings would dominate the setup)
+    nj = n
+    klen = 12
+    uniq = np.zeros((100_000, klen), np.uint8)
+    for i in range(100_000):
+        uniq[i] = np.frombuffer(
+            ("k" + format(i, "011d")).encode(), np.uint8
+        )
+
+    def str_table(idx, name):
+        m = uniq[idx]
+        return Table(
+            [
+                Column(
+                    jax.numpy.asarray(m), dt_mod.STRING, None,
+                    jax.numpy.full((nj,), klen, jax.numpy.int32),
+                ),
+                Column.from_numpy(np.arange(nj, dtype=np.int64)),
+            ],
+            ["k", name],
+        )
+
+    lt = str_table(rng.integers(0, 100_000, nj), "lv")
+    rt = str_table(rng.integers(0, 100_000, nj), "rv")
+    jax.block_until_ready(lt.columns[0].data)
+    t0 = time.perf_counter()
+    out = inner_join(lt, rt, ["k"])
+    np.asarray(out.columns[1].data.ravel()[-1:])
+    join_s = time.perf_counter() - t0
+    e2 = {
+        "config": "strings",
+        "name": f"string_key_join_{nj // 1_000_000}Mx{nj // 1_000_000}M",
+        "rows": 2 * nj,
+        "seconds_median": round(join_s, 4),
+        "matches": out.row_count,
+        "platform": platform,
+    }
+    return [e1, e2]
+
+
 def bench_distributed_skew():
     """Config 4 shape at 1e7 rows: zipf-skew distributed groupby through
     the ragged-compact exchange on the virtual 8-device CPU mesh (the
@@ -593,6 +663,7 @@ _SUBPROCESS_CONFIGS = {
     "join_batched": bench_join_batched,
     "sort": bench_sort,
     "sort_gather": bench_sort_gather,
+    "strings": bench_strings,
     "resident": bench_resident_chain,
     "parquet": bench_parquet_pipeline,
 }
@@ -602,7 +673,8 @@ _SUBPROCESS_CONFIGS = {
 _LADDER = (
     "groupby100m_chunked", "groupby16m_chunked", "groupby1m",
     "groupby16m", "groupby100m", "transpose",
-    "join_batched", "sort", "sort_gather", "resident", "parquet",
+    "join_batched", "sort", "sort_gather", "strings", "resident",
+    "parquet",
 )
 
 _CONFIG_TIMEOUT_S = 1800
