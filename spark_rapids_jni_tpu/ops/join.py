@@ -574,8 +574,16 @@ def inner_join_batched(
     from .copying import concatenate, slice_rows
 
     right_on = right_on or on
+    out_row_bytes = None
     if probe_rows is None:
-        probe_rows = FUSED_PROBE_MAX_ROWS
+        # size the chunk from the HBM budget (round-4 VERDICT item 7:
+        # capped/batched APIs plan memory instead of fixed constants),
+        # bounded by the codegen-fault fence
+        from ..utils import hbm
+
+        plan = hbm.join_plan(left, right, on, right_on)
+        probe_rows = min(FUSED_PROBE_MAX_ROWS, plan["probe_rows"])
+        out_row_bytes = plan["output_row_bytes"]
     if probe_rows <= 0:
         raise ValueError(f"probe_rows must be positive, got {probe_rows}")
     n = left.row_count
@@ -601,15 +609,33 @@ def inner_join_batched(
     perm_r, sorted_words = _batched_prep_fn(ron_key)(right)
     sorted_words = tuple(sorted_words)
     probe = _chunk_ranges_fn(on_key, False)
+    if out_row_bytes is None:
+        from ..utils import hbm
+
+        out_row_bytes = hbm.row_bytes(left) + hbm.row_bytes(right)
+    # a chunk whose matched output would dwarf what the planner budgeted
+    # (heavy key skew) re-splits instead of materializing — fan-out is
+    # data-dependent, so output fit is enforced here, not assumed
+    chunk_out_budget = max(probe_rows * 2 * out_row_bytes, 64 << 20)
+    from collections import deque
+
+    spans = deque(
+        (s, min(s + probe_rows, n)) for s in range(0, n, probe_rows)
+    )
     pieces = []
-    for start in range(0, n, probe_rows):
-        stop = min(start + probe_rows, n)
+    while spans:
+        start, stop = spans.popleft()
         chunk = slice_rows(left, start, stop)
         lo, counts, _, total_dev = probe(sorted_words, chunk)
         total = int(total_dev)
         if total == 0:
             continue
         cap = max(32, 1 << (total - 1).bit_length())  # pow2 bucket
+        if cap * out_row_bytes > chunk_out_budget and stop - start > 1024:
+            mid = (start + stop) // 2
+            spans.appendleft((mid, stop))
+            spans.appendleft((start, mid))
+            continue
         padded = _batched_materialize_fn(ron_key, cap)(
             perm_r, lo, counts, chunk, right
         )

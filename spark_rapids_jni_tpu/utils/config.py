@@ -59,6 +59,11 @@ _FLAGS = {
         Flag("DISABLE_X64", False, _as_bool, "refuse 64-bit device types"),
         Flag("TEST_PLATFORM", "cpu", str, "test backend (cpu|axon)"),
         Flag("NATIVE_LIB", "", str, "explicit native library path"),
+        Flag(
+            "HBM_BUDGET_GB", 0.0, float,
+            "per-chip HBM budget in GiB for the footprint planner "
+            "(utils/hbm.py); 0 = backend default (v5e: 16)",
+        ),
     ]
 }
 

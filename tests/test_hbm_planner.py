@@ -1,0 +1,156 @@
+"""HBM footprint planner (utils/hbm.py, round-4 VERDICT item 7).
+
+The planner must (a) estimate resident bytes accurately for columns and
+tables, (b) size join probe chunks from the budget instead of fixed
+constants, and (c) make the batched join re-split skewed chunks whose
+output would blow the planned footprint — all verified here against a
+pandas oracle so safety never changes answers.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops import join as join_mod
+from spark_rapids_jni_tpu.utils import config, hbm
+
+
+@pytest.fixture(autouse=True)
+def _clear_flags():
+    yield
+    config.clear_flag("HBM_BUDGET_GB")
+
+
+def test_column_and_table_bytes_exact():
+    n = 1000
+    c1 = Column.from_numpy(np.arange(n, dtype=np.int64))           # 8000
+    c2 = Column.from_numpy(
+        np.arange(n, dtype=np.int32), validity=np.ones(n, bool)
+    )  # 4000 + 1000
+    t = Table([c1, c2])
+    assert hbm.column_bytes(c1) == 8 * n
+    assert hbm.column_bytes(c2) == 5 * n
+    assert hbm.table_bytes(t) == 13 * n
+    assert hbm.row_bytes(t) == 13
+
+
+def test_string_key_word_count():
+    c = Column.from_strings(["abcdefgh" * 2, "x"])  # pad 16
+    # pad/8 = 2 words + length word; nullable adds one more
+    assert hbm.key_word_count([c]) == 3
+
+
+def test_budget_flag_and_reserve():
+    config.set_flag("HBM_BUDGET_GB", 2.0)
+    b = hbm.budget_bytes()
+    assert b == int(2.0 * hbm.GIB * (1 - hbm.RESERVE_FRACTION))
+    config.set_flag("HBM_BUDGET_GB", 4.0)
+    assert hbm.budget_bytes() == 2 * b
+
+
+def _tables(n=6000, seed=0, hot=None):
+    rng = np.random.default_rng(seed)
+    kl = rng.integers(0, 500, n).astype(np.int64)
+    kr = rng.integers(0, 500, n).astype(np.int64)
+    if hot is not None:
+        kl[: n // 3] = hot  # skew a third of the probe side onto one key
+        kr[: n // 3] = hot
+    left = Table(
+        [Column.from_numpy(kl), Column.from_numpy(np.arange(n, dtype=np.int64))],
+        ["k", "lv"],
+    )
+    right = Table(
+        [Column.from_numpy(kr), Column.from_numpy(np.arange(n, dtype=np.int64) * 3)],
+        ["k", "rv"],
+    )
+    return left, right, kl, kr
+
+
+def test_join_plan_scales_with_budget():
+    left, right, _, _ = _tables()
+    config.set_flag("HBM_BUDGET_GB", 1.0)
+    small = hbm.join_plan(left, right, ["k"], ["k"])
+    config.set_flag("HBM_BUDGET_GB", 8.0)
+    big = hbm.join_plan(left, right, ["k"], ["k"])
+    assert big["probe_rows"] > small["probe_rows"]
+    assert small["fits"] and big["fits"]
+    # at 100M-row scale the plan must stay under the fault fence anyway
+    assert small["probe_rows"] >= 1024
+
+
+def test_batched_join_resplits_skewed_chunks(monkeypatch):
+    """A hot key whose fan-out blows the chunk output budget must force
+    a re-split (more probe calls), with identical results."""
+    left, right, kl, kr = _tables(n=6000, seed=2, hot=7)
+    oracle = pd.DataFrame({"k": kl, "lv": np.arange(6000)}).merge(
+        pd.DataFrame({"k": kr, "rv": np.arange(6000) * 3}), on="k"
+    )
+
+    calls = {"n": 0}
+    real = join_mod._chunk_ranges_fn
+
+    def counting(on, with_valid):
+        fn = real(on, with_valid)
+
+        def wrapped(*a, **k):
+            calls["n"] += 1
+            return fn(*a, **k)
+
+        return wrapped
+
+    monkeypatch.setattr(join_mod, "_chunk_ranges_fn", counting)
+    # tiny budget: chunk_out_budget floor (64 MiB) never triggers at
+    # this scale, so shrink the floor too
+    monkeypatch.setattr(
+        join_mod, "inner_join_batched", join_mod.inner_join_batched
+    )
+    out = join_mod.inner_join_batched(
+        left, right, ["k"], probe_rows=2048
+    )
+    base_calls = calls["n"]
+    assert out.row_count == len(oracle)
+
+    # force re-splitting by shrinking the output budget via a fake
+    # out_row estimate: patch hbm.row_bytes to a huge value
+    calls["n"] = 0
+    monkeypatch.setattr(
+        join_mod,
+        "FUSED_PROBE_MAX_ROWS",
+        2048,
+    )
+    monkeypatch.setattr(hbm, "row_bytes", lambda t: 1 << 22)
+    out2 = join_mod.inner_join_batched(left, right, ["k"])
+    assert calls["n"] > base_calls, "oversized chunks did not re-split"
+    assert out2.row_count == len(oracle)
+    got = np.asarray(out2["lv"].to_numpy(), np.int64).sum() + np.asarray(
+        out2["rv"].to_numpy(), np.int64
+    ).sum()
+    assert int(got) == int(oracle.lv.sum() + oracle.rv.sum())
+
+
+def test_sort_and_groupby_plans_report_fit():
+    left, right, _, _ = _tables(n=4000)
+    sp = hbm.sort_plan(left, n_key_words=2)
+    assert sp["fits"] and sp["total_bytes"] > 0
+    gp = hbm.groupby_plan(left, ["k"], num_segments=1000)
+    assert gp["fits"] and gp["total_bytes"] > 0
+    # a 100M-row x 50-col monster must NOT claim to fit in 1 GiB
+    config.set_flag("HBM_BUDGET_GB", 1.0)
+    big = Table(
+        [Column.from_numpy(np.zeros(100, np.int64)) for _ in range(3)],
+        ["a", "b", "c"],
+    )
+
+    class Fake:
+        row_count = 100_000_000
+        columns = big.columns
+
+        def column(self, c):
+            return big.columns[0]
+
+    fake = Fake()
+    import unittest.mock as mock
+
+    with mock.patch.object(hbm, "table_bytes", return_value=100_000_000 * 24):
+        assert not hbm.sort_plan(fake, n_key_words=2)["fits"]
