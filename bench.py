@@ -596,6 +596,116 @@ def bench_strings(platform, n=10_000_000, pad=128):
     return [e1, e2]
 
 
+def bench_parquet_device(platform, n_groups=4, rows_per_group=1_500_000):
+    """Round-4 VERDICT item 4 A/B: scan throughput of the device page
+    decoder (host parses headers, uploads ENCODED bytes, chip expands)
+    vs the host-Arrow-decode + upload path, on the config-5 shape."""
+    import tempfile
+    import time as _time
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.io.parquet import scan_parquet
+
+    rng = np.random.default_rng(23)
+    n = n_groups * rows_per_group
+    with tempfile.TemporaryDirectory() as td:
+        path = f"{td}/bench_dev.parquet"
+        pq.write_table(
+            pa.table({
+                "k": rng.integers(0, 1000, n),          # dict-encodable
+                "v": rng.standard_normal(n),            # PLAIN doubles
+                "q": rng.integers(0, 100, n).astype(np.int32),
+            }),
+            path,
+            row_group_size=rows_per_group,
+        )
+
+        def scan(device):
+            t0 = _time.perf_counter()
+            total = 0
+            checksum = 0.0
+            for batch in scan_parquet(path, device_decode=device):
+                # force materialization on device: a reduction + fetch
+                total += batch.row_count
+                checksum += float(
+                    np.asarray(batch["q"].data.astype(np.int64).sum())
+                )
+            return _time.perf_counter() - t0, total, checksum
+
+        scan(False)  # warm compile + page cache
+        scan(True)
+        host_s, t1, c1 = scan(False)
+        dev_s, t2, c2 = scan(True)
+        assert t1 == t2 and c1 == c2, "device decode changed the data"
+    return {
+        "config": 5,
+        "name": f"parquet_device_decode_{n_groups}x{rows_per_group // 1000}k",
+        "rows": n,
+        "host_decode_seconds": round(host_s, 3),
+        "device_decode_seconds": round(dev_s, 3),
+        "speedup": round(host_s / dev_s, 2),
+        "platform": platform,
+    }
+
+
+def bench_tpcds(platform):
+    """Configs 4-5 with REAL data (round-4 VERDICT item 6): seeded
+    Parquet star schema at SRT_TPCDS_SCALE (default SF1: 2.88M
+    store_sales rows), streamed scan->join->agg q5/q23/q64 with pandas
+    oracle verdicts recorded per query."""
+    import os
+
+    from benchmarks import tpcds
+
+    scale = float(os.environ.get("SRT_TPCDS_SCALE", "1.0"))
+    cache = f"/tmp/srt_tpcds_sf{scale}"
+    if not os.path.exists(os.path.join(cache, "store_sales.parquet")):
+        _progress(f"generating TPC-DS parquet at scale {scale} -> {cache}")
+        tpcds.generate_parquet(cache, scale=scale, seed=0)
+    entries = tpcds.run_all(cache, prefetch=2)
+    for e in entries:
+        e.update({"config": 5, "scale": scale, "platform": platform})
+    return entries
+
+
+def bench_tpcds_distributed(devices: int = 8, scale: float = 0.05):
+    """Config 4: the same Parquet files through the mesh-distributed
+    q5/q23/q64 DAGs on the virtual CPU mesh (simulation wall-clock)."""
+    import os
+    import subprocess
+
+    cache = f"/tmp/srt_tpcds_sf{scale}"
+    if not os.path.exists(os.path.join(cache, "store_sales.parquet")):
+        from benchmarks import tpcds
+
+        tpcds.generate_parquet(cache, scale=scale, seed=0)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices}"
+    ).strip()
+    code = (
+        "import jax, json; jax.config.update('jax_platforms','cpu'); "
+        "from benchmarks import tpcds; "
+        f"print('TPCDS_DIST ' + json.dumps(tpcds.run_distributed({cache!r}, {devices})))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=1800, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("TPCDS_DIST "):
+            got = json.loads(line[len("TPCDS_DIST "):])
+            for e in got:
+                e.update({"config": 4, "scale": scale, "platform": "cpu-mesh"})
+            return got
+    _progress(f"tpcds distributed produced no JSON: {out.stderr[-400:]}")
+    return None
+
+
 def bench_distributed_skew():
     """Config 4 shape at 1e7 rows: zipf-skew distributed groupby through
     the ragged-compact exchange on the virtual 8-device CPU mesh (the
@@ -666,6 +776,8 @@ _SUBPROCESS_CONFIGS = {
     "strings": bench_strings,
     "resident": bench_resident_chain,
     "parquet": bench_parquet_pipeline,
+    "parquet_device": bench_parquet_device,
+    "tpcds": bench_tpcds,
 }
 
 # the on-chip ladder main()/the daemon walk, in order (chunked groupby
@@ -674,7 +786,7 @@ _LADDER = (
     "groupby100m_chunked", "groupby16m_chunked", "groupby1m",
     "groupby16m", "groupby100m", "transpose",
     "join_batched", "sort", "sort_gather", "strings", "resident",
-    "parquet",
+    "parquet", "parquet_device", "tpcds",
 )
 
 _CONFIG_TIMEOUT_S = 1800
@@ -909,6 +1021,8 @@ def main():
     platform = platform or "unreachable"
     _guard(entries, "config 4: distributed zipf skew, 8-device CPU mesh",
            bench_distributed_skew)
+    _guard(entries, "config 4: TPC-DS q5/q23/q64 from parquet, 8-dev mesh",
+           bench_tpcds_distributed)
 
     _progress("arrow baseline 100M")
     try:

@@ -39,9 +39,19 @@ def _require():
 
 
 def _normalize_paths(path) -> list:
+    import os
+
+    def one(p):
+        # fspath: pathlib.Path must behave exactly like str (the
+        # device_decode gate checks isinstance(p, str))
+        try:
+            return os.fspath(p)
+        except TypeError:
+            return p  # file-like objects pass through
+
     if isinstance(path, (list, tuple)):
-        return list(path)
-    return [path]
+        return [one(p) for p in path]
+    return [one(path)]
 
 
 def _row_group_stats(meta, rg_index: int, names: Sequence[str]) -> dict:
@@ -170,6 +180,7 @@ def scan_parquet(
     row_groups_per_batch: int = 1,
     exact_filter: bool = True,
     prefetch: int = 0,
+    device_decode: bool = False,
 ) -> Iterator[Table]:
     """Stream a Parquet file (or list of files) as device Table batches.
 
@@ -178,6 +189,12 @@ def scan_parquet(
     list of (name, op, value) tuples. ``prefetch=N`` decodes and uploads
     up to N batches ahead on a background thread, overlapping host
     decode with device compute (round-3 VERDICT item 10).
+
+    ``device_decode=True`` moves page decode onto the device for
+    fixed-width PLAIN/dictionary columns (io/parquet_device.py): the
+    host parses headers and uploads the still-encoded page bytes, the
+    chip does the O(n) expansion — the libcudf+nvcomp role. Columns the
+    device path can't take fall back to Arrow transparently.
     """
     _require()
     if prefetch > 0:
@@ -185,18 +202,43 @@ def scan_parquet(
             scan_parquet(
                 path, columns, filters, pad_widths,
                 row_groups_per_batch, exact_filter, prefetch=0,
+                device_decode=device_decode,
             ),
             prefetch,
         )
     return _scan_parquet_serial(
         path, columns, filters, pad_widths, row_groups_per_batch,
-        exact_filter,
+        exact_filter, device_decode,
     )
+
+
+def _device_decode_batch(path, pf, row_groups, read_cols, pad_widths):
+    """Device-decode one batch; Arrow-decode only what the device path
+    refuses, preserving the requested column order."""
+    from ..interop import table_from_arrow
+    from . import parquet_device as pdev
+
+    per_rg = []
+    for rg in row_groups:
+        decoded, fallback = pdev.decode_row_group(path, pf, rg, read_cols)
+        if fallback:
+            atbl = pf.read_row_groups([rg], columns=fallback)
+            host = table_from_arrow(atbl, pad_widths=pad_widths)
+            for name, col in zip(host.names, host.columns):
+                decoded[name] = col
+        per_rg.append(
+            Table([decoded[n] for n in read_cols], list(read_cols))
+        )
+    if len(per_rg) == 1:
+        return per_rg[0]
+    from ..ops.copying import concatenate
+
+    return concatenate(per_rg)
 
 
 def _scan_parquet_serial(
     path, columns, filters, pad_widths, row_groups_per_batch,
-    exact_filter,
+    exact_filter, device_decode=False,
 ) -> Iterator[Table]:
     predicate = preds.from_dnf(filters) if filters is not None else None
     for p in _normalize_paths(path):
@@ -218,12 +260,18 @@ def _scan_parquet_serial(
 
         for i in range(0, len(surviving), max(row_groups_per_batch, 1)):
             batch = surviving[i : i + max(row_groups_per_batch, 1)]
-            with trace_range("io.parquet.decode"):
-                atbl = pf.read_row_groups(batch, columns=read_cols)
-            with trace_range("io.parquet.upload"):
-                from ..interop import table_from_arrow
+            if device_decode and isinstance(p, str):
+                with trace_range("io.parquet.device_decode"):
+                    dev = _device_decode_batch(
+                        p, pf, batch, read_cols, pad_widths
+                    )
+            else:
+                with trace_range("io.parquet.decode"):
+                    atbl = pf.read_row_groups(batch, columns=read_cols)
+                with trace_range("io.parquet.upload"):
+                    from ..interop import table_from_arrow
 
-                dev = table_from_arrow(atbl, pad_widths=pad_widths)
+                    dev = table_from_arrow(atbl, pad_widths=pad_widths)
             if predicate is not None and exact_filter:
                 with trace_range("io.parquet.filter"):
                     dev = _apply_exact_filter(dev, predicate, want)

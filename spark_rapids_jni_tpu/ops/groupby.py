@@ -391,12 +391,21 @@ def groupby_aggregate_capped(
     aggs: Sequence[GroupbyAgg],
     num_segments: int,
     row_valid: Optional[jax.Array] = None,
+    return_collect_overflow: bool = False,
 ) -> tuple[Table, jax.Array]:
     """Jittable groupby: (padded result of ``num_segments`` rows, count).
 
     Padding rows have null keys/values (validity False past the count).
     ``row_valid`` excludes rows (e.g. shuffle-padding occupancy).
-    """
+
+    ``return_collect_overflow=True`` appends a device scalar: the
+    LARGEST pre-clamp valid-element count of any group across the
+    collect_list/collect_set aggregations (0 when there are none).
+    ``collect_*`` outputs silently truncate groups past
+    ``list_capacity`` — unlike every other ``*_capped`` API, whose
+    two-phase counts let callers detect overflow — so callers that
+    need losslessness check ``overflow <= list_capacity`` and resize
+    (r3 advisor finding)."""
     key_cols = [table.column(c) for c in by]
 
     # value columns ride the variadic sort as payload (one fused sort
@@ -440,6 +449,7 @@ def groupby_aggregate_capped(
             c if isinstance(c, str) else (table.names[c] if table.names else f"key{i}")
         )
 
+    collect_overflow = jnp.zeros((), jnp.int64)
     for agg in aggs:
         col = table.column(agg.column)
         j, nv = distinct[id(col)]
@@ -461,8 +471,25 @@ def groupby_aggregate_capped(
             else (table.names[agg.column] if table.names else f"c{agg.column}")
         )
         out_names.append(agg.name or f"{agg.op}_{base}")
+        if return_collect_overflow and agg.op in _COLLECT_OPS:
+            # pre-clamp element count of a group == its valid-row count
+            # (collect drops nulls), which the count machinery already
+            # computes from the same sorted payload. For collect_set
+            # this is an UPPER bound (valid rows, not distinct values):
+            # a conservative overflow signal, never a missed one.
+            starts, ends = bounds
+            n_valid = _sorted_segment_sum(
+                sorted_payload[j + nv].astype(jnp.int64), starts, ends
+            )
+            collect_overflow = jnp.maximum(
+                collect_overflow,
+                jnp.max(jnp.where(in_range, n_valid, 0)),
+            )
 
-    return Table(out_cols, out_names), num_groups
+    out = Table(out_cols, out_names)
+    if return_collect_overflow:
+        return out, num_groups, collect_overflow
+    return out, num_groups
 
 
 # above this, decomposable aggregations route through the two-level

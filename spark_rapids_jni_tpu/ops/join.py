@@ -39,12 +39,16 @@ from .gather import gather_table
 # splits are automatic, row_conversion.cu:476-479,505-511).
 # Module-level so tests can lower it to pin the routing.
 #
+# MIN_CHUNK_OUT_BYTES floors the batched join's per-chunk output budget
+# (module-level so the skew re-split path is testable at small scale).
+#
 # Scope of the fence: it removes the XLA codegen fault by keeping every
 # compiled probe graph at or below this row count. The OUTER joins'
 # materialization (expand + gathers over the full pair count) still runs
 # single-shot, so a pathological fan-out can exhaust HBM — that sizing
 # concern belongs to the memory planner (utils/hbm.py), not this fence.
 FUSED_PROBE_MAX_ROWS = 16_000_000
+MIN_CHUNK_OUT_BYTES = 64 << 20
 
 
 def _on_accelerator() -> bool:
@@ -582,6 +586,21 @@ def inner_join_batched(
         from ..utils import hbm
 
         plan = hbm.join_plan(left, right, on, right_on)
+        if not plan["fits"]:
+            # the fixed resident set (both tables + build words) alone
+            # exceeds the budget: no probe size can save it. Proceed at
+            # minimum chunks but say so — the reserve fraction is
+            # conservative, so this is a warning, not a refusal.
+            import warnings
+
+            warnings.warn(
+                "join inputs exceed the HBM budget before any probe "
+                f"chunk ({plan['fixed_bytes']} fixed vs "
+                f"{plan['budget_bytes']} budget); expect allocator "
+                "pressure. Raise SPARK_RAPIDS_TPU_HBM_BUDGET_GB if the "
+                "chip really has more.",
+                stacklevel=2,
+            )
         probe_rows = min(FUSED_PROBE_MAX_ROWS, plan["probe_rows"])
         out_row_bytes = plan["output_row_bytes"]
     if probe_rows <= 0:
@@ -616,7 +635,9 @@ def inner_join_batched(
     # a chunk whose matched output would dwarf what the planner budgeted
     # (heavy key skew) re-splits instead of materializing — fan-out is
     # data-dependent, so output fit is enforced here, not assumed
-    chunk_out_budget = max(probe_rows * 2 * out_row_bytes, 64 << 20)
+    chunk_out_budget = max(
+        probe_rows * 2 * out_row_bytes, MIN_CHUNK_OUT_BYTES
+    )
     from collections import deque
 
     spans = deque(

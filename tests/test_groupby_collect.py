@@ -218,3 +218,35 @@ def test_first_last_float_and_dec128(rng):
     out2 = groupby_aggregate(t2, ["k"], [GroupbyAgg("d", "first", name="f")])
     got2 = dict(zip(out2["k"].to_pylist(), out2.columns[1].to_pylist()))
     assert got2[1] == 10**20 and got2[2] == 7
+
+
+def test_capped_collect_reports_overflow():
+    """r3 advisor: collect truncation must be detectable. The capped
+    API's overflow scalar is the largest pre-clamp group size; callers
+    compare it to list_capacity like every other two-phase check."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import (
+        GroupbyAgg,
+        groupby_aggregate_capped,
+    )
+
+    k = np.array([1, 1, 1, 1, 2], dtype=np.int64)  # group 1 has 4 rows
+    v = np.arange(5, dtype=np.int64)
+    t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+    out, n, over = groupby_aggregate_capped(
+        t, ["k"],
+        [GroupbyAgg("v", "collect_list", list_capacity=2)],
+        num_segments=4,
+        return_collect_overflow=True,
+    )
+    assert int(n) == 2
+    assert int(over) == 4  # > list_capacity: truncation detectable
+    out2, _, over2 = groupby_aggregate_capped(
+        t, ["k"],
+        [GroupbyAgg("v", "collect_list", list_capacity=4)],
+        num_segments=4,
+        return_collect_overflow=True,
+    )
+    assert int(over2) == 4  # == capacity: lossless

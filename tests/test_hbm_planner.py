@@ -100,27 +100,19 @@ def test_batched_join_resplits_skewed_chunks(monkeypatch):
         return wrapped
 
     monkeypatch.setattr(join_mod, "_chunk_ranges_fn", counting)
-    # tiny budget: chunk_out_budget floor (64 MiB) never triggers at
-    # this scale, so shrink the floor too
-    monkeypatch.setattr(
-        join_mod, "inner_join_batched", join_mod.inner_join_batched
-    )
     out = join_mod.inner_join_batched(
-        left, right, ["k"], probe_rows=2048
+        left, right, ["k"], probe_rows=4096
     )
-    base_calls = calls["n"]
+    base_calls = calls["n"]  # ceil(6000/4096) = 2 probes, no splits
     assert out.row_count == len(oracle)
 
-    # force re-splitting by shrinking the output budget via a fake
-    # out_row estimate: patch hbm.row_bytes to a huge value
+    # shrink the output-budget floor so the skewed chunk (hot key:
+    # fan-out >> 2x) exceeds it and MUST re-split down to 1024-row
+    # spans before materializing; 4096-row chunks satisfy the
+    # `stop - start > 1024` split guard
     calls["n"] = 0
-    monkeypatch.setattr(
-        join_mod,
-        "FUSED_PROBE_MAX_ROWS",
-        2048,
-    )
-    monkeypatch.setattr(hbm, "row_bytes", lambda t: 1 << 22)
-    out2 = join_mod.inner_join_batched(left, right, ["k"])
+    monkeypatch.setattr(join_mod, "MIN_CHUNK_OUT_BYTES", 1 << 15)
+    out2 = join_mod.inner_join_batched(left, right, ["k"], probe_rows=4096)
     assert calls["n"] > base_calls, "oversized chunks did not re-split"
     assert out2.row_count == len(oracle)
     got = np.asarray(out2["lv"].to_numpy(), np.int64).sum() + np.asarray(

