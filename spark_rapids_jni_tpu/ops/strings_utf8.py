@@ -1,0 +1,197 @@
+"""UTF-8-aware string ops: char-level length/substring, case mapping.
+
+Round-4 VERDICT item 9: the base string ops (ops/strings.py) are
+byte/ASCII-level — correct for the bytes they see, but Spark's
+``length``/``substring``/``upper`` count CHARACTERS and case-map the
+whole Basic Multilingual Plane (cudf's string kernels are UTF-8 aware).
+This module adds the UTF-8 tier over the same (n, pad) byte-matrix
+representation, in the division of labor the engine uses everywhere:
+
+  host    builds lookup tables once per process (a 64K-entry BMP case
+          table from Python's own Unicode database — the analog of the
+          host-compiled DFA in ops/regex.py),
+  device  runs only fixed-shape vectorized passes: classify lead bytes,
+          assemble codepoints with shifts/ors, gather through the
+          table, re-emit bytes; a per-row cummax forward-fill gives
+          every continuation byte its character's mapped codepoint.
+
+Scope, stated where it binds (and pinned in tests):
+* case mapping covers 1:1 mappings whose UTF-8 byte length is
+  preserved — ASCII, Latin-1/Extended, Greek, Cyrillic, full-width
+  forms. Length-CHANGING mappings (German ß -> SS, U+0130 dotted I)
+  and supplementary-plane (4-byte) characters pass through unchanged;
+  cudf shares the 1:1 restriction for its device kernels.
+* inputs are assumed valid UTF-8 (what Spark hands the backend);
+  malformed bytes pass through byte-identically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dt
+from ..column import Column
+from .strings import _require_string, _shift_left
+
+
+def _in_str(col: Column):
+    n, pad = col.data.shape
+    j = jnp.arange(pad)[None, :]
+    return j < col.lengths[:, None]
+
+
+def _is_char_start(col: Column):
+    """Bytes that begin a character: everything but 0b10xxxxxx."""
+    return ((col.data & 0xC0) != 0x80) & _in_str(col)
+
+
+def char_length(col: Column) -> Column:
+    """Character count (Spark ``length``; cudf ``count_characters``)."""
+    _require_string(col)
+    n = jnp.sum(_is_char_start(col), axis=1).astype(jnp.int32)
+    return Column(n, dt.INT32, col.validity)
+
+
+def utf8_substring(
+    col: Column, start: int, length: int | None = None
+) -> Column:
+    """Character-indexed substring (0-based start; negative counts from
+    the end, Python/Spark style). Continuation bytes travel with their
+    character, so the kept byte range is contiguous and lands in one
+    ``_shift_left`` pass."""
+    _require_string(col)
+    is_start = _is_char_start(col)
+    in_str = _in_str(col)
+    # char index of every byte (continuation bytes inherit theirs)
+    char_idx = jnp.cumsum(is_start.astype(jnp.int32), axis=1) - 1
+    total = jnp.sum(is_start, axis=1).astype(jnp.int32)
+    if start < 0:
+        s = jnp.maximum(total + start, 0)[:, None]
+    else:
+        s = jnp.full_like(total, start)[:, None]
+    keep = in_str & (char_idx >= s)
+    if length is not None:
+        keep = keep & (char_idx < s + length)
+    any_keep = jnp.any(keep, axis=1)
+    first = jnp.where(any_keep, jnp.argmax(keep, axis=1), 0).astype(
+        jnp.int32
+    )
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return _shift_left(col, first, new_len)
+
+
+@functools.lru_cache(maxsize=4)
+def _bmp_case_table(upper: bool) -> np.ndarray:
+    """(65536,) uint32: cp -> case-mapped cp, restricted to 1:1
+    mappings that keep the UTF-8 byte length (so the device pass never
+    reflows bytes). Built once from Python's Unicode tables."""
+
+    def u8len(cp: int) -> int:
+        if cp < 0x80:
+            return 1
+        if cp < 0x800:
+            return 2
+        return 3
+
+    table = np.arange(0x10000, dtype=np.uint32)
+    for cp in range(0x10000):
+        if 0xD800 <= cp <= 0xDFFF:
+            continue  # surrogates: not characters
+        c = chr(cp)
+        m = c.upper() if upper else c.lower()
+        if len(m) == 1:
+            mcp = ord(m)
+            if mcp < 0x10000 and u8len(mcp) == u8len(cp):
+                table[cp] = mcp
+    return table
+
+
+def _case_map_utf8(col: Column, upper: bool) -> Column:
+    _require_string(col)
+    mat = col.data.astype(jnp.int32)
+    n, pad = mat.shape
+    j = jnp.arange(pad)[None, :]
+    in_str = _in_str(col)
+    b = jnp.where(in_str, mat, 0)
+
+    is1 = (b < 0x80) & in_str
+    is2 = (b & 0xE0) == 0xC0
+    is3 = (b & 0xF0) == 0xE0
+    is4 = (b & 0xF8) == 0xF0
+    is_start = is1 | is2 | is3 | is4
+
+    def nxt(k):
+        rolled = jnp.roll(b, -k, axis=1)
+        # bytes rolled in from the row start are out of range anyway
+        return jnp.where(j + k < pad, rolled, 0) & 0x3F
+
+    cp = jnp.where(
+        is1,
+        b,
+        jnp.where(
+            is2,
+            ((b & 0x1F) << 6) | nxt(1),
+            ((b & 0x0F) << 12) | (nxt(1) << 6) | nxt(2),
+        ),
+    )
+    table = jnp.asarray(_bmp_case_table(upper).astype(np.int32))
+    mapped = table[jnp.clip(cp, 0, 0xFFFF)]
+
+    # forward-fill each byte with its character's start position, then
+    # gather that start's mapped codepoint + length class
+    start_pos = jax.lax.cummax(
+        jnp.where(is_start, j, -1), axis=1
+    )
+    safe = jnp.clip(start_pos, 0, pad - 1)
+    my_mapped = jnp.take_along_axis(mapped, safe, axis=1)
+    my_len = jnp.take_along_axis(
+        jnp.where(is1, 1, jnp.where(is2, 2, jnp.where(is3, 3, 4))),
+        safe,
+        axis=1,
+    )
+    k = j - safe  # byte offset within the character
+
+    out = jnp.where(
+        my_len == 1,
+        my_mapped,
+        jnp.where(
+            my_len == 2,
+            jnp.where(
+                k == 0,
+                0xC0 | (my_mapped >> 6),
+                0x80 | (my_mapped & 0x3F),
+            ),
+            jnp.where(
+                my_len == 3,
+                jnp.where(
+                    k == 0,
+                    0xE0 | (my_mapped >> 12),
+                    jnp.where(
+                        k == 1,
+                        0x80 | ((my_mapped >> 6) & 0x3F),
+                        0x80 | (my_mapped & 0x3F),
+                    ),
+                ),
+                b,  # 4-byte chars pass through
+            ),
+        ),
+    )
+    # malformed leads (start_pos == -1 prefix) and padding keep original
+    out = jnp.where((start_pos >= 0) & in_str, out, mat)
+    return Column(
+        out.astype(jnp.uint8), dt.STRING, col.validity, col.lengths
+    )
+
+
+def utf8_upper(col: Column) -> Column:
+    """UTF-8 uppercase (cudf ``strings::to_upper`` device scope)."""
+    return _case_map_utf8(col, True)
+
+
+def utf8_lower(col: Column) -> Column:
+    """UTF-8 lowercase."""
+    return _case_map_utf8(col, False)
