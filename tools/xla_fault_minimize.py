@@ -1,0 +1,150 @@
+"""Automated minimizer for the fused-join TPU fault (XLA_BUG_REPORT.md).
+
+Runs each graph variant in its OWN subprocess (a worker crash poisons
+the whole PJRT client, so in-process bisection is impossible) and
+appends a results table to the bug report. Designed to run unattended
+when the flaky tunnel is up:
+
+    python tools/xla_fault_minimize.py            # full matrix
+    python tools/xla_fault_minimize.py --variant single_word 32000000
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+VARIANTS = {
+    # name -> python source run in a fresh process; prints PASS total
+    "full": """
+lw, rw = words()
+perm = jnp.lexsort([jnp.ones_like(rw), rw][::-1])
+sw = [jnp.ones_like(rw)[perm], rw[perm]]
+qw = [jnp.ones_like(lw), lw]
+lo = _lex_searchsorted(sw, qw, "left")
+hi = _lex_searchsorted(sw, qw, "right")
+out = jnp.where(jnp.ones_like(lw, dtype=bool), hi - lo, 0).sum()
+""",
+    "single_word": """
+lw, rw = words()
+srt = jax.lax.sort((rw,), num_keys=1)[0]
+lo = _lex_searchsorted([srt], [lw], "left")
+hi = _lex_searchsorted([srt], [lw], "right")
+out = (hi - lo).sum()
+""",
+    "one_search": """
+lw, rw = words()
+perm = jnp.lexsort([jnp.ones_like(rw), rw][::-1])
+sw = [jnp.ones_like(rw)[perm], rw[perm]]
+qw = [jnp.ones_like(lw), lw]
+lo = _lex_searchsorted(sw, qw, "left")
+out = lo.sum()
+""",
+    "jnp_searchsorted": """
+lw, rw = words()
+srt = jax.lax.sort((rw,), num_keys=1)[0]
+lo = jnp.searchsorted(srt, lw, side="left")
+hi = jnp.searchsorted(srt, lw, side="right")
+out = (hi - lo).sum()
+""",
+    "no_perm_gather": """
+lw, rw = words()
+srt = jax.lax.sort((jnp.ones_like(rw), rw), num_keys=2)[1]
+lo = _lex_searchsorted([jnp.ones_like(srt), srt],
+                       [jnp.ones_like(lw), lw], "left")
+hi = _lex_searchsorted([jnp.ones_like(srt), srt],
+                       [jnp.ones_like(lw), lw], "right")
+out = (hi - lo).sum()
+""",
+}
+
+_TEMPLATE = """
+import spark_rapids_jni_tpu  # x64 on before arrays exist
+import jax, jax.numpy as jnp, numpy as np
+from spark_rapids_jni_tpu.ops.join import _lex_searchsorted
+
+n = {n}
+def words():
+    rng = np.random.default_rng(11)
+    sign = jnp.uint64(0x8000000000000000)
+    kl = jnp.asarray(rng.integers(0, n, n, dtype=np.int64))
+    kr = jnp.asarray(rng.integers(0, n, n, dtype=np.int64))
+    return kl.astype(jnp.uint64) ^ sign, kr.astype(jnp.uint64) ^ sign
+
+def graph():
+{body}
+    return out
+
+val = jax.jit(graph)()
+print("PASS", int(np.asarray(val.ravel()[-1:])[0]))
+"""
+
+
+def run_variant(name: str, n: int, timeout_s: int = 900) -> dict:
+    body = "\n".join(
+        "    " + line for line in VARIANTS[name].strip().splitlines()
+    )
+    code = _TEMPLATE.format(n=n, body=body)
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+        status = (
+            "pass"
+            if out.returncode == 0 and "PASS" in out.stdout
+            else "CRASH"
+        )
+        detail = (out.stderr or "")[-200:] if status == "CRASH" else ""
+    except subprocess.TimeoutExpired:
+        status, detail = "timeout", ""
+    return {
+        "variant": name, "n": n, "status": status,
+        "seconds": round(time.time() - t0, 1), "detail": detail,
+    }
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--variant":
+        print(json.dumps(run_variant(sys.argv[2], int(sys.argv[3]))))
+        return
+    results = []
+    # the matrix: each variant at the faulting size, then a threshold
+    # bisection on whichever smallest variant still crashes
+    for name in VARIANTS:
+        r = run_variant(name, 32_000_000)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    crashing = [r["variant"] for r in results if r["status"] == "CRASH"]
+    if crashing:
+        name = crashing[-1]  # most-minimized crashing variant
+        lo, hi = 16_000_000, 32_000_000
+        while hi - lo > 2_000_000:
+            mid = (lo + hi) // 2
+            r = run_variant(name, mid)
+            print(json.dumps(r), flush=True)
+            results.append(r)
+            if r["status"] == "CRASH":
+                hi = mid
+            else:
+                lo = mid
+    with open(__file__.replace(
+        "xla_fault_minimize.py", "XLA_BUG_REPORT.md"
+    ), "a") as f:
+        f.write(
+            "\n## Automated minimize run "
+            + time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+            + "\n\n| variant | n | status | s |\n|---|---|---|---|\n"
+        )
+        for r in results:
+            f.write(
+                f"| {r['variant']} | {r['n']} | {r['status']} "
+                f"| {r['seconds']} |\n"
+            )
+
+
+if __name__ == "__main__":
+    main()
