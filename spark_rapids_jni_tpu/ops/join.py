@@ -578,6 +578,40 @@ def inner_join_batched(
     from .copying import concatenate, slice_rows
 
     right_on = right_on or on
+    pieces = list(
+        inner_join_batches(left, right, on, right_on, probe_rows)
+    )
+    if not pieces:
+        # empty output with the exact join schema — no build-side sort
+        z = jnp.zeros((0,), jnp.int32)
+        return _join_output(
+            slice_rows(left, 0, 0), right, right_on, z, z,
+            jnp.zeros((0,), jnp.bool_), jnp.zeros((0,), jnp.bool_),
+        )
+    return concatenate(pieces) if len(pieces) > 1 else pieces[0]
+
+
+def inner_join_batches(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+    probe_rows: Optional[int] = None,
+):
+    """Streaming inner join: yields one result Table per probe chunk
+    instead of concatenating them — the Spark operator model (plans
+    consume ``Iterator[ColumnarBatch]``), and the bounded-memory output
+    path: at no point is more than one chunk's output resident beyond
+    what the consumer retains, so a join whose FULL output exceeds HBM
+    can still stream through a downstream aggregation.
+
+    Same safety properties as :func:`inner_join_batched` (fault-fenced
+    probe sizes, HBM-planned chunks, skew re-splitting)."""
+    from collections import deque
+
+    from .copying import slice_rows
+
+    right_on = right_on or on
     out_row_bytes = None
     if probe_rows is None:
         # size the chunk from the HBM budget (round-4 VERDICT item 7:
@@ -606,18 +640,8 @@ def inner_join_batched(
     if probe_rows <= 0:
         raise ValueError(f"probe_rows must be positive, got {probe_rows}")
     n = left.row_count
-
-    def empty_result():
-        # empty output with the exact join schema — no build-side sort
-        z = jnp.zeros((0,), jnp.int32)
-        return _join_output(
-            slice_rows(left, 0, 0), right, right_on, z, z,
-            jnp.zeros((0,), jnp.bool_), jnp.zeros((0,), jnp.bool_),
-        )
-
     if n == 0 or right.row_count == 0:
-        return empty_result()
-
+        return
     # two jitted stages per chunk (NOT eager op-by-op: each eager
     # dispatch pays a full host<->device round trip — ~100s at 32M over
     # the tunnel). The jitted helpers are cached at module level keyed
@@ -632,18 +656,12 @@ def inner_join_batched(
         from ..utils import hbm
 
         out_row_bytes = hbm.row_bytes(left) + hbm.row_bytes(right)
-    # a chunk whose matched output would dwarf what the planner budgeted
-    # (heavy key skew) re-splits instead of materializing — fan-out is
-    # data-dependent, so output fit is enforced here, not assumed
     chunk_out_budget = max(
         probe_rows * 2 * out_row_bytes, MIN_CHUNK_OUT_BYTES
     )
-    from collections import deque
-
     spans = deque(
         (s, min(s + probe_rows, n)) for s in range(0, n, probe_rows)
     )
-    pieces = []
     while spans:
         start, stop = spans.popleft()
         chunk = slice_rows(left, start, stop)
@@ -651,7 +669,7 @@ def inner_join_batched(
         total = int(total_dev)
         if total == 0:
             continue
-        cap = max(32, 1 << (total - 1).bit_length())  # pow2 bucket
+        cap = max(32, 1 << (total - 1).bit_length())
         if cap * out_row_bytes > chunk_out_budget and stop - start > 1024:
             mid = (start + stop) // 2
             spans.appendleft((mid, stop))
@@ -660,10 +678,7 @@ def inner_join_batched(
         padded = _batched_materialize_fn(ron_key, cap)(
             perm_r, lo, counts, chunk, right
         )
-        pieces.append(slice_rows(padded, 0, total))
-    if not pieces:
-        return empty_result()
-    return concatenate(pieces) if len(pieces) > 1 else pieces[0]
+        yield slice_rows(padded, 0, total)
 
 
 def left_join(
