@@ -161,3 +161,41 @@ def test_streaming_join_batches_match_batched(monkeypatch):
 def test_streaming_join_empty_sides():
     left, right = _tables(n_left=10, n_right=0)
     assert list(join_mod.inner_join_batches(left, right, ["k"])) == []
+
+
+def test_batched_string_join_mismatched_pads():
+    """String keys with different pad widths between sides must still
+    match through the chunk-probed path (pre-r4 this returned 0 rows:
+    positional word compare silently truncated to the narrower side)."""
+    lvals = ["apple", "pear", "fig", "apple"]
+    rvals = ["apple", "a-very-long-string-key", "fig"]
+    left = Table(
+        [Column.from_strings(lvals),
+         Column.from_numpy(np.arange(4, dtype=np.int64))],
+        ["k", "lv"],
+    )
+    right = Table(
+        [Column.from_strings(rvals),
+         Column.from_numpy(np.arange(3, dtype=np.int64))],
+        ["k", "rv"],
+    )
+    assert left["k"].data.shape[1] != right["k"].data.shape[1]
+    direct = join_mod.inner_join(left, right, ["k"])
+    batched = join_mod.inner_join_batched(
+        left, right, ["k"], probe_rows=2
+    )
+    assert batched.row_count == direct.row_count == 3
+
+    def rows(t):
+        return sorted(
+            zip(
+                t["k"].to_pylist(),
+                np.asarray(t["lv"].to_numpy()).tolist(),
+                np.asarray(t["rv"].to_numpy()).tolist(),
+            )
+        )
+
+    assert rows(batched) == rows(direct)
+    # the eager chunked-ranges path (outer joins, counts) too
+    got = int(join_mod.inner_join_count(left, right, ["k"]))
+    assert got == 3
