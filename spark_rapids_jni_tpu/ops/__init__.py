@@ -23,9 +23,14 @@ from .gather import gather_table, gather_column
 from .sort import sort_table, argsort_table, SortKey, is_sorted, merge_sorted
 from .hashing import murmur3_column, murmur3_table
 from .groupby import groupby_aggregate, GroupbyAgg
+from .groupby_chunked import (
+    groupby_aggregate_chunked,
+    groupby_aggregate_capped_chunked,
+)
 from .join import (
     inner_join,
     inner_join_batched,
+    inner_join_batches,
     left_join,
     left_join_capped,
     left_join_count,
@@ -127,6 +132,9 @@ __all__ = [
     "murmur3_column",
     "murmur3_table",
     "groupby_aggregate",
+    "groupby_aggregate_chunked",
+    "groupby_aggregate_capped_chunked",
+    "inner_join_batches",
     "GroupbyAgg",
     "inner_join",
     "inner_join_batched",
