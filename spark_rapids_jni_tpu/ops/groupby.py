@@ -8,7 +8,16 @@ like Spark/cudf.
 
 Two forms (see ops/__init__ docstring): ``groupby_aggregate`` host-syncs
 the group count; ``groupby_aggregate_capped`` is fully jittable with
-``num_segments`` as the static capacity.
+``num_segments`` as the static capacity. Large decomposable
+aggregations route through the two-level chunked design
+(ops/groupby_chunked.py).
+
+Design note — string keys are NOT auto-dictionary-encoded here (unlike
+joins, ops/join.py): encoding costs a full-width sort of its own, the
+very pass this groupby already performs once, so for a one-shot
+aggregation it can only add work. Joins amortize the encode across the
+build sort plus 2·log(m) binary-search passes, where one int32 word vs
+pad/8+1 words pays for itself.
 """
 
 from __future__ import annotations

@@ -149,9 +149,13 @@ def groupby_aggregate_capped_chunked(
     than that would have truncated groups, so callers must check (the
     eager wrapper does; bench asserts it).
     """
+    # must mirror groupby_aggregate_capped's output naming exactly
+    # (unnamed tables name keys by POSITION, f"key{i}", not column index)
     key_names = [
-        c if isinstance(c, str) else (table.names[c] if table.names else f"key{c}")
-        for c in by
+        c
+        if isinstance(c, str)
+        else (table.names[c] if table.names else f"key{i}")
+        for i, c in enumerate(by)
     ]
     p1_aggs, plan = _phase1_plan(table, by, aggs)
 

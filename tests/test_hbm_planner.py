@@ -146,3 +146,30 @@ def test_sort_and_groupby_plans_report_fit():
 
     with mock.patch.object(hbm, "table_bytes", return_value=100_000_000 * 24):
         assert not hbm.sort_plan(fake, n_key_words=2)["fits"]
+
+
+def test_distributed_recv_capacity_warns_over_budget(monkeypatch):
+    """r3 weak item 6: capacity plans must check HBM fit. A tiny forced
+    budget makes the planned receive buffer 'exceed' the chip and the
+    exchange must warn (real chips would OOM mid-collective)."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.parallel import distributed as dist
+
+    t = Table(
+        [Column.from_numpy(np.arange(64, dtype=np.int64)),
+         Column.from_numpy(np.arange(64, dtype=np.int64))],
+        ["k", "v"],
+    )
+    config.set_flag("HBM_BUDGET_GB", 1e-9)  # ~1 byte budget
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        dist._warn_if_recv_exceeds_hbm(64, t, "groupby")
+    assert any("receive capacity" in str(x.message) for x in w)
+    config.clear_flag("HBM_BUDGET_GB")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        dist._warn_if_recv_exceeds_hbm(64, t, "groupby")
+    assert not w
