@@ -179,7 +179,9 @@ def _decompress(codec: str, buf: bytes, uncompressed_size: int) -> bytes:
 def read_chunk_pages(f, colmeta) -> list[_Page]:
     """Walk one column chunk's raw bytes into decompressed pages."""
     offsets = [colmeta.data_page_offset]
-    if colmeta.dictionary_page_offset is not None:
+    # truthiness also rejects 0: no page can start at the PAR1 magic,
+    # and some writers surface "no dictionary" as 0 rather than None
+    if colmeta.dictionary_page_offset:
         offsets.append(colmeta.dictionary_page_offset)
     start = min(offsets)
     f.seek(start)

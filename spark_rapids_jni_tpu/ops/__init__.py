@@ -10,6 +10,15 @@ mirroring the reference's two-phase 2GB batching discipline
 * eager APIs that host-sync the exact size (the cudf/JNI call model), and
 * ``*_capped`` jittable variants with caller-fixed capacity + a device
   row count, for whole-query fusion under jit/shard_map.
+
+Two scale disciplines sit above the per-op level (round 4):
+
+* ``*_chunked`` / ``*_batches`` forms split giant inputs into
+  VMEM-/fault-sized pieces automatically (groupby_chunked.py, the
+  join's chunk-probed paths) — the batching the reference applies at
+  INT_MAX bytes, applied at TPU limits; and
+* the HBM footprint planner (utils/hbm.py) sizes those pieces from a
+  per-chip budget instead of constants.
 """
 
 from . import compute, keys
