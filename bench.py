@@ -526,6 +526,49 @@ def bench_parquet_pipeline(platform, n_groups=4, rows_per_group=1_500_000):
     }
 
 
+def bench_chunk_sort_ab(platform, total_rows=16_777_216, t=8192):
+    """Pallas VMEM bitonic sort vs XLA batched lax.sort on the chunked-
+    groupby phase-1 shape — the measurement that decides whether the
+    chunked design's 'batched small sorts stay in VMEM' bet needs the
+    explicit kernel (kernels/bitonic_sort.py) or XLA already delivers."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.kernels.bitonic_sort import batched_sort_u64
+
+    c = total_rows // t
+    rng = np.random.default_rng(29)
+    key = jnp.asarray(rng.integers(0, 1 << 40, (c, t)).astype(np.uint64))
+    val = jnp.asarray(rng.integers(-1000, 1000, (c, t)))
+    jax.block_until_ready(key)
+
+    def xla_sort(k, v):
+        iota = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (c, t))
+        return jax.lax.sort((k, iota, v), num_keys=1, is_stable=True)
+
+    xla_fn = jax.jit(xla_sort)
+    med_x, mn_x, std_x, out_x = _timeit(xla_fn, [(key, val)], reps_per_input=3)
+
+    # Mosaic on the chip; the interpreter tier only exists so a CPU
+    # smoke of this config runs the same code (its timing is meaningless)
+    interp = platform == "cpu"
+    pl_fn = jax.jit(
+        lambda k, v: batched_sort_u64(k, v, interpret=interp)
+    )
+    med_p, mn_p, std_p, out_p = _timeit(pl_fn, [(key, val)], reps_per_input=3)
+    # equality spot check on one chunk
+    assert np.array_equal(
+        np.asarray(out_x[0][0]), np.asarray(out_p[0][0])
+    ), "pallas sort diverges from lax.sort"
+    bytes_moved = total_rows * 20 * 2
+    e1 = _entry("chunk-sort", f"lax_sort_{c}x{t}", total_rows, med_x,
+                mn_x, std_x, bytes_moved, platform)
+    e2 = _entry("chunk-sort", f"pallas_bitonic_{c}x{t}", total_rows,
+                med_p, mn_p, std_p, bytes_moved, platform)
+    e2["vs_lax"] = round(med_x / med_p, 2)
+    return [e1, e2]
+
+
 def bench_strings(platform, n=10_000_000, pad=128):
     """Round-4 VERDICT item 5 bench: literal contains at pad=128 via the
     shift-or scan, and a 10M x 10M string-key join through automatic
@@ -773,6 +816,7 @@ _SUBPROCESS_CONFIGS = {
     "join_batched": bench_join_batched,
     "sort": bench_sort,
     "sort_gather": bench_sort_gather,
+    "chunk_sort_ab": bench_chunk_sort_ab,
     "strings": bench_strings,
     "resident": bench_resident_chain,
     "parquet": bench_parquet_pipeline,
@@ -785,8 +829,8 @@ _SUBPROCESS_CONFIGS = {
 # configs land before the multi-minute 100M uploads; the headline
 # chunked-groupby A/B runs as soon as the cheap tier is banked.
 _LADDER = (
-    "groupby1m", "groupby16m_chunked", "groupby16m", "strings",
-    "transpose", "resident", "parquet", "parquet_device",
+    "groupby1m", "groupby16m_chunked", "groupby16m", "chunk_sort_ab",
+    "strings", "transpose", "resident", "parquet", "parquet_device",
     "groupby100m_chunked", "groupby100m", "sort", "sort_gather",
     "join_batched", "tpcds",
 )

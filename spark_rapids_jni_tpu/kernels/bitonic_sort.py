@@ -1,0 +1,168 @@
+"""Pallas TPU kernel: batched VMEM-resident bitonic sort of key chunks.
+
+The chunked groupby (ops/groupby_chunked.py) turns one n-row sort into
+C independent T-row sorts, betting that XLA's batched ``lax.sort``
+keeps each small sort VMEM-resident. This kernel removes the bet: each
+grid step sorts ONE chunk entirely inside VMEM with an unrolled bitonic
+network — compare-exchange partners reached by ``pltpu.roll`` (partner
+``i XOR j`` is ``i+j`` for the low element and ``i-j`` for the high
+one, so two circular shifts plus a parity select cover every pair), the
+TPU translation of the shared-memory tiled sorts GPU libraries use.
+
+Mosaic constraints shape the interface (same discipline as
+row_transpose.py's "no Mosaic i64 paths"): 64-bit keys and payloads are
+split into u32 (hi, lo) halves OUTSIDE the kernel (free bitcasts under
+XLA) and compared lexicographically inside. A per-row index rides as
+the final tiebreaker, making the network deterministic and
+order-stable for equal keys despite bitonic's inherent instability.
+
+Used today as a measured A/B against ``jax.lax.sort`` on the chunk
+shapes (bench config ``chunk_sort_ab``); flips on as the groupby
+phase-1 engine only if the chip says it wins (r4 measurement pending —
+tunnel outage; see BASELINE.md round-4 status).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import default_interpret
+
+
+def _check_pow2(t: int) -> None:
+    if t & (t - 1) or t < 2:
+        raise ValueError(f"chunk length must be a power of two, got {t}")
+
+
+def _kernel(n_payload: int, t: int):
+    """Kernel body closure: refs = [hi, lo] keys + n_payload u32
+    payloads, each (1, T); same layout out."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    def body(*refs):
+        ins = refs[: 2 + n_payload]
+        outs = refs[2 + n_payload :]
+        hi = ins[0][...]
+        lo = ins[1][...]
+        ps = [r[...] for r in ins[2:]]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
+        i = idx
+
+        ops = [hi, lo, idx] + ps
+        k = 2
+        while k <= t:
+            j = k // 2
+            while j >= 1:
+                # pltpu.roll wants non-negative shifts: a left shift by
+                # j is a right shift by t - j on the circle
+                rolled_up = [pltpu.roll(x, t - j, axis=1) for x in ops]
+                rolled_dn = [pltpu.roll(x, j, axis=1) for x in ops]
+                is_low = (i & j) == 0  # lower index of the pair
+                partner = [
+                    jnp.where(is_low, u, d)
+                    for u, d in zip(rolled_up, rolled_dn)
+                ]
+                p_hi, p_lo, p_idx = partner[0], partner[1], partner[2]
+                hi_, lo_, idx_ = ops[0], ops[1], ops[2]
+                # lexicographic (hi, lo, idx): partner strictly smaller?
+                p_lt = (
+                    (p_hi < hi_)
+                    | ((p_hi == hi_) & (p_lo < lo_))
+                    | ((p_hi == hi_) & (p_lo == lo_) & (p_idx < idx_))
+                )
+                asc = (i & k) == 0  # ascending block of this stage
+                keep_min = is_low == asc
+                take_partner = jnp.where(keep_min, p_lt, ~p_lt)
+                ops = [
+                    jnp.where(take_partner, pv, xv)
+                    for pv, xv in zip(partner, ops)
+                ]
+                j //= 2
+            k *= 2
+
+        outs[0][...] = ops[0]
+        outs[1][...] = ops[1]
+        for r, v in zip(outs[2:], [ops[2]] + ops[3:]):
+            r[...] = v
+
+    return body
+
+
+@functools.lru_cache(maxsize=64)
+def _sort_call(n_payload: int, t: int, interpret: bool):
+    spec = pl.BlockSpec((1, t), lambda c: (c, 0))
+    n_ops = 2 + n_payload
+
+    def fn(*arrays):
+        c = arrays[0].shape[0]
+        out_shapes = [
+            jax.ShapeDtypeStruct((c, t), jnp.uint32) for _ in range(2)
+        ] + [jax.ShapeDtypeStruct((c, t), jnp.int32)] + [
+            jax.ShapeDtypeStruct((c, t), jnp.uint32)
+            for _ in range(n_payload)
+        ]
+        return pl.pallas_call(
+            _kernel(n_payload, t),
+            grid=(c,),
+            in_specs=[spec] * n_ops,
+            out_specs=[spec] * (n_ops + 1),  # +1: the permutation index
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(*arrays)
+
+    return jax.jit(fn)
+
+
+def batched_sort_u64(
+    key: jax.Array, *payloads: jax.Array, interpret: bool | None = None
+):
+    """Sort each row of ``key`` (C, T) u64 ascending, carrying payloads.
+
+    Returns ``(sorted_key, perm int32, *sorted_payloads)`` where perm is
+    the within-chunk source index (the iota that rode the network — the
+    same contract as carrying an iota operand through ``lax.sort``).
+    Equal keys keep their original relative order (index tiebreaker).
+    Payloads may be u64/i64 (split into u32 halves around the kernel)
+    or <=32-bit (widened)."""
+    if interpret is None:
+        interpret = default_interpret()
+    c, t = key.shape
+    _check_pow2(t)
+    hi = (key >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = key.astype(jnp.uint32)
+
+    split = []
+    wide = []
+    for p in payloads:
+        if p.dtype.itemsize == 8:
+            pb = jax.lax.bitcast_convert_type(p, jnp.uint64)
+            split.append((pb >> jnp.uint64(32)).astype(jnp.uint32))
+            split.append(pb.astype(jnp.uint32))
+            wide.append(True)
+        else:
+            split.append(p.astype(jnp.uint32))
+            wide.append(False)
+
+    out = _sort_call(len(split), t, bool(interpret))(hi, lo, *split)
+    s_hi, s_lo, perm = out[0], out[1], out[2]
+    s_key = (s_hi.astype(jnp.uint64) << jnp.uint64(32)) | s_lo.astype(
+        jnp.uint64
+    )
+    outp = []
+    k = 3
+    for p, w in zip(payloads, wide):
+        if w:
+            v = (
+                out[k].astype(jnp.uint64) << jnp.uint64(32)
+            ) | out[k + 1].astype(jnp.uint64)
+            outp.append(jax.lax.bitcast_convert_type(v, p.dtype))
+            k += 2
+        else:
+            outp.append(out[k].astype(p.dtype))
+            k += 1
+    return (s_key, perm, *outp)

@@ -1,0 +1,56 @@
+"""Pallas batched bitonic sort (kernels/bitonic_sort.py) vs lax.sort.
+
+Runs in interpreter mode on the CPU tier (the kernels package
+convention); the TPU A/B lives in bench.py (``chunk_sort_ab``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.kernels.bitonic_sort import batched_sort_u64
+
+
+def _ref_sort(key, *payloads):
+    """Oracle: stable variadic lax.sort with an iota tiebreaker."""
+    c, t = key.shape
+    iota = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (c, t))
+    out = jax.lax.sort((key, iota) + payloads, num_keys=1, is_stable=True)
+    return out[0], out[1], *out[2:]
+
+
+@pytest.mark.parametrize("t", [8, 64, 256])
+def test_matches_stable_lax_sort(t):
+    rng = np.random.default_rng(7)
+    c = 5
+    key = jnp.asarray(
+        rng.integers(0, 50, (c, t)).astype(np.uint64)  # many duplicates
+    )
+    v64 = jnp.asarray(rng.integers(-(2**60), 2**60, (c, t)))
+    v32 = jnp.asarray(rng.integers(0, 2, (c, t)).astype(np.int32))
+    got_k, got_p, got_v64, got_v32 = batched_sort_u64(key, v64, v32)
+    ref_k, ref_p, ref_v64, ref_v32 = _ref_sort(key, v64, v32)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
+    # index tiebreaker == stability: full permutation must agree
+    np.testing.assert_array_equal(np.asarray(got_p), np.asarray(ref_p))
+    np.testing.assert_array_equal(np.asarray(got_v64), np.asarray(ref_v64))
+    np.testing.assert_array_equal(np.asarray(got_v32), np.asarray(ref_v32))
+
+
+def test_extreme_u64_keys():
+    key = jnp.asarray(
+        np.array(
+            [[0, 2**64 - 1, 2**63, 1, 2**32, 2**32 - 1, 5, 2**63 - 1]],
+            dtype=np.uint64,
+        )
+    )
+    got_k, got_p = batched_sort_u64(key)[:2]
+    np.testing.assert_array_equal(
+        np.asarray(got_k)[0], np.sort(np.asarray(key)[0])
+    )
+
+
+def test_rejects_non_pow2():
+    key = jnp.zeros((2, 12), jnp.uint64)
+    with pytest.raises(ValueError):
+        batched_sort_u64(key)
