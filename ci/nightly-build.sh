@@ -22,4 +22,7 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
   python3 -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
 
 # Nightly bench record (BENCH_nightly.json artifact).
-python3 bench.py | tee BENCH_nightly.json
+# bench.py re-prints its headline line after every config (kill-proof);
+# the artifact is the LAST parseable line, kept as a single JSON doc
+python3 bench.py | tee BENCH_nightly.jsonl
+tail -n 1 BENCH_nightly.jsonl > BENCH_nightly.json

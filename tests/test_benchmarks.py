@@ -168,3 +168,50 @@ def test_q64_distributed_matches(tables, mesh):
     for k in s:
         assert d[k][1] == s[k][1]
         assert d[k][0] == pytest.approx(s[k][0], rel=1e-6)
+
+
+def test_bench_main_emits_parseable_line_when_unreachable(monkeypatch, tmp_path):
+    """Round-4 postmortem regression: a dead tunnel + an immediate kill
+    must still leave a parseable headline line (r4 published nothing
+    because main() printed only once, at the very end)."""
+    import contextlib
+    import io
+    import json as json_mod
+
+    import bench
+
+    monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: False)
+    monkeypatch.setattr(bench, "_stop_daemon", lambda: None)
+    # isolate from any real daemon state
+    monkeypatch.setattr(bench, "_STATE_PATH", str(tmp_path / "state.json"))
+    monkeypatch.setenv("SRT_BENCH_DEADLINE_S", "-1")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    lines = buf.getvalue().strip().splitlines()
+    assert len(lines) >= 2  # one up-front + one after the ladder walk
+    for line in lines:
+        doc = json_mod.loads(line)
+        assert doc["metric"] == "groupby_sum_100M_int64"
+    last = json_mod.loads(lines[-1])
+    assert last["headline_source"].startswith("published_round")
+    assert {e["name"] for e in last["configs"]} == set(bench._LADDER)
+
+
+def test_bench_emit_daemon_provenance(monkeypatch, capsys):
+    """A daemon-state 100M entry must not masquerade as a this-run
+    measurement: headline_source carries its capture timestamp."""
+    import json as json_mod
+
+    import bench
+
+    entry = {
+        "name": "groupby_sum_100M_chunked",
+        "seconds_median": 0.5,
+        "source": "daemon_retry_loop",
+        "measured_at": "2026-07-30T12:00:00Z",
+    }
+    bench._emit([entry], "tpu")
+    doc = json_mod.loads(capsys.readouterr().out.strip())
+    assert doc["headline_source"] == "daemon_retry_loop(2026-07-30T12:00:00Z)"
+    assert doc["value"] == pytest.approx(2e8)
