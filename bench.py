@@ -598,22 +598,30 @@ def bench_strings(platform, n=10_000_000, pad=128):
         std, n * pad, platform,
     )
 
-    # string-key join: 100k distinct 12-byte keys (byte matrix built
-    # host-side in numpy; 10M python strings would dominate the setup)
+    # string-key join: nj distinct 12-byte keys, each side drawing nj
+    # rows from them, so the expected output is ~nj rows (~1 match/row).
+    # The previous 100k-unique pool made E[matches] ~ nj^2/100k ~ 1e9
+    # rows — a 30-50 GB materialization that would OOM the 16 GiB chip
+    # (ADVICE r4, medium). Byte matrix built vectorized host-side: 10M
+    # python strings would dominate the setup.
     nj = n
     klen = 12
-    uniq = np.zeros((100_000, klen), np.uint8)
-    for i in range(100_000):
-        uniq[i] = np.frombuffer(
-            ("k" + format(i, "011d")).encode(), np.uint8
-        )
+
+    def key_matrix(ids):
+        m = np.empty((ids.size, klen), np.uint8)
+        m[:, 0] = ord("k")
+        x = ids.astype(np.int64)
+        for j in range(klen - 1, 0, -1):
+            m[:, j] = ord("0") + (x % 10)
+            x //= 10
+        return m
 
     def str_table(idx, name):
-        m = uniq[idx]
         return Table(
             [
                 Column(
-                    jax.numpy.asarray(m), dt_mod.STRING, None,
+                    jax.numpy.asarray(key_matrix(idx)), dt_mod.STRING,
+                    None,
                     jax.numpy.full((nj,), klen, jax.numpy.int32),
                 ),
                 Column.from_numpy(np.arange(nj, dtype=np.int64)),
@@ -621,8 +629,8 @@ def bench_strings(platform, n=10_000_000, pad=128):
             ["k", name],
         )
 
-    lt = str_table(rng.integers(0, 100_000, nj), "lv")
-    rt = str_table(rng.integers(0, 100_000, nj), "rv")
+    lt = str_table(rng.integers(0, nj, nj), "lv")
+    rt = str_table(rng.integers(0, nj, nj), "rv")
     jax.block_until_ready(lt.columns[0].data)
     t0 = time.perf_counter()
     out = inner_join(lt, rt, ["k"])
@@ -630,7 +638,11 @@ def bench_strings(platform, n=10_000_000, pad=128):
     join_s = time.perf_counter() - t0
     e2 = {
         "config": "strings",
-        "name": f"string_key_join_{nj // 1_000_000}Mx{nj // 1_000_000}M",
+        # uniques pool in the name: changing it changes E[matches]
+        "name": (
+            f"string_key_join_{nj // 1_000_000}Mx{nj // 1_000_000}M"
+            f"_u{nj // 1_000_000}M"
+        ),
         "rows": 2 * nj,
         "seconds_median": round(join_s, 4),
         "matches": out.row_count,
@@ -1087,13 +1099,19 @@ def _emit(entries, platform, arrow_rows_per_s=None):
     else:
         rows_per_s = vs = float("nan")
         source = "none"
+
+    def _num(x, nd):
+        # null, not NaN: json.dumps would emit the bare token `NaN`,
+        # which strict parsers (jq, JSON.parse) reject
+        return round(x, nd) if x == x else None
+
     print(
         json.dumps(
             {
                 "metric": "groupby_sum_100M_int64",
-                "value": round(rows_per_s, 1),
+                "value": _num(rows_per_s, 1),
                 "unit": "rows/s",
-                "vs_baseline": round(vs, 3),
+                "vs_baseline": _num(vs, 3),
                 "platform": platform,
                 "headline_source": source,
                 "configs": entries,

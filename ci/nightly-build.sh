@@ -23,6 +23,22 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 
 # Nightly bench record (BENCH_nightly.json artifact).
 # bench.py re-prints its headline line after every config (kill-proof);
-# the artifact is the LAST parseable line, kept as a single JSON doc
-python3 bench.py | tee BENCH_nightly.jsonl
-tail -n 1 BENCH_nightly.jsonl > BENCH_nightly.json
+# the artifact is the LAST PARSEABLE line, kept as a single JSON doc.
+# `|| true`: a bench killed mid-run must still publish the lines it
+# flushed (the very scenario the re-emit design exists to survive).
+python3 bench.py | tee BENCH_nightly.jsonl || true
+python3 - <<'PYEOF'
+import json
+last = None
+with open("BENCH_nightly.jsonl") as f:
+    for line in f:
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # e.g. a final line truncated by the kill
+        last = doc
+if last is None:
+    raise SystemExit("no parseable bench line")
+with open("BENCH_nightly.json", "w") as f:
+    json.dump(last, f)
+PYEOF

@@ -144,7 +144,19 @@ def batched_sort_u64(
             split.append((pb >> jnp.uint64(32)).astype(jnp.uint32))
             split.append(pb.astype(jnp.uint32))
             wide.append(True)
+        elif p.dtype.itemsize == 4:
+            # bitcast, not astype: a value cast truncates float32
+            # payloads (1.5 -> 1) where the 8-byte path bit-preserves
+            split.append(jax.lax.bitcast_convert_type(p, jnp.uint32))
+            wide.append(False)
         else:
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                raise TypeError(
+                    f"narrow float payload {p.dtype} would lose bits "
+                    "through the u32 widening; cast it to float32 first"
+                )
+            # integer widen/narrow round-trips exactly (two's complement
+            # wrap on the way back)
             split.append(p.astype(jnp.uint32))
             wide.append(False)
 
@@ -162,6 +174,9 @@ def batched_sort_u64(
             ) | out[k + 1].astype(jnp.uint64)
             outp.append(jax.lax.bitcast_convert_type(v, p.dtype))
             k += 2
+        elif p.dtype.itemsize == 4:
+            outp.append(jax.lax.bitcast_convert_type(out[k], p.dtype))
+            k += 1
         else:
             outp.append(out[k].astype(p.dtype))
             k += 1

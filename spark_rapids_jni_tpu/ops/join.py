@@ -143,8 +143,13 @@ def _equalize_string_key_pads(left, right, left_on, right_on):
     left_cols = list(left.columns)
     right_cols = list(right.columns)
     for lc, rc, lref, rref in zip(lcols, rcols, left_on, right_on):
-        if not (lc.dtype.is_string and rc.dtype.is_string):
+        if not (lc.dtype.is_string or rc.dtype.is_string):
             continue
+        if not (lc.dtype.is_string and rc.dtype.is_string):
+            # same rejection as _maybe_encode_string_keys: a silent skip
+            # here would let _lex_searchsorted's positional zip truncate
+            # the word comparison and return wrong matches
+            raise TypeError("join key dtypes differ: STRING vs non-STRING")
         common = max(lc.data.shape[1], rc.data.shape[1])
         li = _resolve_col(left, lref)
         ri = _resolve_col(right, rref)
@@ -646,11 +651,12 @@ def inner_join_batches(
     can still stream through a downstream aggregation.
 
     Same safety properties as :func:`inner_join_batched` (fault-fenced
-    probe sizes, HBM-planned chunks, skew re-splitting)."""
-    from collections import deque
+    probe sizes, HBM-planned chunks, skew re-splitting).
 
-    from .copying import slice_rows
-
+    Argument validation and the HBM-budget warning fire HERE, at call
+    time — not on first iteration of the returned generator — so a
+    caller that builds the iterator and defers consumption still gets
+    errors at the faulty call site."""
     right_on = right_on or on
     out_row_bytes = None
     if probe_rows is None:
@@ -679,10 +685,24 @@ def inner_join_batches(
         out_row_bytes = plan["output_row_bytes"]
     if probe_rows <= 0:
         raise ValueError(f"probe_rows must be positive, got {probe_rows}")
+    # key-dtype validation is also eager (raises TypeError on mixed
+    # STRING/non-STRING pairs before any work is enqueued)
+    left, right = _equalize_string_key_pads(left, right, on, right_on)
+    return _inner_join_batches_gen(
+        left, right, on, right_on, probe_rows, out_row_bytes
+    )
+
+
+def _inner_join_batches_gen(
+    left, right, on, right_on, probe_rows, out_row_bytes
+):
+    from collections import deque
+
+    from .copying import slice_rows
+
     n = left.row_count
     if n == 0 or right.row_count == 0:
         return
-    left, right = _equalize_string_key_pads(left, right, on, right_on)
     # two jitted stages per chunk (NOT eager op-by-op: each eager
     # dispatch pays a full host<->device round trip — ~100s at 32M over
     # the tunnel). The jitted helpers are cached at module level keyed

@@ -54,3 +54,36 @@ def test_rejects_non_pow2():
     key = jnp.zeros((2, 12), jnp.uint64)
     with pytest.raises(ValueError):
         batched_sort_u64(key)
+
+
+def test_float32_payload_bit_preserved():
+    """ADVICE r4: 4-byte payloads must ride as bits, not values — the
+    old astype widening truncated float32 (1.5 -> 1.0)."""
+    rng = np.random.default_rng(5)
+    key = jnp.asarray(rng.integers(0, 1 << 40, (2, 16)).astype(np.uint64))
+    pay = jnp.asarray(rng.standard_normal((2, 16)).astype(np.float32))
+    sk, perm, sp = batched_sort_u64(key, pay, interpret=True)
+    rk, rp, rpay = _ref_sort(key, pay)
+    np.testing.assert_array_equal(np.asarray(sk), np.asarray(rk))
+    np.testing.assert_array_equal(np.asarray(sp), np.asarray(rpay))
+
+
+def test_narrow_float_payload_rejected():
+    key = jnp.zeros((1, 8), jnp.uint64)
+    pay = jnp.zeros((1, 8), jnp.float16)
+    with pytest.raises(TypeError, match="narrow float payload"):
+        batched_sort_u64(key, pay, interpret=True)
+
+
+def test_int16_payload_round_trips():
+    rng = np.random.default_rng(6)
+    key = jnp.asarray(rng.integers(0, 1 << 20, (2, 16)).astype(np.uint64))
+    pay = jnp.asarray(
+        rng.integers(-(1 << 15), 1 << 15, (2, 16), dtype=np.int64)
+        .astype(np.int16)
+    )
+    sk, perm, sp = batched_sort_u64(key, pay, interpret=True)
+    rk, rp, rpay = _ref_sort(key, pay.astype(jnp.int32))
+    np.testing.assert_array_equal(
+        np.asarray(sp), np.asarray(rpay).astype(np.int16)
+    )

@@ -199,3 +199,33 @@ def test_batched_string_join_mismatched_pads():
     # the eager chunked-ranges path (outer joins, counts) too
     got = int(join_mod.inner_join_count(left, right, ["k"]))
     assert got == 3
+
+
+def test_mixed_key_dtypes_rejected_both_paths():
+    """ADVICE r4: the chunked eager path must reject STRING vs
+    non-STRING key pairs like the fused path does, not silently zip-
+    truncate the word comparison."""
+    from spark_rapids_jni_tpu import dtype as dt
+    import jax.numpy as jnp
+
+    smat = jnp.asarray(
+        np.frombuffer(b"abcdefgh", np.uint8).reshape(2, 4)
+    )
+    str_t = Table(
+        [Column(smat, dt.STRING, None, jnp.full((2,), 4, jnp.int32))],
+        ["k"],
+    )
+    int_t = Table(
+        [Column.from_numpy(np.array([1, 2], dtype=np.int64))], ["k"]
+    )
+    with pytest.raises(TypeError, match="STRING vs non-STRING"):
+        join_mod._equalize_string_key_pads(str_t, int_t, ["k"], ["k"])
+    with pytest.raises(TypeError, match="STRING vs non-STRING"):
+        # generator wrapper: must raise at CALL time, not first next()
+        join_mod.inner_join_batches(str_t, int_t, ["k"], probe_rows=8)
+
+
+def test_inner_join_batches_validates_at_call_time(fenced):
+    left, right = _tables()
+    with pytest.raises(ValueError, match="probe_rows"):
+        join_mod.inner_join_batches(left, right, [0], probe_rows=0)
