@@ -79,6 +79,7 @@ from . import window
 from .window import (
     rolling_aggregate,
     grouped_rolling_aggregate,
+    grouped_range_rolling_aggregate,
     lead,
     lag,
     row_number,
@@ -186,6 +187,7 @@ __all__ = [
     "window",
     "rolling_aggregate",
     "grouped_rolling_aggregate",
+    "grouped_range_rolling_aggregate",
     "lead",
     "lag",
     "row_number",

@@ -157,6 +157,15 @@ def rolling_aggregate(
         else jnp.full((n,), n, jnp.int32)
     )
     start, end = _window_bounds(n, preceding, following, ps, pe)
+    return _frame_aggregate(col, start, end, agg, min_periods)
+
+
+def _frame_aggregate(
+    col: Column, start, end, agg: str, min_periods: int
+) -> Column:
+    """Aggregate per-row frames [start, end) — the shared back half of
+    the ROW and RANGE window paths (the frame *shape* is the only thing
+    that differs between them)."""
     valid = compute.valid_mask(col)
 
     if agg in _SUMLIKE:
@@ -199,10 +208,165 @@ def rolling_aggregate(
     raise ValueError(f"unknown window aggregation {agg!r}")
 
 
-def _partition_bounds(table: Table, partition_by: Sequence):
-    """(starts, ends) per row for a table sorted by the partition keys."""
+def _saturating_offset(vals: jax.Array, delta) -> jax.Array:
+    """``vals + delta`` with integer wrap-around clamped to the dtype
+    extreme (floats saturate to +-inf on their own). The RANGE frame of
+    a row near INT64_MAX must be "everything from here up", not wrap to
+    the bottom of the partition.
+
+    ``delta`` stays a Python int throughout: casting it to the column
+    dtype would raise under numpy>=2 for e.g. a negative delta on a
+    uint64 column or an out-of-range delta on INT8."""
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        return vals + vals.dtype.type(delta)
+    delta = int(delta)
+    if delta == 0:
+        return vals
+    info = jnp.iinfo(vals.dtype)
+    if vals.dtype.itemsize < 8:
+        # widen: int64 holds any narrow dtype plus a clamped delta
+        d = max(min(delta, 1 << 62), -(1 << 62))
+        out = jnp.clip(vals.astype(jnp.int64) + d, info.min, info.max)
+        return out.astype(vals.dtype)
+    # 8-byte lanes have no wider integer to widen into: walk the offset
+    # in quarter-steps that each fit BOTH int64 and uint64, detecting
+    # wrap after each step (a saturated lane keeps wrapping and is
+    # re-pinned every step, so saturation is sticky).
+    mag = min(abs(delta), 1 << 64)  # >= full dtype span: total saturation
+    sign = 1 if delta > 0 else -1
+    out = vals
+    while mag:
+        q = min(mag, 1 << 62)
+        mag -= q
+        step = vals.dtype.type(q)
+        if sign > 0:
+            nxt = out + step
+            out = jnp.where(nxt < out, info.max, nxt)
+        else:
+            nxt = out - step
+            out = jnp.where(nxt > out, info.min, nxt)
+    return out
+
+
+def grouped_range_rolling_aggregate(
+    table: Table,
+    partition_by: Sequence,
+    order_by: Union[int, str],
+    value: Union[int, str],
+    preceding,
+    following,
+    agg: str,
+    min_periods: int = 1,
+    ascending: bool = True,
+) -> Column:
+    """RANGE-framed rolling window (libcudf grouped_range_rolling_window
+    / Spark ``RANGE BETWEEN x PRECEDING AND y FOLLOWING``), result in
+    the table's ORIGINAL row order.
+
+    Row i's frame holds every partition row j whose ORDER BY value lies
+    within ``[v_i - preceding, v_i + following]`` (ascending; descending
+    frames span ``[v_i - following, v_i + preceding]``) — peers with
+    equal order values always share a frame, the defining difference
+    from ROW frames. ``preceding=None`` / ``following=None`` mean
+    UNBOUNDED PRECEDING/FOLLOWING. Exactly one ORDER BY column; bounds
+    are in the column's storage units (ticks for timestamps, unscaled
+    for decimals). NULL order rows form one peer frame per partition
+    (the SQL null-peers rule).
+
+    TPU formulation: no per-row scans — on the (partition, order)-sorted
+    layout each frame end is a vectorized lexicographic binary search of
+    ``(partition_run, null_word, order_key(v_i -/+ bound))`` against the
+    rows' own sort words (the join-probe machinery,
+    ops/join._lex_searchsorted), so frame discovery is O(n log n) with
+    static shapes, and aggregation reuses the shared prefix-sum /
+    sparse-table kernels. Everything jits. Contrast: cudf walks each row
+    outward with type-dispatched comparators
+    (grouped_rolling .cu kernels); a binary search over normalized u64
+    words is the shape XLA tiles well."""
+    from .gather import gather_column
+    from .join import _lex_searchsorted
+    from .sort import SortKey
+
     n = table.row_count
-    new_part = _change_boundaries(table, partition_by)
+    okey = SortKey(order_by, ascending=ascending)
+    sorted_t, starts, ends, inv, idx, new_part = _window_scaffold(
+        table, partition_by, [okey]
+    )
+
+    oc = sorted_t.column(order_by)
+    okeys = column_order_keys(oc)
+    if len(okeys) != 1:
+        raise TypeError(
+            "range frames need a fixed-width ORDER BY column "
+            f"(got {oc.dtype})"
+        )
+    ovalid = compute.valid_mask(oc)
+    vals = compute.values(oc)
+
+    # The words the rows are actually ordered by, reduced to three:
+    # partition run id (equal pid <=> equal partition keys), the sort's
+    # null-placement word, and the (direction-adjusted) order key.
+    pid = jnp.cumsum(new_part.astype(jnp.int64)).astype(jnp.uint64)
+    if okey.resolved_nulls_first:
+        null_word = jnp.where(ovalid, jnp.uint64(1), jnp.uint64(0))
+    else:
+        null_word = jnp.where(ovalid, jnp.uint64(0), jnp.uint64(1))
+    kw = okeys[0] if ascending else ~okeys[0]
+    # zero the key word under nulls: the three-word view must be
+    # non-decreasing in the sorted layout no matter how the sort
+    # tie-broke the null run internally, and a null query then brackets
+    # its whole peer run with the same zero word
+    kw = jnp.where(ovalid, kw, jnp.uint64(0))
+    sorted_words = [pid, null_word, kw]
+
+    def shifted_key(delta):
+        if delta is None:
+            return None
+        shifted = _saturating_offset(vals, delta)
+        col = Column(
+            compute.encode_values(shifted, oc.dtype), oc.dtype, None
+        )
+        k = column_order_keys(col)[0]
+        return k if ascending else ~k
+
+    # ascending: frame = keys in [ok(v-pre), ok(v+fol)]
+    # descending: layout orders by ~ok, frame = values in
+    #   [v-fol, v+pre] -> ~ok in [~ok(v+pre), ~ok(v-fol)]
+    if ascending:
+        lo_kw = shifted_key(-preceding if preceding is not None else None)
+        hi_kw = shifted_key(following if following is not None else None)
+    else:
+        lo_kw = shifted_key(preceding if preceding is not None else None)
+        hi_kw = shifted_key(-following if following is not None else None)
+
+    zero = jnp.zeros((n,), jnp.uint64)
+    if lo_kw is None:
+        start = starts
+    else:
+        # null rows bracket their own peer run (key word zero, like the
+        # sorted view) instead of applying value arithmetic to garbage
+        q = [pid, null_word, jnp.where(ovalid, lo_kw, zero)]
+        start = _lex_searchsorted(sorted_words, q, "left")
+    if hi_kw is None:
+        end = ends
+    else:
+        q = [pid, null_word, jnp.where(ovalid, hi_kw, zero)]
+        end = _lex_searchsorted(sorted_words, q, "right")
+    start = jnp.clip(start, starts, ends)
+    end = jnp.clip(end, start, ends)
+
+    out_sorted = _frame_aggregate(
+        sorted_t.column(value), start, end, agg, min_periods
+    )
+    return gather_column(out_sorted, inv)
+
+
+def _partition_bounds(table: Table, partition_by: Sequence, new_part=None):
+    """(starts, ends) per row for a table sorted by the partition keys.
+    Pass ``new_part`` when the boundary vector is already computed."""
+    n = table.row_count
+    if new_part is None:
+        new_part = _change_boundaries(table, partition_by)
     idx = jnp.arange(n, dtype=jnp.int32)
     starts = jax.lax.cummax(jnp.where(new_part, idx, 0))
     # ends: next partition start (reverse cummin of starts-after)
@@ -288,7 +452,7 @@ def row_number(
     order (Spark ROW_NUMBER)."""
     from .gather import gather_column
 
-    _, starts, _, inv, idx = _window_scaffold(
+    _, starts, _, inv, idx, _ = _window_scaffold(
         table, partition_by, order_by
     )
     rn_sorted = idx - starts + 1
@@ -320,27 +484,37 @@ def _change_boundaries(table: Table, keys: Sequence) -> jnp.ndarray:
 
 
 def _window_scaffold(table: Table, partition_by, order_by):
-    """Shared sort scaffolding for the ranking family: the table sorted
-    by (partition, order) keys, per-row partition [start, end), and the
-    inverse permutation back to the original row order."""
+    """Shared sort scaffolding for the ranking + range-frame families:
+    the table sorted by (partition, order) keys, per-row partition
+    [start, end), the inverse permutation back to the original row
+    order, and the partition-boundary vector (computed once; both the
+    bounds and the range path's partition-run ids derive from it).
+    Entries of ``order_by`` may be plain column refs or SortKey."""
     from .gather import gather_table
     from .sort import SortKey, argsort_table
 
     n = table.row_count
-    sort_keys = [SortKey(k) for k in [*partition_by, *order_by]]
+    sort_keys = [
+        k if isinstance(k, SortKey) else SortKey(k)
+        for k in [*partition_by, *order_by]
+    ]
     perm = argsort_table(table, sort_keys)
     sorted_t = gather_table(table, perm)
-    starts, ends = _partition_bounds(sorted_t, partition_by)
+    part_refs = [
+        k.column if isinstance(k, SortKey) else k for k in partition_by
+    ]
+    new_part = _change_boundaries(sorted_t, part_refs)
+    starts, ends = _partition_bounds(sorted_t, part_refs, new_part)
     idx = jnp.arange(n, dtype=jnp.int32)
     inv = jnp.zeros((n,), jnp.int32).at[perm].set(idx)
-    return sorted_t, starts, ends, inv, idx
+    return sorted_t, starts, ends, inv, idx, new_part
 
 
 def _rank_sorted(table: Table, partition_by, order_by, kind: str):
     """Shared rank machinery: returns the rank vector in sorted order
     plus the inverse permutation back to table order."""
     n = table.row_count
-    sorted_t, starts, ends, inv, idx = _window_scaffold(
+    sorted_t, starts, ends, inv, idx, _ = _window_scaffold(
         table, partition_by, order_by
     )
     # tie boundary: any (partition + order) key run changes — the
@@ -414,7 +588,7 @@ def ntile(
 
     if n_tiles <= 0:
         raise ValueError("ntile: n_tiles must be positive")
-    _, starts, ends, inv, idx = _window_scaffold(
+    _, starts, ends, inv, idx, _ = _window_scaffold(
         table, partition_by, order_by
     )
     pos = idx - starts  # 0-based position within partition

@@ -293,3 +293,243 @@ class TestRankFamily:
         # nulls sort first (ascending default): both get rank 1
         assert r.tolist() == [1, 1, 3]
         assert d.tolist() == [1, 1, 2]
+
+
+def _oracle_range_window(
+    parts, order, ovalid, values, vvalid, preceding, following, agg,
+    min_periods=1, ascending=True,
+):
+    """O(n^2) reference: for each row, scan its partition and test the
+    ORDER BY value against [v-pre, v+fol] (asc) / [v-fol, v+pre] (desc);
+    NULL order rows frame exactly their partition's null peers."""
+    n = len(order)
+    out = []
+    for i in range(n):
+        frame = []
+        for j in range(n):
+            if parts[j] != parts[i]:
+                continue
+            nulls_first = ascending  # Spark's default null placement
+            if not ovalid[i] and not ovalid[j]:
+                hit = True
+            elif not ovalid[i]:
+                # valid j sits after the null run when nulls are first:
+                # only a positional UNBOUNDED bound reaches it
+                hit = (
+                    following is None if nulls_first else preceding is None
+                )
+            elif not ovalid[j]:
+                hit = (
+                    preceding is None if nulls_first else following is None
+                )
+            else:
+                # the low VALUE edge comes from preceding when ascending
+                # but from following when descending (and vice versa)
+                lo_b = preceding if ascending else following
+                hi_b = following if ascending else preceding
+                lo = -float("inf") if lo_b is None else order[i] - lo_b
+                hi = float("inf") if hi_b is None else order[i] + hi_b
+                hit = lo <= order[j] <= hi
+            if hit and vvalid[j]:
+                frame.append(values[j])
+        if len(frame) < min_periods or not frame:
+            out.append(None)
+        elif agg == "sum":
+            out.append(sum(frame))
+        elif agg == "count":
+            out.append(len(frame))
+        elif agg == "mean":
+            out.append(sum(frame) / len(frame))
+        elif agg == "min":
+            out.append(min(frame))
+        elif agg == "max":
+            out.append(max(frame))
+    return out
+
+
+class TestRangeFrames:
+    def _table(self, n=96, seed=7, float_order=False):
+        rng = np.random.default_rng(seed)
+        parts = rng.integers(0, 5, n).astype(np.int64)
+        if float_order:
+            order = np.round(rng.standard_normal(n) * 10, 2)
+        else:
+            order = rng.integers(-30, 30, n).astype(np.int64)
+        ovalid = rng.random(n) > 0.15
+        vals = rng.integers(-50, 50, n).astype(np.int64)
+        vvalid = rng.random(n) > 0.2
+        t = Table(
+            [
+                Column.from_numpy(parts),
+                Column.from_numpy(order, validity=ovalid),
+                Column.from_numpy(vals, validity=vvalid),
+            ],
+            ["p", "o", "v"],
+        )
+        return t, parts, order, ovalid, vals, vvalid
+
+    @pytest.mark.parametrize("agg", ["sum", "count", "mean", "min", "max"])
+    def test_vs_oracle(self, agg):
+        t, parts, order, ovalid, vals, vvalid = self._table()
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", 5, 3, agg
+        ).to_pylist()
+        want = _oracle_range_window(
+            parts, order, ovalid, vals, vvalid, 5, 3, agg
+        )
+        if agg == "mean":
+            for g, w in zip(got, want):
+                assert (g is None) == (w is None)
+                if g is not None:
+                    assert g == pytest.approx(w)
+        else:
+            assert got == want
+
+    @pytest.mark.parametrize(
+        "pre,fol", [(None, 0), (0, None), (None, None), (2, 0), (0, 2)]
+    )
+    def test_unbounded_and_current(self, pre, fol):
+        t, parts, order, ovalid, vals, vvalid = self._table(seed=11)
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", pre, fol, "sum"
+        ).to_pylist()
+        want = _oracle_range_window(
+            parts, order, ovalid, vals, vvalid, pre, fol, "sum"
+        )
+        assert got == want
+
+    def test_descending(self):
+        t, parts, order, ovalid, vals, vvalid = self._table(seed=13)
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", 4, 2, "sum", ascending=False
+        ).to_pylist()
+        want = _oracle_range_window(
+            parts, order, ovalid, vals, vvalid, 4, 2, "sum",
+            ascending=False,
+        )
+        assert got == want
+
+    def test_float_order_column(self):
+        t, parts, order, ovalid, vals, vvalid = self._table(
+            seed=17, float_order=True
+        )
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", 5.0, 5.0, "count"
+        ).to_pylist()
+        want = _oracle_range_window(
+            parts, order, ovalid, vals, vvalid, 5.0, 5.0, "count"
+        )
+        assert got == want
+
+    def test_peers_share_frames(self):
+        # duplicate order values: every peer must see the same frame —
+        # the defining RANGE-vs-ROWS difference
+        t = Table(
+            [
+                Column.from_numpy(np.zeros(6, np.int64)),
+                Column.from_numpy(np.array([1, 1, 1, 2, 2, 9], np.int64)),
+                Column.from_numpy(np.array([1, 2, 4, 8, 16, 32], np.int64)),
+            ],
+            ["p", "o", "v"],
+        )
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", 0, 0, "sum"
+        ).to_pylist()
+        assert got == [7, 7, 7, 24, 24, 32]
+
+    def test_saturation_at_int64_extremes(self):
+        big = np.iinfo(np.int64).max
+        t = Table(
+            [
+                Column.from_numpy(np.zeros(3, np.int64)),
+                Column.from_numpy(
+                    np.array([big - 1, big, -big], np.int64)
+                ),
+                Column.from_numpy(np.array([1, 2, 4], np.int64)),
+            ],
+            ["p", "o", "v"],
+        )
+        # +following must clamp at INT64_MAX, not wrap below -big
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", 0, 5, "sum"
+        ).to_pylist()
+        assert got == [3, 2, 4]
+
+    def test_no_partition(self):
+        t, parts, order, ovalid, vals, vvalid = self._table(seed=19)
+        got = ops.grouped_range_rolling_aggregate(
+            t, [], "o", "v", 3, 3, "sum"
+        ).to_pylist()
+        want = _oracle_range_window(
+            np.zeros_like(parts), order, ovalid, vals, vvalid, 3, 3,
+            "sum",
+        )
+        assert got == want
+
+    def test_string_order_rejected(self):
+        t = Table(
+            [
+                Column.from_numpy(np.zeros(2, np.int64)),
+                Column.from_numpy(np.array([1, 2], np.int64)),
+            ],
+            ["p", "v"],
+        )
+        import jax.numpy as jnp
+
+        smat = jnp.asarray(np.zeros((2, 4), np.uint8))
+        st = Table(
+            [
+                t.columns[0],
+                Column(smat, dt.STRING, None, jnp.full((2,), 4, jnp.int32)),
+                t.columns[1],
+            ],
+            ["p", "s", "v"],
+        )
+        with pytest.raises(TypeError, match="fixed-width"):
+            ops.grouped_range_rolling_aggregate(
+                st, ["p"], "s", "v", 1, 1, "sum"
+            )
+
+    @pytest.mark.parametrize("pre,fol", [(None, 1), (1, None)])
+    def test_unbounded_descending(self, pre, fol):
+        t, parts, order, ovalid, vals, vvalid = self._table(seed=23)
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", pre, fol, "sum", ascending=False
+        ).to_pylist()
+        want = _oracle_range_window(
+            parts, order, ovalid, vals, vvalid, pre, fol, "sum",
+            ascending=False,
+        )
+        assert got == want
+
+    def test_unsigned_order_column(self):
+        # numpy>=2 regression: a negative delta must never be cast to
+        # the (unsigned) order dtype
+        t = Table(
+            [
+                Column.from_numpy(np.zeros(4, np.int64)),
+                Column.from_numpy(
+                    np.array([1, 3, 4, 2**64 - 1], np.uint64)
+                ),
+                Column.from_numpy(np.array([1, 2, 4, 8], np.int64)),
+            ],
+            ["p", "o", "v"],
+        )
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", 2, 0, "sum"
+        ).to_pylist()
+        assert got == [1, 3, 6, 8]
+
+    def test_narrow_order_with_out_of_range_bound(self):
+        t = Table(
+            [
+                Column.from_numpy(np.zeros(3, np.int64)),
+                Column.from_numpy(np.array([-100, 0, 100], np.int8)),
+                Column.from_numpy(np.array([1, 2, 4], np.int64)),
+            ],
+            ["p", "o", "v"],
+        )
+        got = ops.grouped_range_rolling_aggregate(
+            t, ["p"], "o", "v", 300, 0, "sum"
+        ).to_pylist()
+        assert got == [1, 3, 7]
