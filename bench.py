@@ -1022,6 +1022,18 @@ def daemon(deadline_s: float, probe_every_s: float = 300.0) -> None:
             pass
 
 
+def _tunnel_log(level: str, msg: str, **fields) -> None:
+    """Tunnel events on the observability plane (utils/log.py `tunnel`
+    channel, gated by SPARK_RAPIDS_TPU_LOG_LEVEL) — lazy import so the
+    bench stays runnable from a checkout without the package installed."""
+    try:
+        from spark_rapids_jni_tpu.utils import log as _srt_log
+
+        _srt_log.log(level, "tunnel", msg, **fields)
+    except Exception:
+        pass
+
+
 def _probe_device(timeout_s: int = 150) -> bool:
     """Cheap liveness check: the axon tunnel sometimes hangs jax.devices()
     forever — probe in a killable subprocess before paying per-config
@@ -1034,8 +1046,15 @@ def _probe_device(timeout_s: int = 150) -> bool:
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s,
         )
-        return out.returncode == 0 and bool(out.stdout.strip())
+        up = out.returncode == 0 and bool(out.stdout.strip())
+        _tunnel_log(
+            "INFO" if up else "WARN",
+            "probe_up" if up else "probe_failed",
+            rc=out.returncode,
+        )
+        return up
     except subprocess.TimeoutExpired:
+        _tunnel_log("WARN", "probe_timeout", timeout_s=timeout_s)
         return False
 
 

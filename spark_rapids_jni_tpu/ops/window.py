@@ -390,15 +390,11 @@ def grouped_rolling_aggregate(
 ) -> Column:
     """PARTITION BY + ORDER BY rolling window; result aligned to the
     table's ORIGINAL row order (Spark WindowExec contract)."""
-    from .sort import SortKey, argsort_table
+    from .gather import gather_column
 
-    n = table.row_count
-    keys = [SortKey(k) for k in [*partition_by, *order_by]]
-    perm = argsort_table(table, keys)
-    from .gather import gather_table
-
-    sorted_t = gather_table(table, perm)
-    starts, ends = _partition_bounds(sorted_t, partition_by)
+    sorted_t, starts, ends, inv, _, _ = _window_scaffold(
+        table, partition_by, order_by
+    )
     out_sorted = rolling_aggregate(
         sorted_t.column(value),
         preceding,
@@ -408,10 +404,6 @@ def grouped_rolling_aggregate(
         partition_starts=starts,
         partition_ends=ends,
     )
-    # scatter back to original order
-    inv = jnp.zeros((n,), jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))
-    from .gather import gather_column
-
     return gather_column(out_sorted, inv)
 
 

@@ -61,6 +61,13 @@ def _warn_if_recv_exceeds_hbm(cap: int, table: Table, label: str) -> None:
 
     est = 2 * cap * hbm.row_bytes(table)  # shard + sort working copy
     budget = hbm.budget_bytes()
+    from ..utils import log as srt_log
+
+    srt_log.log(
+        "INFO", "hbm", "recv_buffer_plan", label=label,
+        estimated_bytes=int(est), budget_bytes=int(budget),
+        fits=bool(est <= budget),
+    )
     if est > budget:
         import warnings
 

@@ -38,6 +38,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 
 from . import dtype as dt
 from .column import Column, Table
+from .utils import log
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -385,6 +386,9 @@ def _resident_put(t: Table) -> int:
     tid = next(_NEXT_TABLE_ID)
     with _RESIDENT_LOCK:
         _RESIDENT[tid] = t
+        live = len(_RESIDENT)
+    log.log("DEBUG", "handles", "resident_put", table_id=tid,
+            rows=int(t.row_count), live=live)
     return tid
 
 
@@ -437,8 +441,11 @@ def table_num_rows(table_id: int) -> int:
 def table_free(table_id: int) -> None:
     with _RESIDENT_LOCK:
         gone = _RESIDENT.pop(int(table_id), None) is None
+        live = len(_RESIDENT)
     if gone:
         raise KeyError(f"unknown device table id {table_id}")
+    log.log("DEBUG", "handles", "table_free", table_id=int(table_id),
+            live=live)
 
 
 def resident_table_count() -> int:

@@ -55,7 +55,16 @@ _FLAGS = {
     for f in [
         Flag("TRACE", False, _as_bool, "profiler trace annotations"),
         Flag("REFCOUNT_DEBUG", False, _as_bool, "buffer leak tracking"),
-        Flag("ALLOC_LOG_LEVEL", "OFF", str.upper, "allocation log level"),
+        Flag(
+            "LOG_LEVEL", "OFF", str.upper,
+            "runtime observability level (OFF|ERROR|WARN|INFO|DEBUG|"
+            "TRACE) for every utils/log.py channel",
+        ),
+        Flag(
+            "ALLOC_LOG_LEVEL", "OFF", str.upper,
+            "allocation log level; overrides LOG_LEVEL for the "
+            "hbm/handles channels (RMM_LOGGING_LEVEL analog)",
+        ),
         Flag("DISABLE_X64", False, _as_bool, "refuse 64-bit device types"),
         Flag("TEST_PLATFORM", "cpu", str, "test backend (cpu|axon)"),
         Flag("NATIVE_LIB", "", str, "explicit native library path"),
@@ -80,6 +89,15 @@ def get_flag(name: str):
     if raw is None:
         return flag.default
     return flag.parse(raw)
+
+
+def flag_is_set(name: str) -> bool:
+    """True when the flag has an explicit value (override or env) as
+    opposed to riding its declared default — for knobs where "set to
+    the default value" and "unset" mean different things (e.g.
+    ALLOC_LOG_LEVEL=OFF silences its channels; unset defers)."""
+    flag = _FLAGS[name]
+    return name in _overrides or flag.env_var in os.environ
 
 
 def set_flag(name: str, value) -> None:

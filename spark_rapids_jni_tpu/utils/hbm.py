@@ -24,6 +24,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from . import config
+from . import log
 
 GIB = 1 << 30
 
@@ -134,7 +135,7 @@ def join_plan(
     )
     avail = budget - fixed
     probe_rows = max(1024, avail // max(per_probe_row, 1))
-    return {
+    plan = {
         "budget_bytes": budget,
         "fixed_bytes": int(fixed),
         "per_probe_row_bytes": int(per_probe_row),
@@ -142,6 +143,8 @@ def join_plan(
         "probe_rows": int(probe_rows),
         "fits": avail > 0,
     }
+    log.log("INFO", "hbm", "join_plan", **plan)
+    return plan
 
 
 def sort_plan(table, n_key_words: int, platform: Optional[str] = None) -> dict:
@@ -150,11 +153,13 @@ def sort_plan(table, n_key_words: int, platform: Optional[str] = None) -> dict:
     n = table.row_count
     operand = n_key_words * 8 * n + 4 * n + table_bytes(table)
     total = 2 * operand
-    return {
+    plan = {
         "budget_bytes": budget_bytes(platform),
         "total_bytes": int(total),
         "fits": total <= budget_bytes(platform),
     }
+    log.log("INFO", "hbm", "sort_plan", rows=n, **plan)
+    return plan
 
 
 def groupby_plan(
@@ -171,8 +176,11 @@ def groupby_plan(
     sort_bytes = 2 * (words * 8 * n + 4 * n + table_bytes(table))
     seg_bytes = num_segments * (8 + 2 * 4) + num_segments * row_bytes(table)
     total = sort_bytes + seg_bytes
-    return {
+    plan = {
         "budget_bytes": budget_bytes(platform),
         "total_bytes": int(total),
         "fits": total <= budget_bytes(platform),
     }
+    log.log("INFO", "hbm", "groupby_plan", rows=n, segments=num_segments,
+            **plan)
+    return plan

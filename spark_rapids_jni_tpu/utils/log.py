@@ -1,0 +1,78 @@
+"""Runtime observability — the ``RMM_LOGGING_LEVEL`` role (reference
+``pom.xml:82``) redesigned for an XLA-owned runtime.
+
+The reference surfaces allocator internals because RMM owns every device
+byte; here XLA/PJRT owns allocation, so the observable planes are the
+ones THIS runtime owns: the ante-hoc HBM footprint planner's
+plan-vs-budget decisions (``utils/hbm.py``), live resident-table /
+native-handle counts (``runtime_bridge.py``, the leak-report analog),
+and tunnel probe/retry events (``bench.py`` daemon).
+
+One knob gates everything::
+
+    SPARK_RAPIDS_TPU_LOG_LEVEL = OFF|ERROR|WARN|INFO|DEBUG|TRACE
+
+``SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL`` (the direct RMM_LOGGING_LEVEL
+analog, declared since round 3) overrides the level for the
+allocation-ish channels (``hbm``, ``handles``) specifically, so a user
+can trace memory planning without drowning in tunnel chatter.
+
+Format: one line per event to stderr::
+
+    [srt][<channel>][<LEVEL>] <msg> key=value ...
+
+Lines go to stderr unbuffered so they interleave correctly with XLA's
+own logging and never corrupt stdout protocols (bench JSON, wire dumps).
+"""
+
+from __future__ import annotations
+
+import sys
+
+LEVELS = {
+    "OFF": 0,
+    "ERROR": 1,
+    "WARN": 2,
+    "INFO": 3,
+    "DEBUG": 4,
+    "TRACE": 5,
+}
+
+_ALLOC_CHANNELS = frozenset({"hbm", "handles"})
+
+
+def _resolve_level(channel: str) -> int:
+    from . import config
+
+    if channel in _ALLOC_CHANNELS and config.flag_is_set(
+        "ALLOC_LOG_LEVEL"
+    ):
+        alloc = str(config.get_flag("ALLOC_LOG_LEVEL")).upper()
+        if alloc in LEVELS:
+            # an explicitly SET value overrides in both directions:
+            # ALLOC_LOG_LEVEL=OFF really silences hbm/handles even
+            # under LOG_LEVEL=DEBUG
+            return LEVELS[alloc]
+        # invalid value: fall back to LOG_LEVEL rather than silently
+        # killing the channel
+    return LEVELS.get(str(config.get_flag("LOG_LEVEL")).upper(), 0)
+
+
+def enabled(level: str, channel: str = "general") -> bool:
+    """True when an event at ``level`` on ``channel`` would print —
+    callers guard expensive field construction with this."""
+    return LEVELS.get(level, 0) <= _resolve_level(channel) and LEVELS.get(
+        level, 0
+    ) > 0
+
+
+def log(level: str, channel: str, msg: str, **fields) -> None:
+    """Emit one observability line if the channel's level admits it."""
+    if not enabled(level, channel):
+        return
+    suffix = "".join(f" {k}={v}" for k, v in fields.items())
+    print(
+        f"[srt][{channel}][{level}] {msg}{suffix}",
+        file=sys.stderr,
+        flush=True,
+    )

@@ -1,0 +1,100 @@
+"""The runtime observability plane (utils/log.py): the
+RMM_LOGGING_LEVEL role (reference pom.xml:82) — HBM plan decisions,
+live handle counts, level gating."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.utils import config, hbm, log
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags(monkeypatch):
+    # pin a known baseline: an exported SPARK_RAPIDS_TPU_*LOG_LEVEL in
+    # the developer's shell must not flip these assertions
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_LOG_LEVEL", raising=False)
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL", raising=False)
+    yield
+    config.clear_flag("LOG_LEVEL")
+    config.clear_flag("ALLOC_LOG_LEVEL")
+
+
+def _table(n=64):
+    return Table(
+        [
+            Column.from_numpy(np.arange(n, dtype=np.int64)),
+            Column.from_numpy(np.arange(n, dtype=np.int64)),
+        ],
+        ["k", "v"],
+    )
+
+
+def test_silent_by_default(capsys):
+    log.log("ERROR", "general", "should not appear")
+    hbm.join_plan(_table(), _table(), ["k"], ["k"])
+    assert "[srt]" not in capsys.readouterr().err
+
+
+def test_hbm_plan_decision_surfaces(capsys):
+    config.set_flag("LOG_LEVEL", "INFO")
+    hbm.join_plan(_table(), _table(), ["k"], ["k"])
+    err = capsys.readouterr().err
+    assert "[srt][hbm][INFO] join_plan" in err
+    assert "probe_rows=" in err and "fits=" in err
+
+
+def test_handle_counts_surface(capsys):
+    from spark_rapids_jni_tpu import runtime_bridge as rb
+
+    config.set_flag("ALLOC_LOG_LEVEL", "DEBUG")
+    tid = rb._resident_put(_table(8))
+    rb.table_free(tid)
+    err = capsys.readouterr().err
+    assert "[srt][handles][DEBUG] resident_put" in err
+    assert "[srt][handles][DEBUG] table_free" in err
+    assert "live=" in err
+
+
+def test_alloc_level_overrides_only_alloc_channels(capsys):
+    # ALLOC_LOG_LEVEL=DEBUG must open hbm/handles but leave the general
+    # channel gated by LOG_LEVEL (still OFF)
+    config.set_flag("ALLOC_LOG_LEVEL", "DEBUG")
+    log.log("INFO", "general", "general-line")
+    log.log("DEBUG", "hbm", "hbm-line")
+    err = capsys.readouterr().err
+    assert "general-line" not in err
+    assert "hbm-line" in err
+
+
+def test_level_ordering(capsys):
+    config.set_flag("LOG_LEVEL", "WARN")
+    log.log("ERROR", "tunnel", "e")
+    log.log("WARN", "tunnel", "w")
+    log.log("INFO", "tunnel", "i")
+    err = capsys.readouterr().err
+    assert "[srt][tunnel][ERROR] e" in err
+    assert "[srt][tunnel][WARN] w" in err
+    assert " i" not in err
+
+
+def test_flag_documented():
+    assert "LOG_LEVEL" in config.describe_flags()
+
+
+def test_alloc_off_silences_even_under_debug(capsys):
+    # the override works in the QUIET direction too
+    config.set_flag("LOG_LEVEL", "DEBUG")
+    config.set_flag("ALLOC_LOG_LEVEL", "OFF")
+    log.log("DEBUG", "handles", "handle-line")
+    log.log("DEBUG", "tunnel", "tunnel-line")
+    err = capsys.readouterr().err
+    assert "handle-line" not in err
+    assert "tunnel-line" in err
+
+
+def test_invalid_alloc_level_falls_back(capsys):
+    config.set_flag("LOG_LEVEL", "INFO")
+    config.set_flag("ALLOC_LOG_LEVEL", "VERBOSE")  # typo'd value
+    log.log("INFO", "hbm", "hbm-line")
+    assert "hbm-line" in capsys.readouterr().err
