@@ -705,7 +705,7 @@ def bench_parquet_device(platform, n_groups=4, rows_per_group=1_500_000):
     }
 
 
-def bench_tpcds(platform):
+def bench_tpcds(platform, scale=None):
     """Configs 4-5 with REAL data (round-4 VERDICT item 6): seeded
     Parquet star schema at SRT_TPCDS_SCALE (default SF1: 2.88M
     store_sales rows), streamed scan->join->agg q5/q23/q64 with pandas
@@ -714,7 +714,8 @@ def bench_tpcds(platform):
 
     from benchmarks import tpcds
 
-    scale = float(os.environ.get("SRT_TPCDS_SCALE", "1.0"))
+    if scale is None:
+        scale = float(os.environ.get("SRT_TPCDS_SCALE", "1.0"))
     cache = f"/tmp/srt_tpcds_sf{scale}"
     if not os.path.exists(os.path.join(cache, "store_sales.parquet")):
         _progress(f"generating TPC-DS parquet at scale {scale} -> {cache}")
@@ -834,6 +835,9 @@ _SUBPROCESS_CONFIGS = {
     "parquet": bench_parquet_pipeline,
     "parquet_device": bench_parquet_device,
     "tpcds": bench_tpcds,
+    # SF10 rung (round-4 VERDICT item 5: scale past SF1): 28.8M-row
+    # store_sales star schema, streamed q5/q23/q64 on the chip
+    "tpcds10": lambda p: bench_tpcds(p, scale=10.0),
 }
 
 # the on-chip ladder main()/the daemon walk. Order is cheap-first: the
@@ -844,7 +848,7 @@ _LADDER = (
     "groupby1m", "groupby16m_chunked", "groupby16m", "chunk_sort_ab",
     "strings", "transpose", "resident", "parquet", "parquet_device",
     "groupby100m_chunked", "groupby100m", "sort", "sort_gather",
-    "join_batched", "tpcds",
+    "join_batched", "tpcds", "tpcds10",
 )
 
 _CONFIG_TIMEOUT_S = 1800

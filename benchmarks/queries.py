@@ -131,7 +131,7 @@ def q23_distributed(tables: dict, mesh, min_count: int = 4):
         mesh,
     )
     # gather the (small) hot-item list to every chip, host-side finish
-    freq = _unpad_groupby(freq_padded, counts)
+    freq = unpad_groupby(freq_padded, counts)
     hot = ops.filter_table(
         freq,
         Column(freq["count_item_sk"].data >= min_count, dt.BOOL8, None),
@@ -280,7 +280,7 @@ def _real_mask(table: Table):
     )
 
 
-def _unpad_groupby(padded: Table, counts) -> Table:
+def unpad_groupby(padded: Table, counts) -> Table:
     """Compact the sharded padded result: keep each device's first
     count rows, drop padding groups (the _PAD_KEY key). Device-side
     filter so storage encodings (FLOAT64 bit patterns) stay intact."""
@@ -297,7 +297,7 @@ def _unpad_groupby(padded: Table, counts) -> Table:
 
 def _unpad_join(padded: Table, counts) -> Table:
     """Same shard-stacking for distributed join output."""
-    return _unpad_groupby(padded, counts)
+    return unpad_groupby(padded, counts)
 
 
 def _unpad_occupancy(sharded: Table, occ) -> Table:
@@ -309,3 +309,7 @@ def _unpad_occupancy(sharded: Table, occ) -> Table:
         None,
     )
     return ops.filter_table(sharded, mask)
+
+
+# compat alias: tests and older call sites used the private name
+_unpad_groupby = unpad_groupby

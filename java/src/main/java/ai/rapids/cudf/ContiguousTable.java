@@ -1,0 +1,153 @@
+/*
+ * A table backed by ONE contiguous buffer — the ai.rapids.cudf
+ * ContiguousTable surface the RAPIDS shuffle serializes partitions
+ * through (cudf java ContiguousTable.java: contiguous_split's output,
+ * one table view + one DeviceMemoryBuffer).
+ *
+ * TPU redesign: the contiguous single-buffer form of a table in this
+ * runtime IS the packed Spark UnsafeRow batch the row codec produces
+ * (src/cpp/row_format.cpp; format-exact to row_conversion.cu:432-456).
+ * Packing to rows and wrapping the one buffer is therefore the same
+ * operation as cudf's pack(): the shuffle writes buffer+metadata, the
+ * receiver rebuilds columns with {@link #getTable} via the from-rows
+ * codec. No bespoke serialization format exists — a ContiguousTable
+ * buffer is bit-identical to what RowConversion emits, so either end
+ * can be a plain row-conversion call.
+ */
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.HostBuffer;
+import com.nvidia.spark.rapids.jni.RowConversion;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+public final class ContiguousTable implements AutoCloseable {
+  private final int[] typeIds;
+  private final int[] scales;
+  private final long rows;
+  private HostBuffer buffer;
+
+  ContiguousTable(int[] typeIds, int[] scales, long rows,
+                  HostBuffer buffer) {
+    this.typeIds = typeIds;
+    this.scales = scales;
+    this.rows = rows;
+    this.buffer = buffer;
+  }
+
+  /**
+   * Pack host columns into one contiguous row-format buffer.
+   *
+   * @param typeIds  native dtype ids per column (the JNI wire contract)
+   * @param scales   decimal scales per column
+   * @param table    column buffers concatenated in the bridge layout
+   *                 (data buffers back to back, then per-column validity
+   *                 byte vectors — RowConversion.convertToRows contract)
+   * @param numRows  rows in every column
+   * @throws IllegalArgumentException when the packed form would exceed
+   *         one 2 GB batch — split first, like cudf contiguous_split
+   */
+  public static ContiguousTable pack(int[] typeIds, int[] scales,
+                                     HostBuffer table, long numRows) {
+    int rowSize = RowConversion.rowSize(typeIds);
+    long maxRows = RowConversion.maxRowsPerBatch(rowSize);
+    if (numRows > maxRows) {
+      throw new IllegalArgumentException(
+          "table too large for one contiguous buffer: " + numRows
+          + " rows > " + maxRows + " max; split first");
+    }
+    HostBuffer[] batches = RowConversion.convertToRows(table, typeIds,
+                                                       numRows);
+    // single batch guaranteed by the maxRows check above
+    return new ContiguousTable(typeIds.clone(), scales.clone(), numRows,
+                               batches[0]);
+  }
+
+  /** The one contiguous buffer (packed rows). Owned by this object. */
+  public HostBuffer getBuffer() {
+    if (buffer == null) {
+      throw new IllegalStateException("contiguous table already closed");
+    }
+    return buffer;
+  }
+
+  public long getRowCount() {
+    return rows;
+  }
+
+  /**
+   * Serialization header: [numCols, rows, typeIds..., scales...] as
+   * little-endian int64/int32 — what the shuffle writes next to the
+   * buffer so the receiving executor can call unpack without a schema
+   * side channel (cudf's packed_columns metadata role).
+   */
+  public ByteBuffer getMetadataDirectBuffer() {
+    ByteBuffer bb = ByteBuffer.allocateDirect(8 + 8 + typeIds.length * 8)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    bb.putLong(typeIds.length);
+    bb.putLong(rows);
+    for (int i = 0; i < typeIds.length; i++) {
+      bb.putInt(typeIds[i]);
+      bb.putInt(scales[i]);
+    }
+    bb.flip();
+    return bb;
+  }
+
+  /** Rebuild the metadata triple from {@link #getMetadataDirectBuffer}
+   * output: {numCols, rows} plus the arrays via out-params length. */
+  public static ContiguousTable unpack(ByteBuffer metadata,
+                                       HostBuffer buffer) {
+    ByteBuffer bb = metadata.duplicate().order(ByteOrder.LITTLE_ENDIAN);
+    int numCols = (int) bb.getLong();
+    long rows = bb.getLong();
+    int[] ids = new int[numCols];
+    int[] scales = new int[numCols];
+    for (int i = 0; i < numCols; i++) {
+      ids[i] = bb.getInt();
+      scales[i] = bb.getInt();
+    }
+    return new ContiguousTable(ids, scales, rows, buffer);
+  }
+
+  /**
+   * Decode the contiguous buffer back to columns (caller owns every
+   * returned vector — the cudf getTable() ownership contract).
+   */
+  public Table getTable() {
+    HostBuffer[] cols = RowConversion.convertFromRows(getBuffer(), typeIds,
+                                                      scales, rows);
+    int n = typeIds.length;
+    ColumnVector[] vecs = new ColumnVector[n];
+    try {
+      for (int i = 0; i < n; i++) {
+        DType t = DType.fromNative(typeIds[i], scales[i]);
+        vecs[i] = new ColumnVector(t, rows, cols[i], cols[n + i]);
+        cols[i] = null;
+        cols[n + i] = null;
+      }
+    } catch (RuntimeException e) {
+      for (ColumnVector v : vecs) {
+        if (v != null) {
+          v.close();
+        }
+      }
+      for (HostBuffer b : cols) {
+        if (b != null) {
+          b.close();
+        }
+      }
+      throw e;
+    }
+    return new Table(vecs);
+  }
+
+  @Override
+  public synchronized void close() {
+    if (buffer != null) {
+      buffer.close();
+      buffer = null;
+    }
+  }
+}

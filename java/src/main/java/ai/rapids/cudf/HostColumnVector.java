@@ -1,0 +1,352 @@
+/*
+ * Host-side column storage + builder — the ai.rapids.cudf
+ * HostColumnVector surface the spark-rapids plugin stages rows through
+ * before device upload (cudf java HostColumnVector.java; every
+ * row-to-columnar transition in the plugin builds one per column).
+ *
+ * TPU redesign: cudf backs this with off-heap HostMemoryBuffer because
+ * its JNI layer wants raw addresses. Here the JNI wire protocol ships
+ * byte[] into registry-backed native buffers (HostBuffer.create), so
+ * heap byte[] IS the staging representation — no off-heap lifetime to
+ * manage, no unsafe addressing, and the builder grows amortized like
+ * ArrayList. Validity is one byte per row (the wire's validity vector
+ * format, RowConversionJni.cpp wire contract), not a packed bitmask:
+ * the transpose kernels pack bits on device where the bit-weight
+ * dot-product formulation is free (kernels/row_transpose.py).
+ */
+package ai.rapids.cudf;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+
+public final class HostColumnVector implements AutoCloseable {
+  private final DType type;
+  private final long rows;
+  private final long nullCount;
+  private final byte[] data;      // fixed-width values, little-endian
+  private final byte[] valid;     // 1 byte/row; null = no nulls
+  private final int[] offsets;    // STRING: row i = data[offsets[i]..offsets[i+1])
+
+  HostColumnVector(DType type, long rows, long nullCount, byte[] data,
+                   byte[] valid, int[] offsets) {
+    this.type = type;
+    this.rows = rows;
+    this.nullCount = nullCount;
+    this.data = data;
+    this.valid = valid;
+    this.offsets = offsets;
+  }
+
+  public DType getType() {
+    return type;
+  }
+
+  public long getRowCount() {
+    return rows;
+  }
+
+  public long getNullCount() {
+    return nullCount;
+  }
+
+  public boolean hasNulls() {
+    return nullCount > 0;
+  }
+
+  public boolean isNull(long row) {
+    checkRow(row);
+    return valid != null && valid[(int) row] == 0;
+  }
+
+  private ByteBuffer dataBuf() {
+    return ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  public byte getByte(long row) {
+    checkValue(row);
+    return data[(int) row];
+  }
+
+  public boolean getBoolean(long row) {
+    checkValue(row);
+    return data[(int) row] != 0;
+  }
+
+  public short getShort(long row) {
+    checkValue(row);
+    return dataBuf().getShort((int) row * 2);
+  }
+
+  public int getInt(long row) {
+    checkValue(row);
+    return dataBuf().getInt((int) row * 4);
+  }
+
+  public long getLong(long row) {
+    checkValue(row);
+    return dataBuf().getLong((int) row * 8);
+  }
+
+  public float getFloat(long row) {
+    checkValue(row);
+    return dataBuf().getFloat((int) row * 4);
+  }
+
+  public double getDouble(long row) {
+    checkValue(row);
+    return dataBuf().getDouble((int) row * 8);
+  }
+
+  public String getJavaString(long row) {
+    checkValue(row);
+    int i = (int) row;
+    return new String(data, offsets[i], offsets[i + 1] - offsets[i],
+                      StandardCharsets.UTF_8);
+  }
+
+  /** The wire buffers of this column: data bytes as the JNI ships them. */
+  public byte[] getDataBytes() {
+    return data.clone();
+  }
+
+  /** Per-row validity byte vector, or null when the column has no nulls. */
+  public byte[] getValidityBytes() {
+    return valid == null ? null : valid.clone();
+  }
+
+  /** Upload to registry-backed native buffers ready for
+   * DeviceTable.tableOp: [0]=data, [1]=validity (null when no nulls). */
+  public com.nvidia.spark.rapids.jni.HostBuffer[] copyToDevice(String tag) {
+    com.nvidia.spark.rapids.jni.HostBuffer d =
+        com.nvidia.spark.rapids.jni.HostBuffer.create(data, tag + ".data");
+    com.nvidia.spark.rapids.jni.HostBuffer v = null;
+    if (valid != null) {
+      try {
+        v = com.nvidia.spark.rapids.jni.HostBuffer.create(valid,
+                                                          tag + ".valid");
+      } catch (RuntimeException e) {
+        d.close();
+        throw e;
+      }
+    }
+    return new com.nvidia.spark.rapids.jni.HostBuffer[] {d, v};
+  }
+
+  private void checkRow(long row) {
+    if (row < 0 || row >= rows) {
+      throw new IndexOutOfBoundsException("row " + row + " of " + rows);
+    }
+  }
+
+  private void checkValue(long row) {
+    checkRow(row);
+    if (isNull(row)) {
+      throw new IllegalStateException("row " + row + " is null");
+    }
+  }
+
+  /** Heap-backed: close is a no-op kept for cudf drop-in compatibility
+   * (plugin code try-with-resources every host vector). */
+  @Override
+  public void close() {
+  }
+
+  // ---- factories (the cudf fromXxx surface) --------------------------
+
+  public static HostColumnVector fromLongs(long... values) {
+    Builder b = builder(DType.INT64, values.length);
+    for (long v : values) {
+      b.append(v);
+    }
+    return b.build();
+  }
+
+  public static HostColumnVector fromInts(int... values) {
+    Builder b = builder(DType.INT32, values.length);
+    for (int v : values) {
+      b.append(v);
+    }
+    return b.build();
+  }
+
+  public static HostColumnVector fromDoubles(double... values) {
+    Builder b = builder(DType.FLOAT64, values.length);
+    for (double v : values) {
+      b.append(v);
+    }
+    return b.build();
+  }
+
+  public static HostColumnVector fromBoxedLongs(Long... values) {
+    Builder b = builder(DType.INT64, values.length);
+    for (Long v : values) {
+      if (v == null) {
+        b.appendNull();
+      } else {
+        b.append(v.longValue());
+      }
+    }
+    return b.build();
+  }
+
+  public static HostColumnVector fromStrings(String... values) {
+    Builder b = builder(DType.STRING, values.length);
+    for (String v : values) {
+      if (v == null) {
+        b.appendNull();
+      } else {
+        b.append(v);
+      }
+    }
+    return b.build();
+  }
+
+  public static Builder builder(DType type, int initialRows) {
+    return new Builder(type, initialRows);
+  }
+
+  /** Append-only builder; appendNull writes a zero value slot so the
+   * fixed-width stride never varies (the wire format's convention). */
+  public static final class Builder implements AutoCloseable {
+    private final DType type;
+    private final int width;
+    private byte[] data;
+    private byte[] valid;
+    private int[] offsets;
+    private int rows;
+    private int dataLen;
+    private long nulls;
+
+    Builder(DType type, int initialRows) {
+      this.type = type;
+      boolean isString = type.equals(DType.STRING);
+      this.width = isString ? 0 : type.getSizeInBytes();
+      int cap = Math.max(initialRows, 8);
+      this.data = new byte[isString ? cap * 8 : cap * Math.max(width, 1)];
+      this.valid = null;
+      this.offsets = isString ? new int[cap + 1] : null;
+      if (!isString && width <= 0) {
+        throw new IllegalArgumentException(
+            "unsupported builder type " + type);
+      }
+    }
+
+    private void ensure(int moreRows, int moreBytes) {
+      if (offsets != null && rows + moreRows + 1 > offsets.length) {
+        int[] n = new int[Math.max(offsets.length * 2, rows + moreRows + 1)];
+        System.arraycopy(offsets, 0, n, 0, rows + 1);
+        offsets = n;
+      }
+      int need = dataLen + moreBytes;
+      if (need > data.length) {
+        byte[] n = new byte[Math.max(data.length * 2, need)];
+        System.arraycopy(data, 0, n, 0, dataLen);
+        data = n;
+      }
+    }
+
+    private void mark(boolean isValid) {
+      if (!isValid && valid == null) {
+        // first null: materialize validity as all-valid so far
+        valid = new byte[Math.max(rows + 8, 8)];
+        java.util.Arrays.fill(valid, 0, rows, (byte) 1);
+      }
+      if (valid != null) {
+        if (rows >= valid.length) {
+          byte[] n = new byte[valid.length * 2];
+          System.arraycopy(valid, 0, n, 0, rows);
+          valid = n;
+        }
+        valid[rows] = (byte) (isValid ? 1 : 0);
+      }
+      if (!isValid) {
+        nulls++;
+      }
+    }
+
+    private void putFixed(long bits, boolean isValid) {
+      ensure(1, width);
+      mark(isValid);
+      for (int i = 0; i < width; i++) {
+        data[dataLen + i] = (byte) (bits >>> (8 * i));
+      }
+      dataLen += width;
+      if (offsets != null) {
+        offsets[rows + 1] = dataLen;
+      }
+      rows++;
+    }
+
+    public Builder append(boolean v) {
+      putFixed(v ? 1 : 0, true);
+      return this;
+    }
+
+    public Builder append(byte v) {
+      putFixed(v, true);
+      return this;
+    }
+
+    public Builder append(short v) {
+      putFixed(v, true);
+      return this;
+    }
+
+    public Builder append(int v) {
+      putFixed(v, true);
+      return this;
+    }
+
+    public Builder append(long v) {
+      putFixed(v, true);
+      return this;
+    }
+
+    public Builder append(float v) {
+      putFixed(Float.floatToIntBits(v) & 0xFFFFFFFFL, true);
+      return this;
+    }
+
+    public Builder append(double v) {
+      putFixed(Double.doubleToLongBits(v), true);
+      return this;
+    }
+
+    public Builder append(String v) {
+      byte[] b = v.getBytes(StandardCharsets.UTF_8);
+      ensure(1, b.length);
+      mark(true);
+      System.arraycopy(b, 0, data, dataLen, b.length);
+      dataLen += b.length;
+      offsets[rows + 1] = dataLen;
+      rows++;
+      return this;
+    }
+
+    public Builder appendNull() {
+      if (offsets != null) {
+        ensure(1, 0);
+        mark(false);
+        offsets[rows + 1] = dataLen;
+        rows++;
+      } else {
+        putFixed(0, false);
+      }
+      return this;
+    }
+
+    public HostColumnVector build() {
+      byte[] d = java.util.Arrays.copyOf(data, dataLen);
+      byte[] v = valid == null ? null
+          : java.util.Arrays.copyOf(valid, rows);
+      int[] o = offsets == null ? null
+          : java.util.Arrays.copyOf(offsets, rows + 1);
+      return new HostColumnVector(type, rows, nulls, d, v, o);
+    }
+
+    @Override
+    public void close() {
+    }
+  }
+}

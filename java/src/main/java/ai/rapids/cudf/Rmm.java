@@ -1,0 +1,105 @@
+/*
+ * Memory-configuration entry point — the ai.rapids.cudf.Rmm surface the
+ * spark-rapids plugin initializes device memory through
+ * (GpuDeviceManager calls Rmm.initialize(mode, logConf, poolSize) once
+ * per executor; RMM_LOGGING_LEVEL, reference pom.xml:82).
+ *
+ * TPU redesign: XLA/PJRT owns the allocator, so there is no pool to
+ * create — what this runtime can honor is the BUDGET the pool size
+ * expresses and the logging the log config asks for. initialize()
+ * therefore maps its arguments onto the runtime's flag plane
+ * (utils/config.py): poolSize -> spark.rapids.tpu.hbm.budget.gb (the
+ * ante-hoc footprint planner's ceiling, utils/hbm.py), logging ->
+ * spark.rapids.tpu.alloc.log.level (the hbm/handles observability
+ * channels, utils/log.py). Plugin code calling the cudf sequence works
+ * unchanged; the semantics move from "create a pool" to "bound and
+ * observe the planner", which is the strongest contract an XLA-owned
+ * allocator admits.
+ */
+package ai.rapids.cudf;
+
+public final class Rmm {
+  /** Allocation modes (cudf RmmAllocationMode values). Under XLA the
+   * distinction is advisory: PJRT preallocates per its own policy. */
+  public static final int ALLOCATION_MODE_CUDA_DEFAULT = 0;
+  public static final int ALLOCATION_MODE_POOL = 1;
+  public static final int ALLOCATION_MODE_ARENA = 2;
+  public static final int ALLOCATION_MODE_ASYNC = 3;
+
+  private static boolean initialized = false;
+  private static long poolSizeBytes = 0;
+  private static int mode = ALLOCATION_MODE_CUDA_DEFAULT;
+
+  private Rmm() {
+  }
+
+  /**
+   * Configure the device-memory plane. Idempotent-hostile like cudf
+   * (double-initialize throws): the plugin relies on that to catch
+   * executor misconfiguration.
+   *
+   * @param allocationMode one of the ALLOCATION_MODE_* constants
+   *                       (advisory under XLA)
+   * @param enableLogging  route allocation-plane events to stderr
+   *                       (the hbm/handles channels at DEBUG)
+   * @param poolSize       planner budget in bytes; <=0 keeps the
+   *                       backend default (v5e: 16 GiB)
+   */
+  public static synchronized void initialize(int allocationMode,
+                                             boolean enableLogging,
+                                             long poolSize) {
+    if (initialized) {
+      throw new IllegalStateException("RMM already initialized");
+    }
+    // native flag plane first: a failure here must leave NO partial
+    // configuration behind (a retry with corrected args would otherwise
+    // run under stale properties from the failed attempt)
+    long size = Math.max(poolSize, 0);
+    String gb = size > 0
+        ? Double.toString(size / (1024.0 * 1024.0 * 1024.0)) : null;
+    if (gb != null) {
+      com.nvidia.spark.rapids.jni.DeviceTable.setRuntimeFlag(
+          "SPARK_RAPIDS_TPU_HBM_BUDGET_GB", gb);
+    }
+    if (enableLogging) {
+      com.nvidia.spark.rapids.jni.DeviceTable.setRuntimeFlag(
+          "SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL", "DEBUG");
+    }
+    if (gb != null) {
+      System.setProperty("spark.rapids.tpu.hbm.budget.gb", gb);
+    }
+    if (enableLogging) {
+      System.setProperty("spark.rapids.tpu.alloc.log.level", "DEBUG");
+    }
+    mode = allocationMode;
+    poolSizeBytes = size;
+    initialized = true;
+  }
+
+  public static synchronized boolean isInitialized() {
+    return initialized;
+  }
+
+  /** The configured planner budget in bytes (0 = backend default). */
+  public static synchronized long getPoolSize() {
+    return poolSizeBytes;
+  }
+
+  public static synchronized int getAllocationMode() {
+    return mode;
+  }
+
+  /** Tear down the Java-side configuration (cudf shutdown contract:
+   * re-initializable afterwards). Deliberately does NOT touch the
+   * process environment: the embedded runtime snapshotted it at init
+   * (so unsetenv would be invisible there anyway), and glibc
+   * setenv/unsetenv racing getenv in live runtime threads is undefined
+   * behavior. */
+  public static synchronized void shutdown() {
+    initialized = false;
+    poolSizeBytes = 0;
+    mode = ALLOCATION_MODE_CUDA_DEFAULT;
+    System.clearProperty("spark.rapids.tpu.hbm.budget.gb");
+    System.clearProperty("spark.rapids.tpu.alloc.log.level");
+  }
+}

@@ -1,0 +1,173 @@
+/*
+ * A typed scalar value — the ai.rapids.cudf.Scalar surface the
+ * spark-rapids plugin binds literals through (cudf java/src/main/java/
+ * ai/rapids/cudf/Scalar.java; every GpuLiteral lowers to one).
+ *
+ * TPU redesign: cudf keeps scalars DEVICE-resident (a cudf::scalar
+ * allocation) because CUDA kernels dereference them at launch. Under
+ * XLA a literal is either baked into the compiled graph as a constant
+ * or shipped as a one-element operand, so the natural representation is
+ * a HOST value: this class is a pure-Java value holder with no native
+ * handle and no close-ordering hazard. When a scalar must ride a device
+ * op it serializes through the existing wire as a 1-row column
+ * (DeviceTable.tableOp), which XLA then fuses as a broadcast operand —
+ * the same end state as cudf's device scalar, minus one allocation and
+ * one JNI crossing per literal.
+ */
+package ai.rapids.cudf;
+
+public final class Scalar implements AutoCloseable {
+  private final DType type;
+  private final boolean valid;
+  private final long intBits;     // integer families + timestamps + bool
+  private final double floatBits; // float families
+  private final byte[] utf8;      // STRING payload
+
+  private Scalar(DType type, boolean valid, long intBits,
+                 double floatBits, byte[] utf8) {
+    this.type = type;
+    this.valid = valid;
+    this.intBits = intBits;
+    this.floatBits = floatBits;
+    this.utf8 = utf8;
+  }
+
+  public static Scalar fromBool(boolean v) {
+    return new Scalar(DType.BOOL8, true, v ? 1 : 0, 0, null);
+  }
+
+  public static Scalar fromByte(byte v) {
+    return new Scalar(DType.INT8, true, v, 0, null);
+  }
+
+  public static Scalar fromShort(short v) {
+    return new Scalar(DType.INT16, true, v, 0, null);
+  }
+
+  public static Scalar fromInt(int v) {
+    return new Scalar(DType.INT32, true, v, 0, null);
+  }
+
+  public static Scalar fromLong(long v) {
+    return new Scalar(DType.INT64, true, v, 0, null);
+  }
+
+  public static Scalar fromFloat(float v) {
+    return new Scalar(DType.FLOAT32, true, 0, v, null);
+  }
+
+  public static Scalar fromDouble(double v) {
+    return new Scalar(DType.FLOAT64, true, 0, v, null);
+  }
+
+  public static Scalar fromString(String v) {
+    if (v == null) {
+      return nullScalar(DType.STRING);
+    }
+    return new Scalar(DType.STRING, true, 0, 0,
+                      v.getBytes(java.nio.charset.StandardCharsets.UTF_8));
+  }
+
+  /** Unscaled decimal value at the given scale (DECIMAL64 wire form). */
+  public static Scalar fromDecimal(int scale, long unscaled) {
+    return new Scalar(DType.create(DType.DTypeEnum.DECIMAL64, scale),
+                      true, unscaled, 0, null);
+  }
+
+  public static Scalar timestampDaysFromInt(int days) {
+    return new Scalar(DType.TIMESTAMP_DAYS, true, days, 0, null);
+  }
+
+  public static Scalar timestampFromLong(DType type, long value) {
+    if (!type.isTimestampType()) {
+      throw new IllegalArgumentException(type + " is not a timestamp");
+    }
+    return new Scalar(type, true, value, 0, null);
+  }
+
+  /** A null literal of the given type (GpuLiteral(null, t)). */
+  public static Scalar nullScalar(DType type) {
+    return new Scalar(type, false, 0, 0, null);
+  }
+
+  public DType getType() {
+    return type;
+  }
+
+  public boolean isValid() {
+    return valid;
+  }
+
+  public boolean getBoolean() {
+    requireValid();
+    return intBits != 0;
+  }
+
+  public byte getByte() {
+    requireValid();
+    return (byte) intBits;
+  }
+
+  public short getShort() {
+    requireValid();
+    return (short) intBits;
+  }
+
+  public int getInt() {
+    requireValid();
+    return (int) intBits;
+  }
+
+  public long getLong() {
+    requireValid();
+    return intBits;
+  }
+
+  public float getFloat() {
+    requireValid();
+    return (float) floatBits;
+  }
+
+  public double getDouble() {
+    requireValid();
+    return floatBits;
+  }
+
+  public String getJavaString() {
+    requireValid();
+    return new String(utf8, java.nio.charset.StandardCharsets.UTF_8);
+  }
+
+  public byte[] getUTF8() {
+    requireValid();
+    return utf8.clone();
+  }
+
+  /**
+   * The value as its 8-byte little-endian wire form — what a 1-row
+   * column of this type carries through DeviceTable.tableOp. STRING
+   * scalars use {@link #getUTF8} instead.
+   */
+  public long getWireBits() {
+    requireValid();
+    if (type.equals(DType.FLOAT64)) {
+      return Double.doubleToLongBits(floatBits);
+    }
+    if (type.equals(DType.FLOAT32)) {
+      return Float.floatToIntBits((float) floatBits) & 0xFFFFFFFFL;
+    }
+    return intBits;
+  }
+
+  private void requireValid() {
+    if (!valid) {
+      throw new IllegalStateException("null scalar has no value");
+    }
+  }
+
+  /** No native resources: close is a no-op kept for cudf API drop-in
+   * compatibility (plugin code try-with-resources every Scalar). */
+  @Override
+  public void close() {
+  }
+}

@@ -1,0 +1,77 @@
+/*
+ * Column name/type schema — the ai.rapids.cudf.Schema surface file
+ * readers take (cudf java Schema.java; the plugin builds one per
+ * Parquet/CSV read to bind Spark's StructType to cudf types).
+ *
+ * Pure metadata here as there: a builder of parallel (name, DType)
+ * lists whose wire form is the (typeId, scale) arrays every JNI entry
+ * point already speaks (RowConversionJni.cpp wire contract).
+ */
+package ai.rapids.cudf;
+
+import java.util.ArrayList;
+import java.util.List;
+
+public final class Schema {
+  public static final Schema INFERRED = new Schema(new ArrayList<String>(),
+                                                   new ArrayList<DType>());
+
+  private final List<String> names;
+  private final List<DType> types;
+
+  private Schema(List<String> names, List<DType> types) {
+    this.names = names;
+    this.types = types;
+  }
+
+  public static Builder builder() {
+    return new Builder();
+  }
+
+  public int getNumColumns() {
+    return names.size();
+  }
+
+  public String[] getColumnNames() {
+    return names.toArray(new String[0]);
+  }
+
+  public DType[] getTypes() {
+    return types.toArray(new DType[0]);
+  }
+
+  /** The (typeId, scale) wire arrays of the JNI contract. */
+  public int[] getTypeIds() {
+    int[] out = new int[types.size()];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = types.get(i).getTypeId().getNativeId();
+    }
+    return out;
+  }
+
+  public int[] getScales() {
+    int[] out = new int[types.size()];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = types.get(i).getScale();
+    }
+    return out;
+  }
+
+  public static final class Builder {
+    private final List<String> names = new ArrayList<>();
+    private final List<DType> types = new ArrayList<>();
+
+    public Builder column(DType type, String name) {
+      if (names.contains(name)) {
+        throw new IllegalArgumentException("duplicate column " + name);
+      }
+      names.add(name);
+      types.add(type);
+      return this;
+    }
+
+    public Schema build() {
+      return new Schema(new ArrayList<>(names), new ArrayList<>(types));
+    }
+  }
+}

@@ -186,6 +186,17 @@ public class DeviceTable {
   /** Live resident tables — the device-table leak report. */
   public static native long residentTableCount();
 
+  /**
+   * Set one SPARK_RAPIDS_TPU_* flag in the embedded runtime's
+   * environment (the utils/config.py flag plane) — the path
+   * {@code ai.rapids.cudf.Rmm} routes memory/logging configuration
+   * through. {@code value == null} unsets. Call BEFORE
+   * {@link #initDeviceRuntime}: the embedded interpreter snapshots its
+   * environment at startup (the cudf ordering contract —
+   * Rmm.initialize before any allocation).
+   */
+  public static native void setRuntimeFlag(String name, String value);
+
   private static native long tableUploadNative(int[] typeIds, int[] scales,
                                                long[] colData,
                                                long[] colValid, long numRows);

@@ -1,5 +1,7 @@
 #include "error.hpp"
 
+#include <cstdlib>
+
 namespace spark_rapids_tpu {
 namespace {
 thread_local std::string g_last_error;
@@ -16,5 +18,24 @@ const char* srt_last_error(void) {
 }
 
 const char* srt_version(void) { return "spark-rapids-tpu 0.1.0"; }
+
+srt_status srt_set_runtime_flag(const char* name, const char* value) {
+  if (name == nullptr) {
+    spark_rapids_tpu::set_last_error("flag name is NULL");
+    return SRT_ERR_NULLPTR;
+  }
+  const std::string prefix = "SPARK_RAPIDS_TPU_";
+  if (std::string(name).rfind(prefix, 0) != 0) {
+    spark_rapids_tpu::set_last_error(
+        std::string("runtime flag must start with ") + prefix);
+    return SRT_ERR_INVALID;
+  }
+  int rc = value == nullptr ? ::unsetenv(name) : ::setenv(name, value, 1);
+  if (rc != 0) {
+    spark_rapids_tpu::set_last_error("setenv failed");
+    return SRT_ERR_UNKNOWN;
+  }
+  return SRT_OK;
+}
 
 }  /* extern "C" */

@@ -59,6 +59,19 @@ SRT_EXPORT const char* srt_last_error(void);
  * analog of build/build-info). */
 SRT_EXPORT const char* srt_version(void);
 
+/* Set one SPARK_RAPIDS_TPU_* runtime flag (the utils/config.py flag
+ * plane) in this process's environment, where the embedded runtime
+ * reads it — the path Java memory/logging configuration
+ * (ai.rapids.cudf.Rmm) takes into the planner and observability
+ * channels. `value` NULL unsets. Call BEFORE srt_jax_init(): the
+ * embedded interpreter snapshots its environment at startup, so later
+ * changes are invisible to the flag plane (the same ordering cudf
+ * demands — Rmm.initialize before any allocation). Names outside the
+ * SPARK_RAPIDS_TPU_ prefix return SRT_ERR_INVALID: this is a flag
+ * plane, not an arbitrary putenv. */
+SRT_EXPORT srt_status srt_set_runtime_flag(const char* name,
+                                           const char* value);
+
 /* ---- dtype wire format ----------------------------------------------- */
 
 /* Type ids match spark_rapids_jni_tpu.dtype.TypeId (cudf 22.04 native

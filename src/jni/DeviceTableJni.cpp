@@ -247,6 +247,32 @@ Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(JNIEnv* env, jclass,
   }
 }
 
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_DeviceTable_setRuntimeFlag(
+    JNIEnv* env, jclass, jstring name_j, jstring value_j) {
+  if (name_j == nullptr) {
+    throw_java_dt(env, "null flag name");
+    return;
+  }
+  const char* name = env->GetStringUTFChars(name_j, nullptr);
+  if (name == nullptr) return; /* OOM already thrown */
+  const char* value = nullptr;
+  if (value_j != nullptr) {
+    value = env->GetStringUTFChars(value_j, nullptr);
+    if (value == nullptr) {
+      /* a failed value fetch must NOT fall through to the unset
+       * branch (it would delete the flag instead of setting it), and
+       * no further JNI calls are legal with the OOM pending */
+      env->ReleaseStringUTFChars(name_j, name);
+      return;
+    }
+  }
+  srt_status s = srt_set_runtime_flag(name, value);
+  env->ReleaseStringUTFChars(name_j, name);
+  if (value != nullptr) env->ReleaseStringUTFChars(value_j, value);
+  if (s != SRT_OK) throw_java_dt(env, srt_last_error());
+}
+
 JNIEXPORT jlong JNICALL
 Java_com_nvidia_spark_rapids_jni_DeviceTable_residentTableCount(JNIEnv* env,
                                                                 jclass) {

@@ -21,6 +21,7 @@
 #include <cinttypes>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <string>
@@ -53,6 +54,8 @@ void Java_com_nvidia_spark_rapids_jni_DeviceTable_tableFree(
     JNIEnv*, jclass, jlong);
 jlong Java_com_nvidia_spark_rapids_jni_DeviceTable_residentTableCount(
     JNIEnv*, jclass);
+void Java_com_nvidia_spark_rapids_jni_DeviceTable_setRuntimeFlag(
+    JNIEnv*, jclass, jstring, jstring);
 jlong Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
     JNIEnv*, jclass, jlong, jintArray, jlong, jlong, jlong);
 jlongArray Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(
@@ -96,6 +99,29 @@ int main() {
   JNIEnv env_obj;
   JNIEnv* env = &env_obj;
   jclass cls = env->FindClass("mock/Cls");
+
+  /* -- 0. runtime flag plane (the ai.rapids.cudf.Rmm path): set before
+   * init like a real executor would, verify the env the embedded
+   * runtime reads, unset, and reject non-flag-plane names ------------ */
+  {
+    jstring fname = env->NewStringUTF("SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL");
+    jstring fval = env->NewStringUTF("DEBUG");
+    Java_com_nvidia_spark_rapids_jni_DeviceTable_setRuntimeFlag(
+        env, cls, fname, fval);
+    CHECK(!srt_mock::exception_pending(), "setRuntimeFlag threw");
+    const char* got = std::getenv("SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL");
+    CHECK(got != nullptr && std::string(got) == "DEBUG",
+          "flag did not reach the process environment");
+    Java_com_nvidia_spark_rapids_jni_DeviceTable_setRuntimeFlag(
+        env, cls, fname, nullptr);
+    CHECK(!srt_mock::exception_pending(), "setRuntimeFlag(unset) threw");
+    CHECK(std::getenv("SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL") == nullptr,
+          "flag unset did not clear the environment");
+    jstring bad = env->NewStringUTF("PATH");
+    CHECK_THROWS(Java_com_nvidia_spark_rapids_jni_DeviceTable_setRuntimeFlag(
+                     env, cls, bad, fval),
+                 "non-flag-plane name must be rejected");
+  }
 
   /* -- 1. runtime lifecycle through the DeviceTable entry points ----- */
   CHECK(Java_com_nvidia_spark_rapids_jni_DeviceTable_isDeviceRuntimeAvailable(

@@ -211,3 +211,68 @@ def test_facade_uses_wire_contract():
         re.S,
     )
     assert "getNativeId()" in src and "getScale()" in src
+
+
+PLUGIN_FACADE = {
+    # VERDICT r4 item 4: the plugin's real ai.rapids.cudf import
+    # surface. Class -> public members a Spark plugin binds.
+    "Scalar.java": [
+        "fromBool", "fromInt", "fromLong", "fromDouble", "fromString",
+        "fromDecimal", "nullScalar", "getType", "isValid", "close",
+    ],
+    "HostColumnVector.java": [
+        "builder", "fromLongs", "fromStrings", "appendNull", "build",
+        "getRowCount", "getNullCount", "isNull", "copyToDevice",
+    ],
+    "ContiguousTable.java": [
+        "pack", "getBuffer", "getTable", "getMetadataDirectBuffer",
+        "unpack", "getRowCount", "close",
+    ],
+    "Schema.java": ["builder", "column", "getTypeIds", "getScales"],
+    "Rmm.java": [
+        "initialize", "isInitialized", "getPoolSize", "shutdown",
+    ],
+}
+
+
+def test_plugin_facade_surface_present():
+    """Every class/member of the plugin's ai.rapids.cudf binding surface
+    exists (text-level; a JVM would enforce signatures)."""
+    base = os.path.join(JAVA_ROOT, "main", "java", "ai", "rapids", "cudf")
+    for fname, members in PLUGIN_FACADE.items():
+        path = os.path.join(base, fname)
+        assert os.path.exists(path), f"missing facade class {fname}"
+        src = open(path).read()
+        for m in members:
+            assert re.search(rf"\b{m}\s*\(", src), (
+                f"{fname} lacks public member {m}"
+            )
+
+
+def test_set_runtime_flag_c_abi():
+    """Drive srt_set_runtime_flag through the C ABI: prefix-checked
+    setenv/unsetenv reaching this process's environment (the
+    ai.rapids.cudf.Rmm path into the flag plane)."""
+    import ctypes
+
+    from spark_rapids_jni_tpu.utils import native
+
+    try:
+        lib = native.load()
+    except OSError:
+        lib = None
+    if lib is None:
+        pytest.skip("native library not built")
+    lib.srt_set_runtime_flag.restype = ctypes.c_int
+    # os.environ is a startup snapshot: read back through libc getenv,
+    # which is what the embedded runtime's flag plane actually reads
+    libc = ctypes.CDLL(None)
+    libc.getenv.restype = ctypes.c_char_p
+    name = b"SPARK_RAPIDS_TPU_TEST_FLAG_XYZ"
+    assert lib.srt_set_runtime_flag(name, b"42") == 0
+    assert libc.getenv(name) == b"42"
+    assert lib.srt_set_runtime_flag(name, None) == 0
+    assert libc.getenv(name) is None
+    # outside the flag plane: rejected, env untouched
+    assert lib.srt_set_runtime_flag(b"PATH", b"/tmp") != 0
+    assert libc.getenv(b"PATH") != b"/tmp"
