@@ -177,6 +177,54 @@ def bench_groupby_chunked(platform, n=100_000_000, n_inputs=2):
     )
 
 
+def bench_groupby_packed(platform, n=100_000_000, n_inputs=2):
+    """Config 1 at scale via the packed-key formulation: ONE u64 sort
+    word ((key-kmin)<<18 | iota) per row instead of (occupancy, key,
+    iota, row_valid) — ~1.8x less sort traffic than the chunked path on
+    the same shape, ties impossible so stability is free. The A/B vs
+    groupby100m_chunked/groupby100m decides the headline formulation."""
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+    from spark_rapids_jni_tpu.ops.groupby_packed import (
+        groupby_aggregate_packed_chunked,
+    )
+
+    n_keys = 10_000
+    rng = np.random.default_rng(42)
+    hosts = []
+    inputs = []
+    for _ in range(n_inputs):
+        k = rng.integers(0, n_keys, n, dtype=np.int64)
+        v = rng.integers(-1000, 1000, n, dtype=np.int64)
+        hosts.append((k, v))
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        jax.block_until_ready(t.columns[0].data)
+        inputs.append((t,))
+
+    step = jax.jit(
+        lambda t: groupby_aggregate_packed_chunked(
+            t,
+            ["k"],
+            [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")],
+            num_segments=n_keys,
+            chunk_rows=1 << 18,
+            chunk_segments=1 << 14,
+        )
+    )
+    med, mn, std, out = _timeit(step, inputs)
+    agg, ngroups, max_chunk, overflow = out
+    assert not bool(overflow), "packed range overflow"
+    assert int(max_chunk) <= 1 << 14, "chunk capacity overflow"
+    total = int(np.asarray(agg["sum_v"].data)[: int(ngroups)].sum())
+    assert total == int(hosts[-1][1].sum()), "groupby-sum mismatch vs numpy"
+    return _entry(
+        1, f"groupby_sum_{n // 1_000_000}M_packed", n, med, mn, std,
+        n * 16, platform,
+    )
+
+
 def arrow_baseline(n):
     """CPU Arrow groupby throughput (rows/s) on the config-1 shape."""
     try:
@@ -823,6 +871,8 @@ _SUBPROCESS_CONFIGS = {
     "groupby16m": lambda p: bench_groupby(p, 16_000_000)[0],
     "groupby100m": lambda p: bench_groupby(p, 100_000_000)[0],
     "groupby100m_chunked": bench_groupby_chunked,
+    "groupby100m_packed": bench_groupby_packed,
+    "groupby16m_packed": lambda p: bench_groupby_packed(p, 16_000_000),
     "groupby16m_chunked": lambda p: bench_groupby_chunked(p, 16_000_000),
     "transpose": bench_transpose,
     "join": bench_join,
@@ -845,9 +895,11 @@ _SUBPROCESS_CONFIGS = {
 # configs land before the multi-minute 100M uploads; the headline
 # chunked-groupby A/B runs as soon as the cheap tier is banked.
 _LADDER = (
-    "groupby1m", "groupby16m_chunked", "groupby16m", "chunk_sort_ab",
+    "groupby1m", "groupby16m_packed", "groupby16m_chunked", "groupby16m",
+    "chunk_sort_ab",
     "strings", "transpose", "resident", "parquet", "parquet_device",
-    "groupby100m_chunked", "groupby100m", "sort", "sort_gather",
+    "groupby100m_packed", "groupby100m_chunked", "groupby100m", "sort",
+    "sort_gather",
     "join_batched", "tpcds", "tpcds10",
 )
 

@@ -105,9 +105,27 @@ public final class HostColumnVector implements AutoCloseable {
                       StandardCharsets.UTF_8);
   }
 
-  /** The wire buffers of this column: data bytes as the JNI ships them. */
+  /** The wire buffers of this column: data bytes exactly as the JNI
+   * ships them. STRING columns carry the Arrow-style wire layout the
+   * bridge decodes (runtime_bridge._padded_from_offsets): int32
+   * little-endian offsets[rows+1] followed by the concatenated UTF-8
+   * payload. */
   public byte[] getDataBytes() {
-    return data.clone();
+    if (offsets == null) {
+      return data.clone();
+    }
+    ByteBuffer bb = ByteBuffer
+        .allocate(4 * ((int) rows + 1) + dataLength())
+        .order(ByteOrder.LITTLE_ENDIAN);
+    for (int i = 0; i <= rows; i++) {
+      bb.putInt(offsets[i]);
+    }
+    bb.put(data, 0, dataLength());
+    return bb.array();
+  }
+
+  private int dataLength() {
+    return offsets == null ? data.length : offsets[(int) rows];
   }
 
   /** Per-row validity byte vector, or null when the column has no nulls. */
@@ -119,7 +137,8 @@ public final class HostColumnVector implements AutoCloseable {
    * DeviceTable.tableOp: [0]=data, [1]=validity (null when no nulls). */
   public com.nvidia.spark.rapids.jni.HostBuffer[] copyToDevice(String tag) {
     com.nvidia.spark.rapids.jni.HostBuffer d =
-        com.nvidia.spark.rapids.jni.HostBuffer.create(data, tag + ".data");
+        com.nvidia.spark.rapids.jni.HostBuffer.create(getDataBytes(),
+                                                      tag + ".data");
     com.nvidia.spark.rapids.jni.HostBuffer v = null;
     if (valid != null) {
       try {

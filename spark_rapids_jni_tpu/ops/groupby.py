@@ -520,6 +520,17 @@ def groupby_aggregate(
     chunked path automatically (exact; falls back here when chunk
     cardinality is too high for chunking to win)."""
     if table.row_count > CHUNKED_MIN_ROWS:
+        # narrow-key packed path first (half the sort traffic), then the
+        # general chunked path; both are exact-or-None
+        from .groupby_packed import (
+            groupby_aggregate_packed,
+            packed_groupby_supported,
+        )
+
+        if packed_groupby_supported(table, by, aggs):
+            out = groupby_aggregate_packed(table, by, aggs)
+            if out is not None:
+                return out
         from .groupby_chunked import (
             chunked_groupby_supported,
             groupby_aggregate_chunked,

@@ -1,0 +1,449 @@
+"""Packed-key two-level group-by — the narrow-key fast path.
+
+The chunked design (groupby_chunked.py) still pays a WIDE variadic sort
+per chunk: occupancy word + key order word + iota + row_valid + every
+value column — 29 B/row on the headline shape (one int64 key, one int64
+value). But when the key's VALUE RANGE fits in ``64 - log2(chunk_rows)``
+bits — 10k-key aggregations, dictionary codes, date keys, virtually
+every Spark GROUP BY that isn't keyed on a hash — the entire sort key
+collapses into ONE u64 word::
+
+    packed = (order_key(k) - kmin) << iota_bits  |  row_iota
+    padding rows -> 0xFFFF...F (sorts last, one garbage segment)
+
+which buys, per sort pass (and a bitonic sort makes O(log^2) of them):
+
+* 16 B/row of operands instead of 29 — ~1.8x less sort traffic;
+* ties are IMPOSSIBLE (the embedded iota is unique), so sorted order
+  within a key group is exactly original row order: stability is free,
+  first/last are just segment ends, and no separate iota operand rides;
+* the occupancy word, the boundary scan over a second word, and the
+  row_valid payload all vanish.
+
+Both levels use the same trick (phase 2 packs the C x S chunk partials
+with the same global kmin), and both run as a batched ``lax.sort`` over
+a (C, T) layout — no vmap, XLA sees one fused static-shape graph.
+
+Eligibility is STATIC (caller-checked, raised here): one key column of
+an integer-family dtype (ints / bool / timestamps / durations /
+decimal32/64 — everything whose order key is an XOR-sign-flip or a
+widen, so it inverts exactly), no nulls on keys or values, decomposable
+aggs. Whether the RANGE fits is data-dependent: the eager router
+measures min/max first (one cheap reduction); the jittable API also
+returns a traced ``overflow`` flag so a mis-sized direct call is
+detected, never silently wrong — the same exactness protocol as the
+chunked API's ``max_chunk`` contract.
+
+Reference parity: this is the role of cudf's hash-based groupby
+specializations for simple keys (single-pass hash aggregation) —
+re-expressed for a machine with no device-wide atomic hash tables, where
+the classical sort-based answer gets its constant factor back by making
+the sort key as narrow as the data allows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtype as dt
+from ..column import Column, Table
+from . import compute
+from . import keys as keys_mod
+from .groupby import GroupbyAgg
+from .groupby_chunked import DECOMPOSABLE_OPS
+
+_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+_SIGN64 = jnp.uint64(1) << jnp.uint64(63)
+
+
+def _key_supported(col: Column) -> bool:
+    d = col.dtype
+    if col.validity is not None:
+        return False
+    if d.id in (dt.TypeId.FLOAT32, dt.TypeId.FLOAT64):
+        return False  # order key inverts, but ranges are meaningless
+    if d.is_string or d.id in (dt.TypeId.LIST, dt.TypeId.STRUCT):
+        return False
+    if d.id == dt.TypeId.DECIMAL128:
+        return False  # two-word key
+    return True
+
+
+def _unkey(word: jax.Array, d) -> jax.Array:
+    """Invert column_order_keys for the integer family: the sign-flip
+    XOR is an involution; unsigned/bool just widened."""
+    storage = jnp.dtype(d.storage_dtype)
+    if storage.kind == "i":  # signed ints, timestamps, durations, decimals
+        return (word ^ _SIGN64).astype(jnp.int64).astype(storage)
+    return word.astype(storage)  # unsigned / bool widen
+
+
+def packed_groupby_supported(
+    table: Table, by: Sequence, aggs: Sequence[GroupbyAgg]
+) -> bool:
+    """Static eligibility (range fitting is checked separately)."""
+    if len(by) != 1:
+        return False
+    if not _key_supported(table.column(by[0])):
+        return False
+    for a in aggs:
+        if a.op not in DECOMPOSABLE_OPS:
+            return False
+        c = table.column(a.column)
+        if c.validity is not None or c.dtype.is_string:
+            return False
+        if c.dtype.id == dt.TypeId.DECIMAL128:
+            return False
+    return True
+
+
+def _plan(table: Table, aggs: Sequence[GroupbyAgg]):
+    """Deduplicated partial ops via the chunked path's shared planner
+    (one dedup/mean-decomposition policy for both two-level designs),
+    re-indexed positionally for this module's parallel-list plumbing."""
+    from .groupby_chunked import _phase1_plan
+
+    p1, plan_named = _phase1_plan(table, (), aggs)
+    idx = {a.name: i for i, a in enumerate(p1)}
+    parts = [(a.name, a.op, a.column) for a in p1]
+    plan = [
+        (op, a, idx[main], idx[cnt] if cnt is not None else None)
+        for (op, a, main, cnt) in plan_named
+    ]
+    return parts, plan
+
+
+def _segment_reduce(op, vals, seg, starts, ends):
+    """One partial aggregation over a row-sorted flat layout."""
+    from .groupby import _sorted_segment_extreme, _sorted_segment_sum
+
+    n = vals.shape[0]
+    if op == "sum":
+        acc = vals.astype(
+            jnp.float64
+            if jnp.issubdtype(vals.dtype, jnp.floating)
+            else jnp.int64
+        )
+        return _sorted_segment_sum(acc, starts, ends)
+    if op == "count":
+        # no nulls on the packed path + padding confined to the trailing
+        # garbage segment: count is just the segment length
+        return (ends - starts).astype(jnp.int64)
+    if op in ("min", "max"):
+        return _sorted_segment_extreme(vals, seg, ends, op == "min")
+    if op == "first":
+        return vals[jnp.clip(starts, 0, max(n - 1, 0))]
+    if op == "last":
+        return vals[jnp.clip(ends - 1, 0, max(n - 1, 0))]
+    raise ValueError(op)
+
+
+def groupby_aggregate_packed_chunked(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+    num_segments: int,
+    chunk_rows: int = 1 << 18,
+    chunk_segments: int = 1 << 14,
+) -> tuple[Table, jax.Array, jax.Array, jax.Array]:
+    """Jittable packed two-level groupby.
+
+    Returns ``(padded result of num_segments rows, num_groups,
+    max_per_chunk_groups, overflow)``. EXACT iff ``overflow`` is False
+    (key range fit both packing levels) and ``max_per_chunk_groups <=
+    chunk_segments`` — callers must check both (the eager router does).
+    """
+    if not packed_groupby_supported(table, by, aggs):
+        raise ValueError(
+            "packed groupby: single no-null integer-family key and "
+            "no-null decomposable value columns required"
+        )
+    key_names = [
+        c
+        if isinstance(c, str)
+        else (table.names[c] if table.names else "key0")
+        for c in by
+    ]
+    kcol = table.column(by[0])
+    n = table.row_count
+    c = -(-n // chunk_rows)
+    padded = c * chunk_rows
+    iota_bits = max(1, (chunk_rows - 1).bit_length())
+    p2_rows = c * (chunk_segments + 1)  # +1: per-chunk garbage slot
+    iota_bits2 = max(1, (p2_rows - 1).bit_length())
+
+    kw = keys_mod.column_order_keys(kcol)[0]  # (n,) u64, order-preserving
+    kmin = jnp.min(kw)
+    rel = kw - kmin
+    rel_max = jnp.max(rel)
+    # both packing levels must fit strictly below the sentinel
+    fit1 = rel_max < (
+        (jnp.uint64(1) << jnp.uint64(64 - iota_bits)) - jnp.uint64(1)
+    )
+    fit2 = rel_max < (
+        (jnp.uint64(1) << jnp.uint64(64 - iota_bits2)) - jnp.uint64(1)
+    )
+    overflow = jnp.logical_not(jnp.logical_and(fit1, fit2))
+
+    parts, plan = _plan(table, aggs)
+    vals_in = [
+        compute.values(table.column(colref)) for (_, _, colref) in parts
+    ]
+
+    # ---- phase 1: batched (C, T) packed sort + flat segment reduce ----
+    iota = jnp.arange(chunk_rows, dtype=jnp.uint64)
+    packed = (rel << jnp.uint64(iota_bits))
+    packed = jnp.pad(packed, (0, padded - n), constant_values=0)
+    packed = packed.reshape(c, chunk_rows) | iota[None, :]
+    occ2d = (
+        jnp.arange(padded, dtype=jnp.int32).reshape(c, chunk_rows)
+        < n
+    )
+    packed = jnp.where(occ2d, packed, _U64_MAX)
+
+    ops_2d = tuple(
+        jnp.pad(v, [(0, padded - n)] + [(0, 0)] * (v.ndim - 1)).reshape(
+            (c, chunk_rows) + v.shape[1:]
+        )
+        for v in vals_in
+    )
+    sorted_all = jax.lax.sort((packed,) + ops_2d, num_keys=1)
+    spacked = sorted_all[0]
+    svals = sorted_all[1:]
+
+    skey = spacked >> jnp.uint64(iota_bits)  # (C, T) relative key words
+    boundary = jnp.concatenate(
+        [
+            jnp.ones((c, 1), jnp.bool_),
+            skey[:, 1:] != skey[:, :-1],
+        ],
+        axis=1,
+    )
+    local_seg = jnp.cumsum(boundary.astype(jnp.int32), axis=1) - 1
+    # group count per chunk = local segment of its LAST REAL row + 1
+    # (real rows sort before the sentinel; padding forms one garbage
+    # trailing segment per padded chunk)
+    real_per_chunk = jnp.sum(occ2d, axis=1)
+    last_real = jnp.clip(real_per_chunk - 1, 0, chunk_rows - 1)
+    chunk_groups = jnp.where(
+        real_per_chunk > 0,
+        jnp.take_along_axis(local_seg, last_real[:, None], axis=1)[:, 0]
+        + 1,
+        0,
+    )
+    max_chunk = jnp.max(chunk_groups)
+
+    # per-chunk stride is S+1: slot S is a DEDICATED garbage slot, so a
+    # padded chunk whose real groups fill all S slots (max_chunk == S,
+    # still documented-exact) cannot have its padding clamped into the
+    # last real segment
+    stride = chunk_segments + 1
+    seg_flat = (
+        jnp.arange(c, dtype=jnp.int32)[:, None] * stride
+        + jnp.minimum(local_seg, chunk_segments)
+    ).reshape(-1)
+    from .groupby import _segment_bounds
+
+    starts, ends = _segment_bounds(seg_flat, c * stride)
+    # a partial slot is REAL iff its local id is below its chunk's
+    # group count (slot S never is: chunk_groups <= S when exact)
+    sids = jnp.arange(c * stride, dtype=jnp.int32)
+    p2_valid = (sids % stride) < chunk_groups[sids // stride]
+    ends = jnp.where(p2_valid, ends, starts)
+
+    skey_flat = skey.reshape(-1)
+    part_key = skey_flat[jnp.clip(starts, 0, padded - 1)]  # relative words
+    partials = [
+        _segment_reduce(op, sv.reshape(-1), seg_flat, starts, ends)
+        for ((_, op, _), sv) in zip(parts, svals)
+    ]
+
+    # ---- phase 2: pack the C*S partials the same way ------------------
+    iota2 = jnp.arange(p2_rows, dtype=jnp.uint64)
+    packed2 = (part_key << jnp.uint64(iota_bits2)) | iota2
+    packed2 = jnp.where(p2_valid, packed2, _U64_MAX)
+    sorted2 = jax.lax.sort(
+        (packed2,) + tuple(partials) + (p2_valid,), num_keys=1
+    )
+    sp2 = sorted2[0]
+    sparts = sorted2[1:-1]
+    svalid2 = sorted2[-1]
+
+    skey2 = sp2 >> jnp.uint64(iota_bits2)
+    boundary2 = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), skey2[1:] != skey2[:-1]]
+    )
+    seg2 = jnp.cumsum(boundary2.astype(jnp.int32)) - 1
+    num_groups = jnp.max(jnp.where(svalid2, seg2 + 1, 0))
+    starts2, ends2 = _segment_bounds(seg2, num_segments)
+    valid_out = jnp.arange(num_segments, dtype=jnp.int32) < num_groups
+    ends2 = jnp.where(valid_out, ends2, starts2)
+
+    _COMBINE2 = {
+        "sum": "sum",
+        "count": "sum",
+        "min": "min",
+        "max": "max",
+        "first": "first",
+        "last": "last",
+    }
+    finals = [
+        _segment_reduce(
+            _COMBINE2[op], sp, seg2, starts2, ends2
+        )
+        for ((_, op, _), sp) in zip(parts, sparts)
+    ]
+
+    # reconstruct the key column from the segment-start order word
+    key_rel = skey2[jnp.clip(starts2, 0, p2_rows - 1)]
+    key_word = key_rel + kmin
+    key_storage = _unkey(key_word, kcol.dtype)
+    out_cols = [Column(key_storage, kcol.dtype, None)]
+    out_names = list(key_names)
+
+    for op, a, main_i, count_i in plan:
+        colref = a.column
+        base = (
+            colref
+            if isinstance(colref, str)
+            else (table.names[colref] if table.names else f"c{colref}")
+        )
+        out_name = a.name or f"{a.op}_{base}"
+        src = table.column(colref)
+        if op == "mean":
+            total = finals[main_i]
+            cnt = finals[count_i]
+            mean = total.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            if src.dtype.is_decimal:
+                mean = mean * (10.0 ** src.dtype.scale)
+            out_cols.append(
+                compute.from_values(mean, dt.FLOAT64, valid_out)
+            )
+        elif op == "count":
+            # INT64, matching the single-pass path (groupby.py count
+            # branch) — the packed path must be schema-interchangeable
+            out_cols.append(Column(finals[main_i], dt.INT64, None))
+        elif op == "sum":
+            v = finals[main_i]
+            if src.dtype.is_floating:
+                # f64 accumulation surfaces as FLOAT64 like the other
+                # paths (even for FLOAT32 inputs)
+                out_cols.append(
+                    compute.from_values(v, dt.FLOAT64, None)
+                )
+            elif src.dtype.is_decimal:
+                out_cols.append(
+                    Column(
+                        v,
+                        dt.DType(dt.TypeId.DECIMAL64, src.dtype.scale),
+                        None,
+                    )
+                )
+            else:
+                out_cols.append(Column(v, dt.INT64, None))
+        else:  # min / max / first / last keep the source dtype
+            # finals hold the ARITHMETIC view (f64 for FLOAT64 columns);
+            # from_values re-encodes storage (bit patterns for f64)
+            out_cols.append(
+                compute.from_values(finals[main_i], src.dtype, None)
+            )
+        out_names.append(out_name)
+    return (
+        Table(out_cols, out_names),
+        num_groups,
+        max_chunk,
+        overflow,
+    )
+
+
+def groupby_aggregate_packed(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+    chunk_rows: int = 1 << 18,
+    chunk_segments: Optional[int] = None,
+) -> Optional[Table]:
+    """Eager packed groupby with exact output size, or None when the
+    shape is ineligible (caller falls back to chunked / single-pass).
+
+    Range fitting is decided EAGERLY from one min/max reduction (two
+    8-byte fetches), so the jitted graph never needs a fallback branch;
+    the traced overflow flag is still asserted as a belt."""
+    n = table.row_count
+    if n <= chunk_rows:
+        return None
+    if not packed_groupby_supported(table, by, aggs):
+        return None
+    kcol = table.column(by[0])
+    kw = keys_mod.column_order_keys(kcol)[0]
+    lo, hi = _minmax(kw)
+    span = int(hi) - int(lo)
+    c = -(-n // chunk_rows)
+    iota_bits = max(1, (chunk_rows - 1).bit_length())
+    if chunk_segments is None:
+        # worst-case distinct keys per chunk is bounded by the span+1
+        guess = min(chunk_rows, 1 << max(6, (span).bit_length()))
+        chunk_segments = min(guess, 1 << 14)
+    iota_bits2 = max(1, (c * chunk_segments - 1).bit_length())
+    limit = (1 << (64 - max(iota_bits, iota_bits2))) - 1
+    if span >= limit:
+        return None
+    if span + 1 > chunk_segments * 4 and span + 1 > chunk_rows:
+        # keys too spread for per-chunk dedup to win
+        return None
+
+    for _ in range(2):
+        out, num_groups, max_chunk, overflow = _jit_packed(
+            table, tuple(by), tuple(aggs),
+            min(c * chunk_segments, n), chunk_rows, chunk_segments,
+        )
+        assert not bool(overflow), "packed groupby range overflow"
+        if int(max_chunk) <= chunk_segments:
+            g = int(num_groups)
+            cols = [
+                Column(
+                    col.data[:g],
+                    col.dtype,
+                    None if col.validity is None else col.validity[:g],
+                    None if col.lengths is None else col.lengths[:g],
+                )
+                for col in out.columns
+            ]
+            return Table(cols, out.names)
+        if chunk_segments >= chunk_rows:
+            break
+        chunk_segments = min(
+            chunk_rows, 1 << int(max_chunk - 1).bit_length()
+        )
+    return None
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _minmax_jit(kw):
+    return jnp.min(kw), jnp.max(kw)
+
+
+def _minmax(kw):
+    lo, hi = _minmax_jit(kw)
+    return int(lo), int(hi)
+
+
+@functools.lru_cache(maxsize=256)
+def _packed_fn(by, aggs, num_segments, chunk_rows, chunk_segments):
+    def fn(tbl):
+        return groupby_aggregate_packed_chunked(
+            tbl, list(by), list(aggs), num_segments, chunk_rows,
+            chunk_segments,
+        )
+
+    return jax.jit(fn)
+
+
+def _jit_packed(table, by, aggs, num_segments, chunk_rows, chunk_segments):
+    return _packed_fn(by, aggs, num_segments, chunk_rows, chunk_segments)(
+        table
+    )
