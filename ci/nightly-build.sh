@@ -16,6 +16,7 @@ NATIVE_BUILD_CONFIGURE=true SRT_WERROR=ON \
   CPP_PARALLEL_LEVEL="${PARALLEL_LEVEL:-4}" \
   bash spark-rapids-tpu-runtime/build-native.sh
 
+# FULL suite nightly, slow distributed tier included
 python3 -m pytest tests/ -q
 
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

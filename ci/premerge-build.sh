@@ -27,9 +27,12 @@ NATIVE_BUILD_CONFIGURE=true SRT_WERROR=ON \
   CPP_PARALLEL_LEVEL="${PARALLEL_LEVEL:-4}" \
   bash spark-rapids-tpu-runtime/build-native.sh
 
-# Full suite (CPU-forced inside conftest; op surface + native codec +
-# java facade structure).
-python3 -m pytest tests/ -q
+# Quick tier (CPU-forced inside conftest; op surface + native codec +
+# java facade structure). The slow distributed/mesh tier runs nightly;
+# premerge covers those paths via the multichip dryrun below, keeping
+# the gate's wall-clock bounded as coverage grows (the suite passed
+# 600 tests / >1h this round).
+python3 -m pytest tests/ -q -m "not slow"
 
 # Multi-chip sharding must compile+run on a virtual 8-device mesh.
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

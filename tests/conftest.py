@@ -32,3 +32,31 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+# ---------------------------------------------------------------------------
+# quick/slow split (round-4 VERDICT weak item 8): the distributed tier
+# runs minutes-per-file on the virtual 8-device mesh and grows with
+# coverage. The premerge gate runs `-m "not slow"` plus the multichip
+# dryrun (which exercises the same distributed paths end-to-end); the
+# nightly tier runs everything.
+# ---------------------------------------------------------------------------
+
+_SLOW_MODULES = {
+    "test_parallel",      # distributed ops over the virtual mesh
+    "test_benchmarks",    # TPC-DS query DAGs incl. mesh variants
+    "test_tpcds",         # parquet star schema generate + stream
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: distributed/mesh tier (premerge skips; nightly runs)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
