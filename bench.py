@@ -442,6 +442,37 @@ def bench_join_batched(platform, n=None):
     return e
 
 
+def bench_join_batched_packed(platform, n=None):
+    """Config 3a A/B arm: the packed-key batched join (join_packed.py)
+    — one-u64-word build sort (8 B/row vs 20) with the permutation in
+    the low bits, native searchsorted probe. Eligible because the bench
+    keys span [0, n) and n < 2^37."""
+    import os
+
+    from spark_rapids_jni_tpu.ops.join_packed import (
+        inner_join_batched_packed,
+    )
+
+    if n is None:
+        n = int(os.environ.get("SRT_BENCH_JOIN_ROWS", 100_000_000))
+    left, right = _join_inputs(n)
+
+    def run(l, r):
+        out = inner_join_batched_packed(l, r, ["k"], probe_rows=16_000_000)
+        assert out is not None, "packed join declined the bench shape"
+        return out
+
+    med, mn, std, out = _timeit(run, [(left, right)], reps_per_input=2)
+    matches = out.row_count
+    bytes_moved = 2 * n * 16 + matches * 24
+    e = _entry(
+        3, f"inner_join_{n // 1_000_000}M_batched_packed", 2 * n, med,
+        mn, std, bytes_moved, platform,
+    )
+    e["matches"] = matches
+    return e
+
+
 def bench_resident_chain(platform, n=4_000_000):
     """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
     through device-RESIDENT table handles vs the bytes-wire path that
@@ -877,6 +908,7 @@ _SUBPROCESS_CONFIGS = {
     "transpose": bench_transpose,
     "join": bench_join,
     "join_batched": bench_join_batched,
+    "join_batched_packed": bench_join_batched_packed,
     "sort": bench_sort,
     "sort_gather": bench_sort_gather,
     "chunk_sort_ab": bench_chunk_sort_ab,
@@ -900,7 +932,7 @@ _LADDER = (
     "strings", "transpose", "resident", "parquet", "parquet_device",
     "groupby100m_packed", "groupby100m_chunked", "groupby100m", "sort",
     "sort_gather",
-    "join_batched", "tpcds", "tpcds10",
+    "join_batched", "join_batched_packed", "tpcds", "tpcds10",
 )
 
 _CONFIG_TIMEOUT_S = 1800

@@ -1,0 +1,206 @@
+"""Packed-key batched inner join — the narrow-key fast path.
+
+The batched join (ops/join.py inner_join_batched) sorts the build side
+over (occupancy word, key order word) with a separate permutation iota
+riding the sort, then probes with a hand-rolled multi-word lexicographic
+binary search. When the single integer-family key's VALUE RANGE fits in
+``64 - log2(build_rows)`` bits — which covers every dictionary-coded,
+date, or sequential-id join key — the same trick as the packed groupby
+(ops/groupby_packed.py) collapses all of it into one u64 word::
+
+    build:  sorted = lax.sort( (key - kmin) << bits | build_iota )   # ONE array
+    perm:   sorted & mask                                            # free
+    probe:  lo = searchsorted(sorted, rel_q << bits,        'left')
+            hi = searchsorted(sorted, rel_q << bits | mask, 'right')
+
+What this buys over the general path:
+
+* the build sort carries ONE u64 operand instead of two u64 words plus
+  an int32 iota (8 B/row vs 20) — and the permutation needs no gather,
+  it is the low bits of the sorted word;
+* the probe is ``jnp.searchsorted`` over one word (XLA's native binary
+  search) instead of the fori-loop lexicographic search over word lists;
+* probe keys below/above the build range wrap or clamp harmlessly:
+  ``rel`` is computed against the GLOBAL min of both sides and the fit
+  check covers the global span, so every query is in-range by
+  construction and unmatched keys get ``lo == hi`` (count 0).
+
+Expansion and output assembly reuse the shared machinery (``_expand`` /
+``_join_output``), so semantics — row order, schema, null handling — are
+identical to ``inner_join_batched``; this module only changes how the
+match ranges are found. Eligibility is decided EAGERLY (one min/max
+reduction per side); ineligible shapes return ``None`` and callers fall
+back to the general batched path. The fused-graph XLA fault fence is
+irrelevant here: every graph this module builds is (sort-one-word) or
+(searchsorted + expand), both known-safe shapes.
+
+Reference parity: cudf's mixed/hash join specializations pick cheaper
+kernels for simple key types (hash_join.cu type dispatch); this is the
+sort-based machine's version of the same specialization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Table
+from . import keys as keys_mod
+from .groupby_packed import _key_supported
+from .join import _expand, _join_output
+
+
+def packed_join_supported(
+    left: Table, right: Table, on: Sequence, right_on: Sequence
+) -> bool:
+    if len(on) != 1 or len(right_on) != 1:
+        return False
+    return _key_supported(left.column(on[0])) and _key_supported(
+        right.column(right_on[0])
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_fn(bits: int):
+    mask = jnp.uint64((1 << bits) - 1)
+
+    def fn(kw_r, kmin):
+        m = kw_r.shape[0]
+        rel = kw_r - kmin
+        iota = jnp.arange(m, dtype=jnp.uint64)
+        (sorted_packed,) = jax.lax.sort(
+            ((rel << jnp.uint64(bits)) | iota,), num_keys=1
+        )
+        # permutation extracted ONCE here (the low bits), not per probe
+        # chunk — matching the general path's prep/materialize split
+        return sorted_packed, (sorted_packed & mask).astype(jnp.int32)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _probe_fn(bits: int):
+    mask = jnp.uint64((1 << bits) - 1)
+
+    def fn(sorted_packed, kw_chunk, kmin):
+        base = (kw_chunk - kmin) << jnp.uint64(bits)
+        lo = jnp.searchsorted(
+            sorted_packed, base, side="left"
+        ).astype(jnp.int32)
+        hi = jnp.searchsorted(
+            sorted_packed, base | mask, side="right"
+        ).astype(jnp.int32)
+        counts = hi - lo
+        return lo, counts, jnp.sum(counts)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _materialize_fn(right_on: tuple, cap: int):
+    def fn(perm_r, lo, counts, chunk, r):
+        left_idx, right_idx, _, _ = _expand(
+            perm_r, lo, counts, cap, left_outer=False
+        )
+        return _join_output(
+            chunk, r, list(right_on), left_idx, right_idx, None, None
+        )
+
+    return jax.jit(fn)
+
+
+def inner_join_batched_packed(
+    left: Table,
+    right: Table,
+    on: Sequence[Union[int, str]],
+    right_on: Optional[Sequence[Union[int, str]]] = None,
+    probe_rows: Optional[int] = None,
+) -> Optional[Table]:
+    """Eager batched inner join via the packed formulation, or ``None``
+    when the shape is ineligible / the key span does not fit (callers
+    fall back to :func:`ops.join.inner_join_batched`).
+
+    ``probe_rows`` defaults to the live fault fence
+    (``join.FUSED_PROBE_MAX_ROWS``) so tuning the fence moves this path
+    with it. Oversized chunk outputs re-split like the general batched
+    path (heavy-hitter keys must not materialize an HBM-breaking padded
+    output in one graph)."""
+    from collections import deque
+
+    from ..utils import hbm
+    from . import join as join_mod
+    from .copying import concatenate, slice_rows
+
+    right_on = right_on or on
+    if probe_rows is None:
+        probe_rows = join_mod.FUSED_PROBE_MAX_ROWS
+    if probe_rows <= 0:
+        # a config error, not an eligibility decision (same eager
+        # validation as inner_join_batches)
+        raise ValueError(f"probe_rows must be positive, got {probe_rows}")
+    if not packed_join_supported(left, right, on, right_on):
+        return None
+    n, m = left.row_count, right.row_count
+    if n == 0 or m == 0:
+        return None
+    bits = max(1, (m - 1).bit_length())
+    kw_l = keys_mod.column_order_keys(left.column(on[0]))[0]
+    kw_r = keys_mod.column_order_keys(right.column(right_on[0]))[0]
+    lo_l, hi_l = _minmax(kw_l)
+    lo_r, hi_r = _minmax(kw_r)
+    kmin = min(lo_l, lo_r)
+    span = max(hi_l, hi_r) - kmin
+    if span >= (1 << (64 - bits)) - 1:
+        return None
+    kmin_dev = jnp.uint64(kmin)
+
+    sorted_packed, perm_r = _build_fn(bits)(kw_r, kmin_dev)
+    probe = _probe_fn(bits)
+    out_row_bytes = hbm.row_bytes(left) + hbm.row_bytes(right)
+    chunk_out_budget = max(
+        probe_rows * 2 * out_row_bytes, join_mod.MIN_CHUNK_OUT_BYTES
+    )
+    pieces = []
+    spans = deque(
+        (s, min(s + probe_rows, n)) for s in range(0, n, probe_rows)
+    )
+    while spans:
+        start, stop = spans.popleft()
+        lo, counts, total_dev = probe(
+            sorted_packed, kw_l[start:stop], kmin_dev
+        )
+        total = int(total_dev)
+        if total == 0:
+            continue
+        cap = max(32, 1 << (total - 1).bit_length())
+        if cap * out_row_bytes > chunk_out_budget and stop - start > 1024:
+            mid = (start + stop) // 2
+            spans.appendleft((mid, stop))
+            spans.appendleft((start, mid))
+            continue
+        chunk = slice_rows(left, start, stop)
+        padded = _materialize_fn(tuple(right_on), cap)(
+            perm_r, lo, counts, chunk, right
+        )
+        pieces.append(slice_rows(padded, 0, total))
+    if not pieces:
+        # zero matches: the empty joined schema, built directly
+        z = jnp.zeros((0,), jnp.int32)
+        return _join_output(
+            slice_rows(left, 0, 0), right, list(right_on), z, z, None,
+            None,
+        )
+    return concatenate(pieces) if len(pieces) > 1 else pieces[0]
+
+
+@jax.jit
+def _minmax_jit(kw):
+    return jnp.min(kw), jnp.max(kw)
+
+
+def _minmax(kw):
+    lo, hi = _minmax_jit(kw)
+    return int(lo), int(hi)

@@ -1,0 +1,120 @@
+"""Packed-key batched join (ops/join_packed.py) vs the general join
+oracle: randomized equivalence, out-of-range probe keys, chunked
+probing, eligibility fallbacks."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.ops.join_packed import (
+    inner_join_batched_packed,
+    packed_join_supported,
+)
+
+
+def _pairs(t):
+    cols = [c.to_pylist() for c in t.columns]
+    return sorted(zip(*cols))
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("seed,probe_rows", [(0, 1 << 20), (1, 97), (2, 256)])
+    def test_randomized(self, seed, probe_rows):
+        rng = np.random.default_rng(seed)
+        nl, nr = 700, 500
+        # probe keys deliberately extend BELOW and ABOVE the build range
+        kl = rng.integers(-50, 120, nl, dtype=np.int64)
+        kr = rng.integers(0, 90, nr, dtype=np.int64)
+        left = Table(
+            [Column.from_numpy(kl),
+             Column.from_numpy(np.arange(nl, dtype=np.int64))],
+            ["k", "lv"],
+        )
+        right = Table(
+            [Column.from_numpy(kr),
+             Column.from_numpy(np.arange(nr, dtype=np.int64))],
+            ["k", "rv"],
+        )
+        got = inner_join_batched_packed(
+            left, right, ["k"], probe_rows=probe_rows
+        )
+        assert got is not None
+        want = inner_join(left, right, ["k"])
+        assert got.names == want.names
+        assert _pairs(got) == _pairs(want)
+
+    def test_zero_matches_keeps_schema(self):
+        left = Table(
+            [Column.from_numpy(np.array([1, 2], np.int64)),
+             Column.from_numpy(np.array([9, 9], np.int64))],
+            ["k", "lv"],
+        )
+        right = Table(
+            [Column.from_numpy(np.array([5, 6], np.int64)),
+             Column.from_numpy(np.array([7, 7], np.int64))],
+            ["k", "rv"],
+        )
+        got = inner_join_batched_packed(left, right, ["k"])
+        assert got is not None
+        assert got.row_count == 0
+        assert got.names == inner_join(left, right, ["k"]).names
+
+    def test_negative_and_timestamp_like_keys(self):
+        rng = np.random.default_rng(3)
+        kl = rng.integers(-(1 << 40), 1 << 40, 400, dtype=np.int64)
+        kr = np.concatenate([kl[:100], rng.integers(-(1 << 40), 1 << 40, 200, dtype=np.int64)])
+        left = Table([Column.from_numpy(kl)], ["k"])
+        right = Table([Column.from_numpy(kr)], ["k"])
+        got = inner_join_batched_packed(left, right, ["k"], probe_rows=128)
+        assert got is not None
+        want = inner_join(left, right, ["k"])
+        assert _pairs(got) == _pairs(want)
+
+
+class TestEligibility:
+    def test_wide_span_declines(self):
+        kl = np.array([0, 1 << 62], np.int64)
+        left = Table([Column.from_numpy(kl)], ["k"])
+        right = Table([Column.from_numpy(np.arange(8, dtype=np.int64))], ["k"])
+        assert inner_join_batched_packed(left, right, ["k"]) is None
+
+    def test_null_key_declines(self):
+        k = np.arange(8, dtype=np.int64)
+        v = np.ones(8, bool)
+        v[0] = False
+        left = Table([Column.from_numpy(k, validity=v)], ["k"])
+        right = Table([Column.from_numpy(k)], ["k"])
+        assert not packed_join_supported(left, right, ["k"], ["k"])
+
+    def test_multi_key_declines(self):
+        k = np.arange(8, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(k)], ["a", "b"])
+        assert not packed_join_supported(t, t, ["a", "b"], ["a", "b"])
+
+
+def test_probe_rows_zero_raises():
+    k = np.arange(8, dtype=np.int64)
+    t = Table([Column.from_numpy(k)], ["k"])
+    with pytest.raises(ValueError, match="probe_rows"):
+        inner_join_batched_packed(t, t, ["k"], probe_rows=0)
+
+
+def test_heavy_hitter_resplits():
+    # one build key duplicated heavily: the chunk output budget must
+    # force span re-splitting instead of one giant materialization
+    from spark_rapids_jni_tpu.ops import join_packed as jp
+    nl = 8192
+    left = Table(
+        [Column.from_numpy(np.zeros(nl, np.int64)),
+         Column.from_numpy(np.arange(nl, dtype=np.int64))],
+        ["k", "lv"],
+    )
+    right = Table(
+        [Column.from_numpy(np.zeros(64, np.int64)),
+         Column.from_numpy(np.arange(64, dtype=np.int64))],
+        ["k", "rv"],
+    )
+    got = inner_join_batched_packed(left, right, ["k"], probe_rows=nl)
+    assert got is not None
+    assert got.row_count == nl * 64
