@@ -50,7 +50,7 @@ import jax.numpy as jnp
 from ..column import Table
 from . import keys as keys_mod
 from .groupby_packed import _key_supported
-from .join import _expand, _join_output
+from .join import _join_output
 
 
 def packed_join_supported(
@@ -99,19 +99,6 @@ def _probe_fn(bits: int):
     return jax.jit(fn)
 
 
-@functools.lru_cache(maxsize=64)
-def _materialize_fn(right_on: tuple, cap: int):
-    def fn(perm_r, lo, counts, chunk, r):
-        left_idx, right_idx, _, _ = _expand(
-            perm_r, lo, counts, cap, left_outer=False
-        )
-        return _join_output(
-            chunk, r, list(right_on), left_idx, right_idx, None, None
-        )
-
-    return jax.jit(fn)
-
-
 def inner_join_batched_packed(
     left: Table,
     right: Table,
@@ -136,7 +123,23 @@ def inner_join_batched_packed(
 
     right_on = right_on or on
     if probe_rows is None:
-        probe_rows = join_mod.FUSED_PROBE_MAX_ROWS
+        # size from the HBM budget like the general wrapper, bounded by
+        # the live fault fence (wide tables shrink the chunk; the plan
+        # also carries the over-budget warning)
+        plan = hbm.join_plan(left, right, on, right_on)
+        if not plan["fits"]:
+            import warnings
+
+            warnings.warn(
+                "join inputs exceed the HBM budget before any probe "
+                f"chunk ({plan['fixed_bytes']} fixed vs "
+                f"{plan['budget_bytes']} budget); expect allocator "
+                "pressure.",
+                stacklevel=2,
+            )
+        probe_rows = min(
+            join_mod.FUSED_PROBE_MAX_ROWS, plan["probe_rows"]
+        )
     if probe_rows <= 0:
         # a config error, not an eligibility decision (same eager
         # validation as inner_join_batches)
@@ -182,7 +185,7 @@ def inner_join_batched_packed(
             spans.appendleft((start, mid))
             continue
         chunk = slice_rows(left, start, stop)
-        padded = _materialize_fn(tuple(right_on), cap)(
+        padded = join_mod._batched_materialize_fn(tuple(right_on), cap)(
             perm_r, lo, counts, chunk, right
         )
         pieces.append(slice_rows(padded, 0, total))

@@ -276,7 +276,20 @@ def cast(col: Column, to: dt.DType) -> Column:
             return _format_bool(col)
         if col.dtype.is_integer:
             return _format_int(col)
-        # floats/decimals: host formatting pass
+        if (
+            col.dtype.is_decimal
+            and col.dtype.id != dt.TypeId.DECIMAL128
+            and -19 <= col.dtype.scale <= 0
+        ):
+            # scale floor -19: the 23-byte device row fits sign + 20
+            # digits + point only down there, and every u64 magnitude
+            # keeps its top digit inside the 20-slot extraction
+            # device path (the TPC-DS price/amount case); DECIMAL128
+            # needs the 128-bit limb digit extraction and positive
+            # scales are a host corner
+            return _format_decimal(col)
+        # floats (shortest round-trip repr needs a Ryu-style kernel)
+        # and the decimal corners above: host formatting pass
         return _format_host(col)
     raise TypeError(f"not a string cast: {col.dtype} -> {to}")
 
@@ -587,6 +600,53 @@ def _format_int(col: Column) -> Column:
     chars = jnp.take_along_axis(digs, digit_idx, axis=1) + ord("0")
     out = jnp.where(
         neg[:, None] & (j == 0), ord("-"), chars
+    )
+    out = jnp.where(j < lens[:, None], out, 0).astype(jnp.uint8)
+    return Column(out, dt.STRING, col.validity, lens.astype(jnp.int32))
+
+
+def _format_decimal(col: Column) -> Column:
+    """DECIMAL32/64 -> STRING fully on device (scale <= 0): the int
+    formatter's digit extraction plus a decimal point inserted ``-scale``
+    digits from the right, integer part zero-padded to at least one
+    digit — byte-identical to the host formatter's
+    ``str(abs(u)).rjust(-s+1, '0')[: s] + '.' + [s:]`` shape."""
+    s = col.dtype.scale
+    d = -s
+    if d == 0:
+        return _format_int(col)
+    v = compute.values(col).astype(jnp.int64)
+    neg = v < 0
+    mag = jnp.where(
+        neg, (~v.astype(jnp.uint64)) + jnp.uint64(1), v.astype(jnp.uint64)
+    )
+    K = 20
+    pows = jnp.asarray([np.uint64(10) ** np.uint64(k) for k in range(K)])
+    digs = ((mag[:, None] // pows[None, :]) % jnp.uint64(10)).astype(
+        jnp.uint8
+    )
+    ndig = jnp.maximum(
+        jnp.sum((mag[:, None] >= pows[None, :]).astype(jnp.int32), axis=1),
+        1,
+    )
+    int_digits = jnp.maximum(ndig - d, 1)
+    lens = neg.astype(jnp.int32) + int_digits + 1 + d
+    width = K + 3  # sign + up to K digits + point + slack
+    j = jnp.arange(width)[None, :]
+    p = j - neg.astype(jnp.int32)[:, None]  # position after the sign
+    point_at = int_digits[:, None]
+    # digit index (10^k, least-significant-first) per output position:
+    # integer part counts down from int_digits-1+d; fraction part from
+    # d-1 after the point
+    int_idx = int_digits[:, None] - 1 - p + d
+    frac_idx = d - 1 - (p - point_at - 1)
+    digit_idx = jnp.clip(
+        jnp.where(p < point_at, int_idx, frac_idx), 0, K - 1
+    )
+    chars = jnp.take_along_axis(digs, digit_idx, axis=1) + ord("0")
+    out = jnp.where(p == point_at, ord("."), chars)
+    out = jnp.where(
+        neg[:, None] & (j == 0), ord("-"), out
     )
     out = jnp.where(j < lens[:, None], out, 0).astype(jnp.uint8)
     return Column(out, dt.STRING, col.validity, lens.astype(jnp.int32))

@@ -100,10 +100,14 @@ def test_probe_rows_zero_raises():
         inner_join_batched_packed(t, t, ["k"], probe_rows=0)
 
 
-def test_heavy_hitter_resplits():
+def test_heavy_hitter_resplits(monkeypatch):
     # one build key duplicated heavily: the chunk output budget must
-    # force span re-splitting instead of one giant materialization
-    from spark_rapids_jni_tpu.ops import join_packed as jp
+    # force span re-splitting instead of one giant materialization —
+    # and the re-split pieces must come back in exact row order
+    from spark_rapids_jni_tpu.ops import join as join_mod
+
+    # shrink the budget floor so cap * out_row_bytes really exceeds it
+    monkeypatch.setattr(join_mod, "MIN_CHUNK_OUT_BYTES", 1 << 10)
     nl = 8192
     left = Table(
         [Column.from_numpy(np.zeros(nl, np.int64)),
@@ -118,3 +122,8 @@ def test_heavy_hitter_resplits():
     got = inner_join_batched_packed(left, right, ["k"], probe_rows=nl)
     assert got is not None
     assert got.row_count == nl * 64
+    # exact sequence (not just multiset): probe-row-major like the
+    # fused single-shot join
+    want = inner_join(left, right, ["k"])
+    assert got["lv"].to_pylist() == want["lv"].to_pylist()
+    assert got["rv"].to_pylist() == want["rv"].to_pylist()
