@@ -316,6 +316,14 @@ def bench_sort_gather(platform, n=100_000_000):
     return _bench_sort_formulation(platform, n, "gather")
 
 
+def bench_sort_packed(platform, n=100_000_000):
+    """Config 3b third arm: the packed formulation (sort_packed.py) —
+    key word, iota AND the key column's payload in ONE u64 (16 B/row of
+    operands vs the payload form's 24; bench keys span [0,1e8) < 2^37
+    so the shape is eligible)."""
+    return _bench_sort_formulation(platform, n, "packed")
+
+
 def _bench_sort_formulation(platform, n, form):
     import jax
 
@@ -334,6 +342,13 @@ def _bench_sort_formulation(platform, n, form):
     jax.block_until_ready(t.columns[0].data)
     if form == "payload":
         sort_fn = jax.jit(lambda tt: sort_table(tt, [SortKey("k")]))
+    elif form == "packed":
+        from spark_rapids_jni_tpu.ops.sort_packed import sort_table_packed
+
+        def sort_fn(tt):
+            out = sort_table_packed(tt, [SortKey("k")])
+            assert out is not None, "packed sort declined the bench shape"
+            return out
     else:
         sort_fn = jax.jit(
             lambda tt: gather_table(tt, argsort_table(tt, [SortKey("k")]))
@@ -911,6 +926,7 @@ _SUBPROCESS_CONFIGS = {
     "join_batched_packed": bench_join_batched_packed,
     "sort": bench_sort,
     "sort_gather": bench_sort_gather,
+    "sort_packed": bench_sort_packed,
     "chunk_sort_ab": bench_chunk_sort_ab,
     "strings": bench_strings,
     "resident": bench_resident_chain,
@@ -931,7 +947,7 @@ _LADDER = (
     "chunk_sort_ab",
     "strings", "transpose", "resident", "parquet", "parquet_device",
     "groupby100m_packed", "groupby100m_chunked", "groupby100m", "sort",
-    "sort_gather",
+    "sort_packed", "sort_gather",
     "join_batched", "join_batched_packed", "tpcds", "tpcds10",
 )
 

@@ -122,25 +122,7 @@ def inner_join_batched_packed(
     from .copying import concatenate, slice_rows
 
     right_on = right_on or on
-    if probe_rows is None:
-        # size from the HBM budget like the general wrapper, bounded by
-        # the live fault fence (wide tables shrink the chunk; the plan
-        # also carries the over-budget warning)
-        plan = hbm.join_plan(left, right, on, right_on)
-        if not plan["fits"]:
-            import warnings
-
-            warnings.warn(
-                "join inputs exceed the HBM budget before any probe "
-                f"chunk ({plan['fixed_bytes']} fixed vs "
-                f"{plan['budget_bytes']} budget); expect allocator "
-                "pressure.",
-                stacklevel=2,
-            )
-        probe_rows = min(
-            join_mod.FUSED_PROBE_MAX_ROWS, plan["probe_rows"]
-        )
-    if probe_rows <= 0:
+    if probe_rows is not None and probe_rows <= 0:
         # a config error, not an eligibility decision (same eager
         # validation as inner_join_batches)
         raise ValueError(f"probe_rows must be positive, got {probe_rows}")
@@ -159,6 +141,31 @@ def inner_join_batched_packed(
     if span >= (1 << (64 - bits)) - 1:
         return None
     kmin_dev = jnp.uint64(kmin)
+    if probe_rows is None:
+        # HBM-budget chunk sizing with THIS path's resident set — the
+        # general plan models a 20 B/build-row word+perm set, but the
+        # packed build holds one u64 + an int32 perm (12 B/row); sized
+        # here, AFTER eligibility, so ineligible joins neither pay the
+        # plan nor double-warn on fallback
+        budget = hbm.budget_bytes()
+        fixed = hbm.table_bytes(left) + hbm.table_bytes(right) + 12 * m
+        out_row = hbm.row_bytes(left) + hbm.row_bytes(right)
+        per_probe_row = hbm.row_bytes(left) + 8 + 2 * out_row
+        avail = budget - fixed
+        if avail <= 0:
+            import warnings
+
+            warnings.warn(
+                "join inputs exceed the HBM budget before any probe "
+                f"chunk ({fixed} fixed vs {budget} budget); expect "
+                "allocator pressure. Raise SPARK_RAPIDS_TPU_HBM_"
+                "BUDGET_GB if the chip really has more.",
+                stacklevel=2,
+            )
+        probe_rows = min(
+            join_mod.FUSED_PROBE_MAX_ROWS,
+            max(1024, avail // max(per_probe_row, 1)),
+        )
 
     sorted_packed, perm_r = _build_fn(bits)(kw_r, kmin_dev)
     probe = _probe_fn(bits)
