@@ -244,7 +244,7 @@ def arrow_baseline(n):
     return n / best
 
 
-def bench_transpose(platform, n=4_000_000, n_inputs=2):
+def bench_transpose(platform, n=4_000_000, n_inputs=2, backend="xla"):
     """Config 2: to_rows -> from_rows -> cast+binaryop on the result.
 
     The CudfColumnVector round-trip shape: an 8-column fixed-width table
@@ -290,16 +290,30 @@ def bench_transpose(platform, n=4_000_000, n_inputs=2):
     inputs = [(make_table(),) for _ in range(n_inputs)]
 
     def round_trip(t):
-        batches = rows_mod.to_rows(t, split=False)
-        back = rows_mod.from_rows(batches, schema)
+        batches = rows_mod.to_rows(t, split=False, backend=backend)
+        back = rows_mod.from_rows(batches, schema, backend=backend)
         c = cast_fn(back.columns[0], dt.FLOAT64)
         return binaryop.add(c, back.columns[1])
 
     med, mn, std, out = _timeit(round_trip, inputs)
     # pack writes + unpack reads the packed bytes, plus column reads/writes
     bytes_moved = n * layout.row_size * 2
-    return _entry(2, "transpose_cast_round_trip", n, med, mn, std,
-                  bytes_moved, platform)
+    # default arm keeps the historical unsuffixed name (BASELINE.json
+    # published rows are keyed by entry name; only the new arm suffixes)
+    name = (
+        "transpose_cast_round_trip"
+        if backend == "xla"
+        else f"transpose_cast_round_trip_{backend}"
+    )
+    return _entry(2, name, n, med, mn, std, bytes_moved, platform)
+
+
+def bench_transpose_pallas(platform, n=4_000_000, n_inputs=2):
+    """Config 2 A/B arm: the explicit VMEM-tiled Pallas transpose pair
+    (kernels/row_transpose.py) vs the XLA-fused default — r3 measured
+    the XLA path at 1.54s/4M rows (~1 GB/s effective), far below what a
+    tiled byte repack should do; this decides the default backend."""
+    return bench_transpose(platform, n, n_inputs, backend="pallas")
 
 
 def bench_sort(platform, n=100_000_000):
@@ -921,6 +935,7 @@ _SUBPROCESS_CONFIGS = {
     "groupby16m_packed": lambda p: bench_groupby_packed(p, 16_000_000),
     "groupby16m_chunked": lambda p: bench_groupby_chunked(p, 16_000_000),
     "transpose": bench_transpose,
+    "transpose_pallas": bench_transpose_pallas,
     "join": bench_join,
     "join_batched": bench_join_batched,
     "join_batched_packed": bench_join_batched_packed,
@@ -945,7 +960,8 @@ _SUBPROCESS_CONFIGS = {
 _LADDER = (
     "groupby1m", "groupby16m_packed", "groupby16m_chunked", "groupby16m",
     "chunk_sort_ab",
-    "strings", "transpose", "resident", "parquet", "parquet_device",
+    "strings", "transpose", "transpose_pallas", "resident", "parquet",
+    "parquet_device",
     "groupby100m_packed", "groupby100m_chunked", "groupby100m", "sort",
     "sort_packed", "sort_gather",
     "join_batched", "join_batched_packed", "tpcds", "tpcds10",

@@ -25,7 +25,7 @@ BASELINE = os.path.join(REPO, "BASELINE.json")
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--round", type=int, default=5)
     ap.add_argument("--dry-run", action="store_true")
     args = ap.parse_args()
 
