@@ -225,6 +225,59 @@ def bench_groupby_packed(platform, n=100_000_000, n_inputs=2):
     )
 
 
+def bench_groupby_highcard(platform, n=100_000_000, n_keys=50_000_000):
+    """High-cardinality A/B in one config: the general single-pass
+    capped groupby vs the FLAT packed formulation on the same 50M-key
+    shape (per-chunk dedup can't win here; the question is whether the
+    one-narrow-word sort beats the multi-word single-pass sort)."""
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import (
+        GroupbyAgg,
+        groupby_aggregate_capped,
+    )
+    from spark_rapids_jni_tpu.ops.groupby_packed import (
+        groupby_aggregate_packed_flat,
+    )
+
+    rng = np.random.default_rng(44)
+    k = rng.integers(0, n_keys, n, dtype=np.int64)
+    v = rng.integers(-1000, 1000, n, dtype=np.int64)
+    t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+    jax.block_until_ready(t.columns[0].data)
+    aggs = [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")]
+    want_total = int(v.sum())
+
+    single = jax.jit(
+        lambda tt: groupby_aggregate_capped(
+            tt, ["k"], aggs, num_segments=n_keys
+        )
+    )
+    med_s, mn_s, std_s, out_s = _timeit(single, [(t,)], reps_per_input=2)
+    agg_s, ng_s = out_s
+    tot = int(np.asarray(agg_s["sum_v"].data)[: int(ng_s)].sum())
+    assert tot == want_total, "single-pass highcard sum mismatch"
+
+    flat = jax.jit(
+        lambda tt: groupby_aggregate_packed_flat(
+            tt, ["k"], aggs, num_segments=n_keys
+        )
+    )
+    med_f, mn_f, std_f, out_f = _timeit(flat, [(t,)], reps_per_input=2)
+    agg_f, ng_f, ov = out_f
+    assert not bool(ov), "flat packed overflow"
+    tot = int(np.asarray(agg_f["sum_v"].data)[: int(ng_f)].sum())
+    assert tot == want_total, "flat packed highcard sum mismatch"
+
+    e1 = _entry(1, f"groupby_highcard_{n // 1_000_000}M_single", n,
+                med_s, mn_s, std_s, n * 16, platform)
+    e2 = _entry(1, f"groupby_highcard_{n // 1_000_000}M_packed_flat", n,
+                med_f, mn_f, std_f, n * 16, platform)
+    e2["vs_single"] = round(med_s / med_f, 2)
+    return [e1, e2]
+
+
 def arrow_baseline(n):
     """CPU Arrow groupby throughput (rows/s) on the config-1 shape."""
     try:
@@ -932,6 +985,7 @@ _SUBPROCESS_CONFIGS = {
     "groupby100m": lambda p: bench_groupby(p, 100_000_000)[0],
     "groupby100m_chunked": bench_groupby_chunked,
     "groupby100m_packed": bench_groupby_packed,
+    "groupby_highcard": bench_groupby_highcard,
     "groupby16m_packed": lambda p: bench_groupby_packed(p, 16_000_000),
     "groupby16m_chunked": lambda p: bench_groupby_chunked(p, 16_000_000),
     "transpose": bench_transpose,
@@ -962,7 +1016,8 @@ _LADDER = (
     "chunk_sort_ab",
     "strings", "transpose", "transpose_pallas", "resident", "parquet",
     "parquet_device",
-    "groupby100m_packed", "groupby100m_chunked", "groupby100m", "sort",
+    "groupby100m_packed", "groupby100m_chunked", "groupby100m",
+    "groupby_highcard", "sort",
     "sort_packed", "sort_gather",
     "join_batched", "join_batched_packed", "tpcds", "tpcds10",
 )

@@ -85,10 +85,13 @@ def _unkey(word: jax.Array, d) -> jax.Array:
 def packed_groupby_supported(
     table: Table, by: Sequence, aggs: Sequence[GroupbyAgg]
 ) -> bool:
-    """Static eligibility (range fitting is checked separately)."""
-    if len(by) != 1:
+    """Static eligibility (range fitting is checked separately).
+    Multi-key shapes are eligible when EVERY key is integer-family and
+    no-null — the composite word packs them as bit fields (TPC-DS q64
+    groups by (brand, state, year): three narrow fields)."""
+    if not by:
         return False
-    if not _key_supported(table.column(by[0])):
+    if not all(_key_supported(table.column(k)) for k in by):
         return False
     for a in aggs:
         if a.op not in DECOMPOSABLE_OPS:
@@ -149,6 +152,7 @@ def groupby_aggregate_packed_chunked(
     num_segments: int,
     chunk_rows: int = 1 << 18,
     chunk_segments: int = 1 << 14,
+    field_bits: Optional[tuple] = None,
 ) -> tuple[Table, jax.Array, jax.Array, jax.Array]:
     """Jittable packed two-level groupby.
 
@@ -156,19 +160,14 @@ def groupby_aggregate_packed_chunked(
     max_per_chunk_groups, overflow)``. EXACT iff ``overflow`` is False
     (key range fit both packing levels) and ``max_per_chunk_groups <=
     chunk_segments`` — callers must check both (the eager router does).
+
+    ``field_bits`` (STATIC, one entry per key column) packs multiple
+    narrow keys as bit fields of one composite word, lexicographic key
+    order == numeric composite order. Required for multi-key shapes
+    (the eager router measures spans and supplies it); the single-key
+    default packs the one key into the whole word above the iota.
     """
-    if not packed_groupby_supported(table, by, aggs):
-        raise ValueError(
-            "packed groupby: single no-null integer-family key and "
-            "no-null decomposable value columns required"
-        )
-    key_names = [
-        c
-        if isinstance(c, str)
-        else (table.names[c] if table.names else "key0")
-        for c in by
-    ]
-    kcol = table.column(by[0])
+    key_names, key_cols = _validate_and_names(table, by, aggs, field_bits)
     n = table.row_count
     c = -(-n // chunk_rows)
     padded = c * chunk_rows
@@ -176,18 +175,15 @@ def groupby_aggregate_packed_chunked(
     p2_rows = c * (chunk_segments + 1)  # +1: per-chunk garbage slot
     iota_bits2 = max(1, (p2_rows - 1).bit_length())
 
-    kw = keys_mod.column_order_keys(kcol)[0]  # (n,) u64, order-preserving
-    kmin = jnp.min(kw)
-    rel = kw - kmin
-    rel_max = jnp.max(rel)
-    # both packing levels must fit strictly below the sentinel
-    fit1 = rel_max < (
-        (jnp.uint64(1) << jnp.uint64(64 - iota_bits)) - jnp.uint64(1)
+    # one fewer bit than either level leaves: both sentinels stay
+    # strictly above every packed word (single-key path mirrors the
+    # original fit1/fit2 pair; multi-key validates static widths)
+    allowed = (
+        64 - max(iota_bits, iota_bits2)
+        if field_bits is None
+        else 63 - max(iota_bits, iota_bits2)
     )
-    fit2 = rel_max < (
-        (jnp.uint64(1) << jnp.uint64(64 - iota_bits2)) - jnp.uint64(1)
-    )
-    overflow = jnp.logical_not(jnp.logical_and(fit1, fit2))
+    rel, kmins, overflow = _composite_rel(key_cols, field_bits, allowed)
 
     parts, plan = _plan(table, aggs)
     vals_in = [
@@ -298,13 +294,122 @@ def groupby_aggregate_packed_chunked(
         for ((_, op, _), sp) in zip(parts, sparts)
     ]
 
-    # reconstruct the key column from the segment-start order word
+    # reconstruct the key column(s) from the segment-start order word
     key_rel = skey2[jnp.clip(starts2, 0, p2_rows - 1)]
-    key_word = key_rel + kmin
-    key_storage = _unkey(key_word, kcol.dtype)
-    out_cols = [Column(key_storage, kcol.dtype, None)]
+    out_cols = _reconstruct_keys(key_rel, key_cols, kmins, field_bits)
     out_names = list(key_names)
+    out_cols, out_names = _assemble_output(
+        table, plan, finals, valid_out, out_cols, out_names
+    )
+    return (
+        Table(out_cols, out_names),
+        num_groups,
+        max_chunk,
+        overflow,
+    )
 
+
+def _validate_and_names(table, by, aggs, field_bits):
+    """Shared preamble of both packed kernels: eligibility, field_bits
+    arity, output key names, resolved key columns."""
+    if not packed_groupby_supported(table, by, aggs):
+        raise ValueError(
+            "packed groupby: no-null integer-family keys and no-null "
+            "decomposable value columns required"
+        )
+    if field_bits is None and len(by) != 1:
+        raise ValueError("multi-key packed groupby needs field_bits")
+    if field_bits is not None and len(field_bits) != len(by):
+        raise ValueError("field_bits must have one entry per key")
+    key_names = [
+        c
+        if isinstance(c, str)
+        else (table.names[c] if table.names else f"key{i}")
+        for i, c in enumerate(by)
+    ]
+    key_cols = [table.column(k) for k in by]
+    return key_names, key_cols
+
+
+def _slice_groups(out: Table, g: int) -> Table:
+    """The capped result trimmed to its exact group count."""
+    return Table(
+        [
+            Column(
+                col.data[:g],
+                col.dtype,
+                None if col.validity is None else col.validity[:g],
+                None if col.lengths is None else col.lengths[:g],
+            )
+            for col in out.columns
+        ],
+        out.names,
+    )
+
+
+def _composite_rel(key_cols, field_bits, allowed_bits: int):
+    """(rel composite u64 (n,), kmins, overflow): the relative key word
+    shared by the chunked and flat paths. ``allowed_bits`` is how many
+    high bits the packing level(s) leave for the key fields; the traced
+    overflow flag trips when data exceeds the declared widths."""
+    if field_bits is None:
+        kw = keys_mod.column_order_keys(key_cols[0])[0]
+        kmin = jnp.min(kw)
+        rel = kw - kmin
+        overflow = jnp.max(rel) >= (
+            (jnp.uint64(1) << jnp.uint64(allowed_bits)) - jnp.uint64(1)
+        )
+        return rel, [kmin], overflow
+    if sum(field_bits) > allowed_bits:
+        raise ValueError(
+            f"field_bits {field_bits} exceed the {allowed_bits} bits "
+            "this packing leaves; the router must decline this shape"
+        )
+    n = key_cols[0].data.shape[0]
+    rel = jnp.zeros((n,), jnp.uint64)
+    overflow = jnp.zeros((), jnp.bool_)
+    kmins = []
+    for kc, b in zip(key_cols, field_bits):
+        kwi = keys_mod.column_order_keys(kc)[0]
+        kmini = jnp.min(kwi)
+        kmins.append(kmini)
+        reli = kwi - kmini
+        overflow = jnp.logical_or(
+            overflow,
+            jnp.max(reli) >= (jnp.uint64(1) << jnp.uint64(b)),
+        )
+        rel = (rel << jnp.uint64(b)) | reli
+    return rel, kmins, overflow
+
+
+def _reconstruct_keys(key_rel, key_cols, kmins, field_bits):
+    """Key column(s) from the composite relative word at segment starts."""
+    out = []
+    if field_bits is None:
+        out.append(
+            Column(_unkey(key_rel + kmins[0], key_cols[0].dtype),
+                   key_cols[0].dtype, None)
+        )
+        return out
+    # peel the composite fields back off, last key in the low bits
+    shift = 0
+    fields = []
+    for b in reversed(field_bits):
+        fields.append(
+            (key_rel >> jnp.uint64(shift))
+            & ((jnp.uint64(1) << jnp.uint64(b)) - jnp.uint64(1))
+        )
+        shift += b
+    fields.reverse()
+    for kc, kmini, f in zip(key_cols, kmins, fields):
+        out.append(Column(_unkey(f + kmini, kc.dtype), kc.dtype, None))
+    return out
+
+
+def _assemble_output(table, plan, finals, valid_out, out_cols, out_names):
+    """User-facing agg columns, schema-identical to the single-pass
+    path (count INT64, float sums FLOAT64, min/max/first/last keep the
+    source dtype via from_values re-encoding)."""
     for op, a, main_i, count_i in plan:
         colref = a.column
         base = (
@@ -324,17 +429,11 @@ def groupby_aggregate_packed_chunked(
                 compute.from_values(mean, dt.FLOAT64, valid_out)
             )
         elif op == "count":
-            # INT64, matching the single-pass path (groupby.py count
-            # branch) — the packed path must be schema-interchangeable
             out_cols.append(Column(finals[main_i], dt.INT64, None))
         elif op == "sum":
             v = finals[main_i]
             if src.dtype.is_floating:
-                # f64 accumulation surfaces as FLOAT64 like the other
-                # paths (even for FLOAT32 inputs)
-                out_cols.append(
-                    compute.from_values(v, dt.FLOAT64, None)
-                )
+                out_cols.append(compute.from_values(v, dt.FLOAT64, None))
             elif src.dtype.is_decimal:
                 out_cols.append(
                     Column(
@@ -345,19 +444,12 @@ def groupby_aggregate_packed_chunked(
                 )
             else:
                 out_cols.append(Column(v, dt.INT64, None))
-        else:  # min / max / first / last keep the source dtype
-            # finals hold the ARITHMETIC view (f64 for FLOAT64 columns);
-            # from_values re-encodes storage (bit patterns for f64)
+        else:
             out_cols.append(
                 compute.from_values(finals[main_i], src.dtype, None)
             )
         out_names.append(out_name)
-    return (
-        Table(out_cols, out_names),
-        num_groups,
-        max_chunk,
-        overflow,
-    )
+    return out_cols, out_names
 
 
 def groupby_aggregate_packed(
@@ -378,42 +470,73 @@ def groupby_aggregate_packed(
         return None
     if not packed_groupby_supported(table, by, aggs):
         return None
-    kcol = table.column(by[0])
-    kw = keys_mod.column_order_keys(kcol)[0]
-    lo, hi = _minmax(kw)
-    span = int(hi) - int(lo)
+    spans = []
+    for k in by:
+        kw = keys_mod.column_order_keys(table.column(k))[0]
+        lo, hi = _minmax(kw)
+        spans.append(int(hi) - int(lo))
     c = -(-n // chunk_rows)
     iota_bits = max(1, (chunk_rows - 1).bit_length())
+    if len(by) == 1:
+        field_bits = None
+        span_bits = max(1, spans[0].bit_length())
+    else:
+        field_bits = tuple(
+            max(1, sp.bit_length()) for sp in spans
+        )
+        span_bits = sum(field_bits)
+    # cardinality proxy: the product of spans caps distinct keys
+    span_card = 1
+    for sp in spans:
+        span_card *= sp + 1
+        if span_card > n:
+            span_card = n
+            break
     if chunk_segments is None:
-        # worst-case distinct keys per chunk is bounded by the span+1
-        guess = min(chunk_rows, 1 << max(6, (span).bit_length()))
+        # worst-case distinct keys per chunk bounded by the span product
+        guess = min(
+            chunk_rows, 1 << max(6, (span_card - 1).bit_length())
+        )
         chunk_segments = min(guess, 1 << 14)
-    iota_bits2 = max(1, (c * chunk_segments - 1).bit_length())
-    limit = (1 << (64 - max(iota_bits, iota_bits2))) - 1
-    if span >= limit:
-        return None
-    if span + 1 > chunk_segments * 4 and span + 1 > chunk_rows:
-        # keys too spread for per-chunk dedup to win
-        return None
+    iota_bits2 = max(1, (c * (chunk_segments + 1) - 1).bit_length())
+    if field_bits is None:
+        limit = (1 << (64 - max(iota_bits, iota_bits2))) - 1
+        if spans[0] >= limit:
+            return None
+    else:
+        if span_bits + max(iota_bits, iota_bits2) > 63:
+            return None
+    if span_card > chunk_segments * 4 and span_card > chunk_rows:
+        # keys too spread for per-chunk dedup to win — but the FLAT
+        # packed sort (one narrow word over the whole column) still
+        # strictly beats the general single-pass sort's operand width
+        flat_iota = max(1, (n - 1).bit_length())
+        flat_allowed = (
+            64 - flat_iota if field_bits is None else 63 - flat_iota
+        )
+        if field_bits is None:
+            if spans[0] >= (1 << flat_allowed) - 1:
+                return None
+        elif span_bits > flat_allowed:
+            return None
+        # quantize the capacity knob: a raw data-dependent span_card
+        # would force one XLA recompile per observed key range
+        flat_cap = min(n, 1 << max(6, (span_card - 1).bit_length()))
+        out, ng, ov = _packed_flat_fn(
+            tuple(by), tuple(aggs), flat_cap, field_bits
+        )(table)
+        assert not bool(ov), "flat packed groupby overflow"
+        return _slice_groups(out, int(ng))
 
     for _ in range(2):
         out, num_groups, max_chunk, overflow = _jit_packed(
             table, tuple(by), tuple(aggs),
             min(c * chunk_segments, n), chunk_rows, chunk_segments,
+            field_bits,
         )
         assert not bool(overflow), "packed groupby range overflow"
         if int(max_chunk) <= chunk_segments:
-            g = int(num_groups)
-            cols = [
-                Column(
-                    col.data[:g],
-                    col.dtype,
-                    None if col.validity is None else col.validity[:g],
-                    None if col.lengths is None else col.lengths[:g],
-                )
-                for col in out.columns
-            ]
-            return Table(cols, out.names)
+            return _slice_groups(out, int(num_groups))
         if chunk_segments >= chunk_rows:
             break
         chunk_segments = min(
@@ -433,17 +556,91 @@ def _minmax(kw):
 
 
 @functools.lru_cache(maxsize=256)
-def _packed_fn(by, aggs, num_segments, chunk_rows, chunk_segments):
+def _packed_fn(by, aggs, num_segments, chunk_rows, chunk_segments,
+               field_bits):
     def fn(tbl):
         return groupby_aggregate_packed_chunked(
             tbl, list(by), list(aggs), num_segments, chunk_rows,
-            chunk_segments,
+            chunk_segments, field_bits,
         )
 
     return jax.jit(fn)
 
 
-def _jit_packed(table, by, aggs, num_segments, chunk_rows, chunk_segments):
-    return _packed_fn(by, aggs, num_segments, chunk_rows, chunk_segments)(
-        table
+def _jit_packed(table, by, aggs, num_segments, chunk_rows, chunk_segments,
+                field_bits=None):
+    return _packed_fn(
+        by, aggs, num_segments, chunk_rows, chunk_segments, field_bits
+    )(table)
+
+
+def groupby_aggregate_packed_flat(
+    table: Table,
+    by: Sequence[Union[int, str]],
+    aggs: Sequence[GroupbyAgg],
+    num_segments: int,
+    field_bits: Optional[tuple] = None,
+) -> tuple[Table, jax.Array, jax.Array]:
+    """Jittable SINGLE-LEVEL packed groupby — the high-cardinality arm.
+
+    When distinct keys rival the chunk size, per-chunk dedup buys
+    nothing and the two-level design only adds a combine pass; but the
+    packed sort is still strictly narrower than the general single-pass
+    sort (one u64 vs key words + iota + occupancy). This variant is that
+    single sort: pack, sort once over the whole column, segment-reduce.
+
+    Returns ``(padded result of num_segments rows, num_groups,
+    overflow)`` — EXACT iff ``overflow`` is False (key fields fit AND
+    num_groups <= num_segments; both folded into the flag)."""
+    key_names, key_cols = _validate_and_names(table, by, aggs, field_bits)
+    n = table.row_count
+    iota_bits = max(1, (n - 1).bit_length())
+    allowed = (
+        64 - iota_bits if field_bits is None else 63 - iota_bits
     )
+    rel, kmins, overflow = _composite_rel(key_cols, field_bits, allowed)
+
+    parts, plan = _plan(table, aggs)
+    vals_in = [
+        compute.values(table.column(colref)) for (_, _, colref) in parts
+    ]
+    packed = (rel << jnp.uint64(iota_bits)) | jnp.arange(
+        n, dtype=jnp.uint64
+    )
+    sorted_all = jax.lax.sort((packed,) + tuple(vals_in), num_keys=1)
+    skey = sorted_all[0] >> jnp.uint64(iota_bits)
+    svals = sorted_all[1:]
+
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), skey[1:] != skey[:-1]]
+    )
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    num_groups = seg[-1] + 1
+    overflow = jnp.logical_or(overflow, num_groups > num_segments)
+
+    from .groupby import _segment_bounds
+
+    starts, ends = _segment_bounds(seg, num_segments)
+    valid_out = jnp.arange(num_segments, dtype=jnp.int32) < num_groups
+    ends = jnp.where(valid_out, ends, starts)
+    finals = [
+        _segment_reduce(op, sv, seg, starts, ends)
+        for ((_, op, _), sv) in zip(parts, svals)
+    ]
+    key_rel = skey[jnp.clip(starts, 0, n - 1)]
+    out_cols = _reconstruct_keys(key_rel, key_cols, kmins, field_bits)
+    out_names = list(key_names)
+    out_cols, out_names = _assemble_output(
+        table, plan, finals, valid_out, out_cols, out_names
+    )
+    return Table(out_cols, out_names), num_groups, overflow
+
+
+@functools.lru_cache(maxsize=256)
+def _packed_flat_fn(by, aggs, num_segments, field_bits):
+    def fn(tbl):
+        return groupby_aggregate_packed_flat(
+            tbl, list(by), list(aggs), num_segments, field_bits
+        )
+
+    return jax.jit(fn)

@@ -173,11 +173,13 @@ class TestProtocol:
         assert not packed_groupby_supported(
             t_null_key, ["k"], [GroupbyAgg("v", "sum")]
         )
+        # multi-key INT shapes are eligible since the composite-field
+        # generalization (see TestMultiKey)
         t_two_keys = Table(
             [Column.from_numpy(k), Column.from_numpy(k), Column.from_numpy(v)],
             ["a", "b", "v"],
         )
-        assert not packed_groupby_supported(
+        assert packed_groupby_supported(
             t_two_keys, ["a", "b"], [GroupbyAgg("v", "sum")]
         )
         t_float_key = Table(
@@ -252,3 +254,183 @@ class TestBoundary:
         assert packed is not None
         for pc, sc in zip(packed.columns, single.columns):
             assert pc.dtype.id == sc.dtype.id, (pc.dtype, sc.dtype)
+
+
+class TestMultiKey:
+    """Composite bit-field packing: several narrow keys in one word."""
+
+    def _to_dict2(self, t, nk):
+        return _to_dict(t, n_keys=nk)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_two_keys_randomized(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 4000
+        # span product must stay below the router's chunking-wins bail
+        a = rng.integers(-4, 4, n, dtype=np.int64)
+        b = rng.integers(0, 6, n, dtype=np.int64)
+        v = rng.integers(-100, 100, n, dtype=np.int64)
+        t = Table(
+            [Column.from_numpy(a), Column.from_numpy(b),
+             Column.from_numpy(v)],
+            ["a", "b", "v"],
+        )
+        aggs = [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count"),
+                GroupbyAgg("v", "min")]
+        got = groupby_aggregate_packed(t, ["a", "b"], aggs, chunk_rows=256)
+        assert got is not None
+        want = groupby_aggregate(t, ["a", "b"], aggs)
+        gd = self._to_dict2(got, 2)
+        wd = self._to_dict2(want, 2)
+        assert gd == wd
+
+    def test_three_keys_tpcds_q64_shape(self):
+        # (brand, state, year): the q64 grouping key
+        rng = np.random.default_rng(7)
+        n = 6000
+        brand = rng.integers(1, 40, n, dtype=np.int64)
+        state = rng.integers(0, 8, n, dtype=np.int32)
+        year = rng.integers(1998, 2003, n, dtype=np.int64)
+        rev = rng.standard_normal(n)
+        import jax.numpy as jnp
+
+        t = Table(
+            [Column.from_numpy(brand),
+             Column(jnp.asarray(state), dt.INT32, None),
+             Column.from_numpy(year), Column.from_numpy(rev)],
+            ["brand", "state", "year", "rev"],
+        )
+        aggs = [GroupbyAgg("rev", "sum"), GroupbyAgg("rev", "count")]
+        got = groupby_aggregate_packed(
+            t, ["brand", "state", "year"], aggs, chunk_rows=1024
+        )
+        assert got is not None
+        want = groupby_aggregate(t, ["brand", "state", "year"], aggs)
+        gd = self._to_dict2(got, 3)
+        wd = self._to_dict2(want, 3)
+        assert gd.keys() == wd.keys()
+        for k in wd:
+            assert gd[k][1] == wd[k][1]
+            assert gd[k][0] == pytest.approx(wd[k][0], rel=1e-9)
+
+    def test_field_overflow_flagged(self):
+        # declared field too narrow for the data: traced flag, not
+        # silent corruption
+        k1 = np.array([0, 300, 5, 300], np.int64)  # needs 9 bits
+        k2 = np.array([0, 1, 2, 3], np.int64)
+        v = np.ones(4, np.int64)
+        t = Table(
+            [Column.from_numpy(k1), Column.from_numpy(k2),
+             Column.from_numpy(v)],
+            ["a", "b", "v"],
+        )
+        _, _, _, ov = groupby_aggregate_packed_chunked(
+            t, ["a", "b"], [GroupbyAgg("v", "sum")], num_segments=8,
+            chunk_rows=4, chunk_segments=8, field_bits=(4, 2),
+        )
+        assert bool(ov)
+
+    def test_wide_multi_key_declines(self):
+        rng = np.random.default_rng(9)
+        n = 1000
+        a = rng.integers(0, 1 << 40, n, dtype=np.int64)
+        b = rng.integers(0, 1 << 40, n, dtype=np.int64)
+        t = Table(
+            [Column.from_numpy(a), Column.from_numpy(b),
+             Column.from_numpy(np.ones(n, np.int64))],
+            ["a", "b", "v"],
+        )
+        assert (
+            groupby_aggregate_packed(
+                t, ["a", "b"], [GroupbyAgg("v", "sum")], chunk_rows=256
+            )
+            is None
+        )
+
+
+class TestFlatVariant:
+    """Single-level packed groupby: the high-cardinality arm."""
+
+    def test_matches_single_pass(self):
+        from spark_rapids_jni_tpu.ops.groupby_packed import (
+            groupby_aggregate_packed_flat,
+        )
+
+        rng = np.random.default_rng(11)
+        n = 5000
+        k = rng.integers(-2000, 2000, n, dtype=np.int64)
+        v = rng.integers(-50, 50, n, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        out, ng, ov = groupby_aggregate_packed_flat(
+            t, ["k"], AGGS, num_segments=4096
+        )
+        assert not bool(ov)
+        g = int(ng)
+        got = Table(
+            [Column(c.data[:g], c.dtype, None) for c in out.columns],
+            out.names,
+        )
+        want = groupby_aggregate(t, ["k"], AGGS)
+        _assert_equal(got, want)
+
+    def test_multi_key_flat(self):
+        from spark_rapids_jni_tpu.ops.groupby_packed import (
+            groupby_aggregate_packed_flat,
+        )
+
+        rng = np.random.default_rng(12)
+        n = 3000
+        a = rng.integers(0, 300, n, dtype=np.int64)
+        b = rng.integers(-20, 20, n, dtype=np.int64)
+        v = rng.integers(0, 9, n, dtype=np.int64)
+        t = Table(
+            [Column.from_numpy(a), Column.from_numpy(b),
+             Column.from_numpy(v)],
+            ["a", "b", "v"],
+        )
+        out, ng, ov = groupby_aggregate_packed_flat(
+            t, ["a", "b"], [GroupbyAgg("v", "sum")], num_segments=n,
+            field_bits=(9, 6),
+        )
+        assert not bool(ov)
+        g = int(ng)
+        got = {}
+        aa = np.asarray(out["a"].data)[:g]
+        bb = np.asarray(out["b"].data)[:g]
+        ss = np.asarray(out["sum_v"].data)[:g]
+        for i in range(g):
+            got[(int(aa[i]), int(bb[i]))] = int(ss[i])
+        want = {}
+        for x, y, z in zip(a.tolist(), b.tolist(), v.tolist()):
+            want[(x, y)] = want.get((x, y), 0) + z
+        assert got == want
+
+    def test_capacity_overflow_flagged(self):
+        from spark_rapids_jni_tpu.ops.groupby_packed import (
+            groupby_aggregate_packed_flat,
+        )
+
+        k = np.arange(100, dtype=np.int64)
+        t = Table(
+            [Column.from_numpy(k), Column.from_numpy(k)], ["k", "v"]
+        )
+        _, _, ov = groupby_aggregate_packed_flat(
+            t, ["k"], [GroupbyAgg("v", "sum")], num_segments=10
+        )
+        assert bool(ov)
+
+    def test_router_takes_flat_for_high_cardinality(self):
+        rng = np.random.default_rng(13)
+        n = 60_000
+        k = rng.integers(0, 50_000, n, dtype=np.int64)
+        v = rng.integers(-5, 5, n, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        got = groupby_aggregate_packed(
+            t, ["k"], [GroupbyAgg("v", "sum")], chunk_rows=2048
+        )
+        assert got is not None
+        wd = {}
+        for kk, vv in zip(k.tolist(), v.tolist()):
+            wd[kk] = wd.get(kk, 0) + vv
+        gd = dict(zip(got["k"].to_pylist(), got["sum_v"].to_pylist()))
+        assert gd == wd
