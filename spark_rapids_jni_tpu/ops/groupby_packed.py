@@ -365,8 +365,7 @@ def _composite_rel(key_cols, field_bits, allowed_bits: int):
             f"field_bits {field_bits} exceed the {allowed_bits} bits "
             "this packing leaves; the router must decline this shape"
         )
-    n = key_cols[0].data.shape[0]
-    rel = jnp.zeros((n,), jnp.uint64)
+    rels = []
     overflow = jnp.zeros((), jnp.bool_)
     kmins = []
     for kc, b in zip(key_cols, field_bits):
@@ -378,8 +377,8 @@ def _composite_rel(key_cols, field_bits, allowed_bits: int):
             overflow,
             jnp.max(reli) >= (jnp.uint64(1) << jnp.uint64(b)),
         )
-        rel = (rel << jnp.uint64(b)) | reli
-    return rel, kmins, overflow
+        rels.append(reli)
+    return keys_mod.fold_fields(rels, field_bits), kmins, overflow
 
 
 def _reconstruct_keys(key_rel, key_cols, kmins, field_bits):
@@ -391,16 +390,7 @@ def _reconstruct_keys(key_rel, key_cols, kmins, field_bits):
                    key_cols[0].dtype, None)
         )
         return out
-    # peel the composite fields back off, last key in the low bits
-    shift = 0
-    fields = []
-    for b in reversed(field_bits):
-        fields.append(
-            (key_rel >> jnp.uint64(shift))
-            & ((jnp.uint64(1) << jnp.uint64(b)) - jnp.uint64(1))
-        )
-        shift += b
-    fields.reverse()
+    fields = keys_mod.peel_fields(key_rel, field_bits)
     for kc, kmini, f in zip(key_cols, kmins, fields):
         out.append(Column(_unkey(f + kmini, kc.dtype), kc.dtype, None))
     return out

@@ -3,10 +3,12 @@
 The batched join (ops/join.py inner_join_batched) sorts the build side
 over (occupancy word, key order word) with a separate permutation iota
 riding the sort, then probes with a hand-rolled multi-word lexicographic
-binary search. When the single integer-family key's VALUE RANGE fits in
-``64 - log2(build_rows)`` bits — which covers every dictionary-coded,
-date, or sequential-id join key — the same trick as the packed groupby
-(ops/groupby_packed.py) collapses all of it into one u64 word::
+binary search. When the integer-family keys' combined VALUE RANGES fit
+in ``64 - log2(build_rows)`` bits — every dictionary-coded, date, or
+sequential-id join key, alone or composed (the q64 shape joins on
+(item_sk, ticket_number): two narrow fields) — the same trick as the
+packed groupby (ops/groupby_packed.py) collapses all of it into one
+u64 word::
 
     build:  sorted = lax.sort( (key - kmin) << bits | build_iota )   # ONE array
     perm:   sorted & mask                                            # free
@@ -56,20 +58,32 @@ from .join import _join_output
 def packed_join_supported(
     left: Table, right: Table, on: Sequence, right_on: Sequence
 ) -> bool:
-    if len(on) != 1 or len(right_on) != 1:
+    """Every key pair integer-family and no-null on both sides —
+    multi-key pairs pack as composite bit fields (the q64 shape joins
+    on (item_sk, ticket_number))."""
+    if not on or len(on) != len(right_on):
         return False
-    return _key_supported(left.column(on[0])) and _key_supported(
-        right.column(right_on[0])
+    return all(
+        _key_supported(left.column(lk)) and _key_supported(right.column(rk))
+        for lk, rk in zip(on, right_on)
+    )
+
+
+def _composite(kws, kmins, field_bits):
+    """Composite relative word over parallel key-word arrays (shared
+    kmins across both join sides; fields validated by the caller)."""
+    return keys_mod.fold_fields(
+        [kw - kmin for kw, kmin in zip(kws, kmins)], field_bits
     )
 
 
 @functools.lru_cache(maxsize=64)
-def _build_fn(bits: int):
+def _build_fn(bits: int, field_bits: tuple):
     mask = jnp.uint64((1 << bits) - 1)
 
-    def fn(kw_r, kmin):
-        m = kw_r.shape[0]
-        rel = kw_r - kmin
+    def fn(kws_r, kmins):
+        m = kws_r[0].shape[0]
+        rel = _composite(kws_r, kmins, field_bits)
         iota = jnp.arange(m, dtype=jnp.uint64)
         (sorted_packed,) = jax.lax.sort(
             ((rel << jnp.uint64(bits)) | iota,), num_keys=1
@@ -82,11 +96,13 @@ def _build_fn(bits: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _probe_fn(bits: int):
+def _probe_fn(bits: int, field_bits: tuple):
     mask = jnp.uint64((1 << bits) - 1)
 
-    def fn(sorted_packed, kw_chunk, kmin):
-        base = (kw_chunk - kmin) << jnp.uint64(bits)
+    def fn(sorted_packed, kws_chunk, kmins):
+        base = _composite(kws_chunk, kmins, field_bits) << jnp.uint64(
+            bits
+        )
         lo = jnp.searchsorted(
             sorted_packed, base, side="left"
         ).astype(jnp.int32)
@@ -132,15 +148,28 @@ def inner_join_batched_packed(
     if n == 0 or m == 0:
         return None
     bits = max(1, (m - 1).bit_length())
-    kw_l = keys_mod.column_order_keys(left.column(on[0]))[0]
-    kw_r = keys_mod.column_order_keys(right.column(right_on[0]))[0]
-    lo_l, hi_l = _minmax(kw_l)
-    lo_r, hi_r = _minmax(kw_r)
-    kmin = min(lo_l, lo_r)
-    span = max(hi_l, hi_r) - kmin
-    if span >= (1 << (64 - bits)) - 1:
+    kws_l = [
+        keys_mod.column_order_keys(left.column(k))[0] for k in on
+    ]
+    kws_r = [
+        keys_mod.column_order_keys(right.column(k))[0] for k in right_on
+    ]
+    kmins = []
+    field_bits = []
+    for kl, kr in zip(kws_l, kws_r):
+        lo_l, hi_l = _minmax(kl)
+        lo_r, hi_r = _minmax(kr)
+        kmin = min(lo_l, lo_r)
+        kmins.append(kmin)
+        field_bits.append(
+            max(1, (max(hi_l, hi_r) - kmin).bit_length())
+        )
+    if sum(field_bits) + bits > 64:
+        # no sentinel here (unlike the groupby's padding slot): the
+        # full 64 bits are usable
         return None
-    kmin_dev = jnp.uint64(kmin)
+    field_bits = tuple(field_bits)
+    kmins_dev = jnp.asarray(kmins, dtype=jnp.uint64)
     if probe_rows is None:
         # HBM-budget chunk sizing with THIS path's resident set — the
         # general plan models a 20 B/build-row word+perm set, but the
@@ -148,7 +177,12 @@ def inner_join_batched_packed(
         # here, AFTER eligibility, so ineligible joins neither pay the
         # plan nor double-warn on fallback
         budget = hbm.budget_bytes()
-        fixed = hbm.table_bytes(left) + hbm.table_bytes(right) + 12 * m
+        nk = len(on)
+        fixed = (
+            hbm.table_bytes(left) + hbm.table_bytes(right)
+            + 12 * m          # packed build word + int32 perm
+            + 8 * nk * (n + m)  # both sides' key-word arrays, live
+        )
         out_row = hbm.row_bytes(left) + hbm.row_bytes(right)
         per_probe_row = hbm.row_bytes(left) + 8 + 2 * out_row
         avail = budget - fixed
@@ -167,8 +201,10 @@ def inner_join_batched_packed(
             max(1024, avail // max(per_probe_row, 1)),
         )
 
-    sorted_packed, perm_r = _build_fn(bits)(kw_r, kmin_dev)
-    probe = _probe_fn(bits)
+    sorted_packed, perm_r = _build_fn(bits, field_bits)(
+        tuple(kws_r), kmins_dev
+    )
+    probe = _probe_fn(bits, field_bits)
     out_row_bytes = hbm.row_bytes(left) + hbm.row_bytes(right)
     chunk_out_budget = max(
         probe_rows * 2 * out_row_bytes, join_mod.MIN_CHUNK_OUT_BYTES
@@ -180,7 +216,9 @@ def inner_join_batched_packed(
     while spans:
         start, stop = spans.popleft()
         lo, counts, total_dev = probe(
-            sorted_packed, kw_l[start:stop], kmin_dev
+            sorted_packed,
+            tuple(kw[start:stop] for kw in kws_l),
+            kmins_dev,
         )
         total = int(total_dev)
         if total == 0:

@@ -123,3 +123,29 @@ def rows_equal(
     for ak, bk in zip(a_keys, b_keys):
         eq = eq & (ak[a_idx] == bk[b_idx])
     return eq
+
+
+def fold_fields(rels, field_bits):
+    """Pack parallel relative-key u64 arrays as bit fields of ONE word
+    (first field in the high bits): lexicographic order of the tuple ==
+    numeric order of the composite. Callers validate that each rel fits
+    its declared width — the shared primitive of the packed
+    groupby/join/sort formulations."""
+    out = jnp.zeros(rels[0].shape, jnp.uint64)
+    for r, b in zip(rels, field_bits):
+        out = (out << jnp.uint64(b)) | r
+    return out
+
+
+def peel_fields(word, field_bits):
+    """Inverse of :func:`fold_fields`: the per-key relative fields."""
+    shift = 0
+    fields = []
+    for b in reversed(field_bits):
+        fields.append(
+            (word >> jnp.uint64(shift))
+            & ((jnp.uint64(1) << jnp.uint64(b)) - jnp.uint64(1))
+        )
+        shift += b
+    fields.reverse()
+    return fields

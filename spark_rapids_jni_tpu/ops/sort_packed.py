@@ -1,27 +1,27 @@
 """Packed-key ORDER BY — the narrow-key fast path for sort_table.
 
 The payload sort (ops/sort.py sort_table) carries key order words + an
-iota + every 1-D buffer through one variadic stable sort. With a single
-integer-family no-null key whose span fits ``64 - log2(n)`` bits (date
-keys, dictionary codes, ids), the key word, the iota AND the key
-column's own payload all collapse into one u64::
+iota + every 1-D buffer through one variadic stable sort. With
+integer-family no-null keys whose combined spans fit ``64 - log2(n)``
+bits (date keys, dictionary codes, ids — alone or composed), the key
+words, the iota AND the key columns' own payloads all collapse into one
+u64::
 
-    packed = (rel_key << bits) | row_iota      # rel = kw-kmin (asc)
-                                               #       kmax-kw (desc)
+    packed = (rel_1 << b_2 | rel_2 | ...) << iota_bits  |  row_iota
 
-so a 2-column ORDER BY moves 16 B/row of sort operands instead of 24 —
-and the sorted key column is RECONSTRUCTED from the word's high bits
-(the order-key transform inverts exactly for the integer family),
-while the permutation for matrix-shaped buffers (strings, DECIMAL128)
-is the word's low bits. Stability is structural: embedded iotas make
-ties impossible, so ``is_stable`` costs nothing.
+where each field is ``kw_i - kmin_i`` for an ascending key and
+``kmax_i - kw_i`` for a descending one — so MIXED directions
+(``ORDER BY a ASC, b DESC``) ride the same machinery, each field's
+direction folded into its own rel. A 2-column single-key ORDER BY moves
+16 B/row of sort operands instead of 24; every sorted key column is
+RECONSTRUCTED from its bit field (the order-key transform inverts
+exactly for the integer family), and the permutation for matrix-shaped
+buffers (strings, DECIMAL128) is the word's low bits. Stability is
+structural: embedded iotas make ties impossible.
 
-Descending rides the same machinery with ``rel = kmax - kw`` (an exact
-order-reversing shift within the same span), not a second code path.
-
-Eligibility is eager (one min/max); ineligible shapes return ``None``
-and callers fall back to :func:`ops.sort.sort_table` — this is an A/B
-arm, not a routing change.
+Eligibility is eager (one min/max per key); ineligible shapes return
+``None`` and callers fall back to :func:`ops.sort.sort_table` — this is
+an A/B arm, not a routing change.
 """
 
 from __future__ import annotations
@@ -33,27 +33,34 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column, Table
-from .groupby_packed import _key_supported, _unkey
+from .groupby_packed import _key_supported, _minmax, _unkey
 from .keys import column_order_keys
 from .sort import SortKey
 
 
 @functools.lru_cache(maxsize=64)
-def _packed_sort_fn(bits: int, ascending: bool, key_ci: int):
+def _packed_sort_fn(
+    bits: int, directions: tuple, field_bits: tuple, key_cis: tuple
+):
     mask = jnp.uint64((1 << bits) - 1)
 
-    def fn(table: Table, kbase):
-        kcol = table.columns[key_ci]
-        kw = column_order_keys(kcol)[0]
-        rel = (kw - kbase) if ascending else (kbase - kw)
-        n = kw.shape[0]
+    def fn(table: Table, kbases):
+        n = table.row_count
+        rels = []
+        for i, (ci, asc) in enumerate(zip(key_cis, directions)):
+            kw = column_order_keys(table.columns[ci])[0]
+            rels.append((kw - kbases[i]) if asc else (kbases[i] - kw))
+        from .keys import fold_fields
+
+        rel = fold_fields(rels, field_bits)
         iota = jnp.arange(n, dtype=jnp.uint64)
         packed = (rel << jnp.uint64(bits)) | iota
 
         operands: list[jax.Array] = [packed]
         plan: list[tuple[int, str]] = []
+        key_set = set(key_cis)
         for ci, c in enumerate(table.columns):
-            if c.data.ndim == 1 and ci != key_ci:
+            if c.data.ndim == 1 and ci not in key_set:
                 plan.append((ci, "data"))
                 operands.append(c.data)
             if c.validity is not None:
@@ -66,7 +73,15 @@ def _packed_sort_fn(bits: int, ascending: bool, key_ci: int):
         packed_s = out[0]
         perm = (packed_s & mask).astype(jnp.int32)
         rel_s = packed_s >> jnp.uint64(bits)
-        kw_sorted = (kbase + rel_s) if ascending else (kbase - rel_s)
+
+        # peel the sorted key fields back off (last key in low bits)
+        from .keys import peel_fields
+
+        peeled = peel_fields(rel_s, field_bits)
+        fields = {
+            ci: (f, asc)
+            for ci, asc, f in zip(key_cis, directions, peeled)
+        }
 
         by_col: dict = {}
         for (ci, attr), arr in zip(plan, out[1:]):
@@ -74,7 +89,10 @@ def _packed_sort_fn(bits: int, ascending: bool, key_ci: int):
         cols = []
         for ci, c in enumerate(table.columns):
             got = by_col.get(ci, {})
-            if ci == key_ci:
+            if ci in fields:
+                f, asc = fields[ci]
+                i = key_cis.index(ci)
+                kw_sorted = (kbases[i] + f) if asc else (kbases[i] - f)
                 data = _unkey(kw_sorted, c.dtype)
             else:
                 data = got.get("data")
@@ -97,27 +115,41 @@ def sort_table_packed(
     table: Table,
     sort_keys: Sequence[Union[SortKey, str, int]],
 ) -> Optional[Table]:
-    """Eager packed ORDER BY, or ``None`` when ineligible (multi-key,
-    nulls, non-integer key, span too wide) — fall back to sort_table."""
-    from .groupby_packed import _minmax
-
-    if len(sort_keys) != 1:
+    """Eager packed ORDER BY, or ``None`` when ineligible (nulls,
+    non-integer keys, duplicate key columns, combined span too wide) —
+    fall back to sort_table."""
+    if not sort_keys:
         return None
-    k = sort_keys[0]
-    k = k if isinstance(k, SortKey) else SortKey(k)
-    kcol = table.column(k.column)
-    if not _key_supported(kcol):
-        return None
+    keys = [
+        k if isinstance(k, SortKey) else SortKey(k) for k in sort_keys
+    ]
     n = table.row_count
     if n == 0:
         return None
-    key_ci = next(
-        i for i, c in enumerate(table.columns) if c is kcol
-    )
+    key_cis = []
+    for k in keys:
+        kcol = table.column(k.column)
+        if not _key_supported(kcol):
+            return None
+        ci = next(i for i, c in enumerate(table.columns) if c is kcol)
+        key_cis.append(ci)
+    if len(set(key_cis)) != len(key_cis):
+        return None  # duplicate key column: field peeling is ambiguous
     bits = max(1, (n - 1).bit_length())
-    kw = column_order_keys(kcol)[0]
-    lo, hi = _minmax(kw)
-    if hi - lo >= (1 << (64 - bits)) - 1:
+    kbases = []
+    field_bits = []
+    for k, ci in zip(keys, key_cis):
+        kw = column_order_keys(table.columns[ci])[0]
+        lo, hi = _minmax(kw)
+        field_bits.append(max(1, (hi - lo).bit_length()))
+        kbases.append(lo if k.ascending else hi)
+    if sum(field_bits) + bits > 64:
+        # no sentinel word: the full 64 bits are usable
         return None
-    kbase = jnp.uint64(lo if k.ascending else hi)
-    return _packed_sort_fn(bits, bool(k.ascending), key_ci)(table, kbase)
+    fn = _packed_sort_fn(
+        bits,
+        tuple(bool(k.ascending) for k in keys),
+        tuple(field_bits),
+        tuple(key_cis),
+    )
+    return fn(table, jnp.asarray(kbases, dtype=jnp.uint64))

@@ -87,10 +87,16 @@ class TestEligibility:
         right = Table([Column.from_numpy(k)], ["k"])
         assert not packed_join_supported(left, right, ["k"], ["k"])
 
-    def test_multi_key_declines(self):
+    def test_multi_key_supported(self):
+        # multi-key joins pack as composite fields since round 5
         k = np.arange(8, dtype=np.int64)
         t = Table([Column.from_numpy(k), Column.from_numpy(k)], ["a", "b"])
-        assert not packed_join_supported(t, t, ["a", "b"], ["a", "b"])
+        assert packed_join_supported(t, t, ["a", "b"], ["a", "b"])
+
+    def test_mismatched_key_count_declines(self):
+        k = np.arange(8, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(k)], ["a", "b"])
+        assert not packed_join_supported(t, t, ["a", "b"], ["a"])
 
 
 def test_probe_rows_zero_raises():
@@ -127,3 +133,56 @@ def test_heavy_hitter_resplits(monkeypatch):
     want = inner_join(left, right, ["k"])
     assert got["lv"].to_pylist() == want["lv"].to_pylist()
     assert got["rv"].to_pylist() == want["rv"].to_pylist()
+
+
+
+class TestMultiKeyJoin:
+    @pytest.mark.parametrize("seed,probe_rows", [(0, 1 << 20), (1, 111)])
+    def test_two_keys_randomized(self, seed, probe_rows):
+        rng = np.random.default_rng(seed)
+        nl, nr = 600, 500
+        la = rng.integers(-20, 20, nl, dtype=np.int64)
+        lb = rng.integers(0, 15, nl, dtype=np.int64)
+        ra = rng.integers(-20, 20, nr, dtype=np.int64)
+        rb = rng.integers(0, 15, nr, dtype=np.int64)
+        left = Table(
+            [Column.from_numpy(la), Column.from_numpy(lb),
+             Column.from_numpy(np.arange(nl, dtype=np.int64))],
+            ["a", "b", "lv"],
+        )
+        right = Table(
+            [Column.from_numpy(ra), Column.from_numpy(rb),
+             Column.from_numpy(np.arange(nr, dtype=np.int64))],
+            ["a", "b", "rv"],
+        )
+        got = inner_join_batched_packed(
+            left, right, ["a", "b"], probe_rows=probe_rows
+        )
+        assert got is not None
+        want = inner_join(left, right, ["a", "b"])
+        assert got.names == want.names
+        assert _pairs(got) == _pairs(want)
+
+    def test_q64_join_shape(self):
+        # (item_sk, ticket_number): the q64 self-join key pair
+        rng = np.random.default_rng(5)
+        n = 2000
+        item = rng.integers(1, 300, n, dtype=np.int64)
+        ticket = rng.integers(1, 500, n, dtype=np.int64)
+        left = Table(
+            [Column.from_numpy(item), Column.from_numpy(ticket),
+             Column.from_numpy(np.arange(n, dtype=np.int64))],
+            ["item_sk", "ticket", "lv"],
+        )
+        right = Table(
+            [Column.from_numpy(item[::-1].copy()),
+             Column.from_numpy(ticket[::-1].copy()),
+             Column.from_numpy(np.arange(n, dtype=np.int64))],
+            ["item_sk", "ticket", "rv"],
+        )
+        got = inner_join_batched_packed(
+            left, right, ["item_sk", "ticket"]
+        )
+        assert got is not None
+        want = inner_join(left, right, ["item_sk", "ticket"])
+        assert _pairs(got) == _pairs(want)

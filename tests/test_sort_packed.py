@@ -72,5 +72,63 @@ def test_declines():
         [Column.from_numpy(np.array([0, 1 << 62] * 32, np.int64))], ["k"]
     )
     assert sort_table_packed(t_wide, [SortKey("k")]) is None
-    t2 = Table([Column.from_numpy(k), Column.from_numpy(k)], ["a", "b"])
-    assert sort_table_packed(t2, [SortKey("a"), SortKey("b")]) is None
+    # multi-key shapes are SUPPORTED since the composite-field
+    # generalization (TestMultiKey); only duplicate columns decline
+    t2 = Table(
+        [Column.from_numpy(k),
+         Column.from_numpy((k * 3 % 7).astype(np.int64))],
+        ["a", "b"],
+    )
+    got = sort_table_packed(t2, [SortKey("a"), SortKey("b")])
+    assert got is not None
+    assert _cols(got) == _cols(sort_table(t2, [SortKey("a"), SortKey("b")]))
+
+
+class TestMultiKey:
+    @pytest.mark.parametrize(
+        "dirs", [(True, True), (True, False), (False, True)]
+    )
+    def test_two_keys_mixed_directions(self, dirs):
+        rng = np.random.default_rng(21)
+        n = 2500
+        a = rng.integers(-30, 30, n, dtype=np.int64)
+        b = rng.integers(0, 100, n, dtype=np.int64)
+        v = rng.integers(-9, 9, n, dtype=np.int64)
+        t = Table(
+            [Column.from_numpy(a), Column.from_numpy(b),
+             Column.from_numpy(v)],
+            ["a", "b", "v"],
+        )
+        keys = [SortKey("a", ascending=dirs[0]),
+                SortKey("b", ascending=dirs[1])]
+        got = sort_table_packed(t, keys)
+        assert got is not None
+        want = sort_table(t, keys)
+        assert _cols(got) == _cols(want)
+
+    def test_three_keys_with_string_payload(self):
+        rng = np.random.default_rng(22)
+        n = 1200
+        t = Table(
+            [
+                Column.from_numpy(rng.integers(0, 12, n, dtype=np.int64)),
+                Column.from_numpy(rng.integers(-5, 5, n, dtype=np.int64)),
+                Column.from_numpy(rng.integers(0, 40, n, dtype=np.int64)),
+                Column.from_strings(
+                    ["p%d" % x for x in rng.integers(0, 30, n)]
+                ),
+            ],
+            ["a", "b", "c", "s"],
+        )
+        keys = [SortKey("a"), SortKey("b", ascending=False), SortKey("c")]
+        got = sort_table_packed(t, keys)
+        assert got is not None
+        want = sort_table(t, keys)
+        assert _cols(got) == _cols(want)
+
+    def test_duplicate_key_column_declines(self):
+        k = np.arange(32, dtype=np.int64)
+        t = Table([Column.from_numpy(k)], ["k"])
+        assert sort_table_packed(
+            t, [SortKey("k"), SortKey("k", ascending=False)]
+        ) is None
