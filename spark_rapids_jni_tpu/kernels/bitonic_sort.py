@@ -93,9 +93,16 @@ def _kernel(n_payload: int, t: int):
     return body
 
 
+#: Chunks per grid step. Mosaic requires the sublane (second-to-last)
+#: block dim be a multiple of 8; each of the 8 rows runs the same
+#: network independently (rolls are along axis 1), so batching them in
+#: one block costs nothing and satisfies the tiling rule.
+_ROWS_PER_BLOCK = 8
+
+
 @functools.lru_cache(maxsize=64)
 def _sort_call(n_payload: int, t: int, interpret: bool):
-    spec = pl.BlockSpec((1, t), lambda c: (c, 0))
+    spec = pl.BlockSpec((_ROWS_PER_BLOCK, t), lambda c: (c, 0))
     n_ops = 2 + n_payload
 
     def fn(*arrays):
@@ -108,7 +115,7 @@ def _sort_call(n_payload: int, t: int, interpret: bool):
         ]
         return pl.pallas_call(
             _kernel(n_payload, t),
-            grid=(c,),
+            grid=(c // _ROWS_PER_BLOCK,),
             in_specs=[spec] * n_ops,
             out_specs=[spec] * (n_ops + 1),  # +1: the permutation index
             out_shape=out_shapes,
@@ -133,6 +140,19 @@ def batched_sort_u64(
         interpret = default_interpret()
     c, t = key.shape
     _check_pow2(t)
+    # Mosaic block tiling: pad the chunk count to the 8-row block and
+    # strip after (padding chunks sort all-max garbage, discarded).
+    pad_c = (-c) % _ROWS_PER_BLOCK
+    if pad_c:
+        key = jnp.concatenate(
+            [key, jnp.full((pad_c, t), ~jnp.uint64(0))], axis=0
+        )
+        payloads = tuple(
+            jnp.concatenate(
+                [p, jnp.zeros((pad_c, t), p.dtype)], axis=0
+            )
+            for p in payloads
+        )
     hi = (key >> jnp.uint64(32)).astype(jnp.uint32)
     lo = key.astype(jnp.uint32)
 
@@ -161,6 +181,8 @@ def batched_sort_u64(
             wide.append(False)
 
     out = _sort_call(len(split), t, bool(interpret))(hi, lo, *split)
+    if pad_c:
+        out = tuple(o[:c] for o in out)
     s_hi, s_lo, perm = out[0], out[1], out[2]
     s_key = (s_hi.astype(jnp.uint64) << jnp.uint64(32)) | s_lo.astype(
         jnp.uint64
@@ -181,3 +203,128 @@ def batched_sort_u64(
             outp.append(out[k].astype(p.dtype))
             k += 1
     return (s_key, perm, *outp)
+
+
+# ---------------------------------------------------------------------------
+# u32 single-word variant — the packed-key fast path's engine. When the
+# sort key fits ONE u32 (key-range x chunk-rows <= 2^32, the packed
+# groupby/ORDER BY word with its embedded per-chunk iota), the network
+# compares one word with NO tiebreaker: the embedded iota makes keys
+# unique, so stability is structural and the (hi, lo, idx) lexicographic
+# compare — and two thirds of the VMEM traffic — vanish.
+# ---------------------------------------------------------------------------
+
+
+def _kernel_u32(n_payload: int, t: int):
+    """refs = key + n_payload u32 payloads in, same out; (8, T) blocks.
+
+    Requires every key in a row to be DISTINCT (packed iota contract):
+    with distinct keys a bitonic network is deterministic, so no index
+    tiebreaker rides."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    def body(*refs):
+        ins = refs[: 1 + n_payload]
+        outs = refs[1 + n_payload:]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
+        ops = [r[...] for r in ins]
+        k = 2
+        while k <= t:
+            j = k // 2
+            while j >= 1:
+                rolled_up = [pltpu.roll(x, t - j, axis=1) for x in ops]
+                rolled_dn = [pltpu.roll(x, j, axis=1) for x in ops]
+                is_low = (idx & j) == 0
+                partner = [
+                    jnp.where(is_low, u, d)
+                    for u, d in zip(rolled_up, rolled_dn)
+                ]
+                p_lt = partner[0] < ops[0]
+                asc = (idx & k) == 0
+                keep_min = is_low == asc
+                take_partner = jnp.where(keep_min, p_lt, ~p_lt)
+                ops = [
+                    jnp.where(take_partner, pv, xv)
+                    for pv, xv in zip(partner, ops)
+                ]
+                j //= 2
+            k *= 2
+        for r, v in zip(outs, ops):
+            r[...] = v
+
+    return body
+
+
+@functools.lru_cache(maxsize=64)
+def _sort_call_u32(n_payload: int, t: int, interpret: bool):
+    spec = pl.BlockSpec((_ROWS_PER_BLOCK, t), lambda c: (c, 0))
+    n_ops = 1 + n_payload
+
+    def fn(*arrays):
+        c = arrays[0].shape[0]
+        return pl.pallas_call(
+            _kernel_u32(n_payload, t),
+            grid=(c // _ROWS_PER_BLOCK,),
+            in_specs=[spec] * n_ops,
+            out_specs=[spec] * n_ops,
+            out_shape=[
+                jax.ShapeDtypeStruct((c, t), jnp.uint32)
+                for _ in range(n_ops)
+            ],
+            interpret=interpret,
+        )(*arrays)
+
+    return jax.jit(fn)
+
+
+def batched_sort_u32(
+    key: jax.Array, *payloads: jax.Array, interpret: bool | None = None
+):
+    """Sort each row of ``key`` (C, T) u32 ascending, carrying payloads.
+
+    Keys within a row MUST be distinct (the packed-word-with-iota
+    contract) — with ties the network's output order is undefined.
+    Payloads must be 4-byte (bitcast around the kernel) or narrower
+    integers (widened). Returns ``(sorted_key, *sorted_payloads)``; the
+    caller recovers the permutation from the embedded iota bits."""
+    if interpret is None:
+        interpret = default_interpret()
+    c, t = key.shape
+    _check_pow2(t)
+    if key.dtype != jnp.uint32:
+        raise TypeError(f"key must be uint32, got {key.dtype}")
+    for p in payloads:  # validate before any device work
+        if p.dtype.itemsize > 4 or (
+            p.dtype.itemsize < 4 and jnp.issubdtype(p.dtype, jnp.floating)
+        ):
+            raise TypeError(
+                f"u32 network payload must be <=4-byte int or any "
+                f"4-byte dtype, got {p.dtype}"
+            )
+    pad_c = (-c) % _ROWS_PER_BLOCK
+    if pad_c:
+        key = jnp.concatenate(
+            [key, jnp.full((pad_c, t), ~jnp.uint32(0))], axis=0
+        )
+        payloads = tuple(
+            jnp.concatenate(
+                [p, jnp.zeros((pad_c, t), p.dtype)], axis=0
+            )
+            for p in payloads
+        )
+    split = [
+        jax.lax.bitcast_convert_type(p, jnp.uint32)
+        if p.dtype.itemsize == 4
+        else p.astype(jnp.uint32)
+        for p in payloads
+    ]
+    out = _sort_call_u32(len(split), t, bool(interpret))(key, *split)
+    if pad_c:
+        out = tuple(o[:c] for o in out)
+    outp = []
+    for p, s in zip(payloads, out[1:]):
+        if p.dtype.itemsize == 4:
+            outp.append(jax.lax.bitcast_convert_type(s, p.dtype))
+        else:
+            outp.append(s.astype(p.dtype))
+    return (out[0], *outp)

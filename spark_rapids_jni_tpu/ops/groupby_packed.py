@@ -53,6 +53,7 @@ from .. import dtype as dt
 from ..column import Column, Table
 from . import compute
 from . import keys as keys_mod
+from .keys import minmax_host as _minmax
 from .groupby import GroupbyAgg
 from .groupby_chunked import DECOMPOSABLE_OPS
 
@@ -533,16 +534,6 @@ def groupby_aggregate_packed(
             chunk_rows, 1 << int(max_chunk - 1).bit_length()
         )
     return None
-
-
-@functools.partial(jax.jit, static_argnums=())
-def _minmax_jit(kw):
-    return jnp.min(kw), jnp.max(kw)
-
-
-def _minmax(kw):
-    lo, hi = _minmax_jit(kw)
-    return int(lo), int(hi)
 
 
 @functools.lru_cache(maxsize=256)

@@ -49,6 +49,7 @@ from typing import Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from .keys import minmax_host as _minmax
 from ..column import Table
 from . import keys as keys_mod
 from .groupby_packed import _key_supported
@@ -244,11 +245,3 @@ def inner_join_batched_packed(
     return concatenate(pieces) if len(pieces) > 1 else pieces[0]
 
 
-@jax.jit
-def _minmax_jit(kw):
-    return jnp.min(kw), jnp.max(kw)
-
-
-def _minmax(kw):
-    lo, hi = _minmax_jit(kw)
-    return int(lo), int(hi)

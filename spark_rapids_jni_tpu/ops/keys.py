@@ -125,6 +125,18 @@ def rows_equal(
     return eq
 
 
+@jax.jit
+def _minmax_jit(kw):
+    return jnp.min(kw), jnp.max(kw)
+
+
+def minmax_host(kw):
+    """Host (int, int) min/max of a key-order word — the eager range
+    probe every packed-key router shares."""
+    lo, hi = _minmax_jit(kw)
+    return int(lo), int(hi)
+
+
 def fold_fields(rels, field_bits):
     """Pack parallel relative-key u64 arrays as bit fields of ONE word
     (first field in the high bits): lexicographic order of the tuple ==

@@ -33,8 +33,9 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column, Table
-from .groupby_packed import _key_supported, _minmax, _unkey
-from .keys import column_order_keys
+from .groupby_packed import _key_supported, _unkey
+from .keys import column_order_keys, fold_fields, peel_fields
+from .keys import minmax_host as _minmax
 from .sort import SortKey
 
 
@@ -50,8 +51,6 @@ def _packed_sort_fn(
         for i, (ci, asc) in enumerate(zip(key_cis, directions)):
             kw = column_order_keys(table.columns[ci])[0]
             rels.append((kw - kbases[i]) if asc else (kbases[i] - kw))
-        from .keys import fold_fields
-
         rel = fold_fields(rels, field_bits)
         iota = jnp.arange(n, dtype=jnp.uint64)
         packed = (rel << jnp.uint64(bits)) | iota
@@ -75,8 +74,6 @@ def _packed_sort_fn(
         rel_s = packed_s >> jnp.uint64(bits)
 
         # peel the sorted key fields back off (last key in low bits)
-        from .keys import peel_fields
-
         peeled = peel_fields(rel_s, field_bits)
         fields = {
             ci: (f, asc)

@@ -8,7 +8,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from spark_rapids_jni_tpu.kernels.bitonic_sort import batched_sort_u64
+from spark_rapids_jni_tpu.kernels.bitonic_sort import (
+    batched_sort_u32,
+    batched_sort_u64,
+)
 
 
 def _ref_sort(key, *payloads):
@@ -87,3 +90,49 @@ def test_int16_payload_round_trips():
     np.testing.assert_array_equal(
         np.asarray(sp), np.asarray(rpay).astype(np.int16)
     )
+
+
+def test_non_multiple_of_8_chunk_count():
+    """Mosaic wants (8, T) blocks; a 3-chunk batch must pad + strip."""
+    rng = np.random.default_rng(8)
+    key = jnp.asarray(rng.integers(0, 1 << 40, (3, 128)).astype(np.uint64))
+    got_k, got_p = batched_sort_u64(key, interpret=True)[:2]
+    assert got_k.shape == (3, 128)
+    np.testing.assert_array_equal(
+        np.asarray(got_k), np.sort(np.asarray(key), axis=1)
+    )
+
+
+@pytest.mark.parametrize("t", [128, 512])
+def test_u32_single_word_matches_argsort(t):
+    """Distinct keys per row (the packed-iota contract): full agreement
+    with np.argsort, payloads riding bit-exactly."""
+    rng = np.random.default_rng(9)
+    c = 11  # deliberately not a multiple of 8
+    key = np.stack(
+        [rng.permutation(1 << 20)[:t].astype(np.uint32) for _ in range(c)]
+    )
+    pay_f = rng.standard_normal((c, t)).astype(np.float32)
+    pay_i = rng.integers(-100, 100, (c, t), dtype=np.int64).astype(np.int16)
+    sk, sf, si = batched_sort_u32(
+        jnp.asarray(key), jnp.asarray(pay_f), jnp.asarray(pay_i),
+        interpret=True,
+    )
+    order = np.argsort(key, axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(sk), np.take_along_axis(key, order, axis=1)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sf), np.take_along_axis(pay_f, order, axis=1)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(si), np.take_along_axis(pay_i, order, axis=1)
+    )
+
+
+def test_u32_rejects_wide_payload_and_key():
+    key = jnp.zeros((1, 8), jnp.uint32)
+    with pytest.raises(TypeError, match="u32 network payload"):
+        batched_sort_u32(key, jnp.zeros((1, 8), jnp.int64), interpret=True)
+    with pytest.raises(TypeError, match="key must be uint32"):
+        batched_sort_u32(jnp.zeros((1, 8), jnp.uint64), interpret=True)

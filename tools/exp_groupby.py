@@ -1,0 +1,159 @@
+"""On-chip formulation microbenchmarks for the groupby/sort redesign.
+
+Round-5 measurement tool (VERDICT item 2): the 16M A/B landed single-
+pass variadic lax.sort at 0.18 s, beating both narrow-word two-level
+designs — so the constant we must attack is the sort itself (or skip
+sorting entirely). Each probe below isolates one primitive cost on the
+real chip; together they decide which groupby formulation can reach the
+>=5x round-3 target (<=0.22 s at 100M rows):
+
+  sort_u64_1op / sort_u32_1op   is a 32-bit sort word ~2x a 64-bit one?
+  sort_u64_variadic             cost of payload operands riding lax.sort
+  sort_u32_batched              XLA batched chunk sorts (the r4 bet)
+  segment_sum_scatter           XLA scatter-add: skip the sort entirely?
+  onehot_matmul_K{128,1024,8192}  MXU histogram: viable K ceiling?
+  gather_16m                    random-gather throughput (counting-sort
+                                / permutation-apply building block)
+
+Usage:  python tools/exp_groupby.py [n_rows]   (default 16M; prints one
+JSON line per probe, cheap first — safe to kill anytime)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 16_777_216
+K_GROUPS = 10_000
+
+
+def _sync(x):
+    import jax
+
+    leaves = [l for l in jax.tree.leaves(x) if hasattr(l, "dtype")]
+    np.asarray(leaves[0].ravel()[-1])
+    return x
+
+
+def _time(fn, *args, reps=3):
+    _sync(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def _emit(name, secs, rows=N, **extra):
+    d = {
+        "probe": name,
+        "seconds": round(secs, 6),
+        "rows": rows,
+        "rows_per_s": round(rows / secs, 1),
+    }
+    d.update(extra)
+    print("EXP " + json.dumps(d), flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(99)
+    platform = jax.devices()[0].platform
+    print(f"# platform={platform} n={N}", file=sys.stderr, flush=True)
+
+    k_host = rng.integers(0, K_GROUPS, N, dtype=np.int64)
+    v_host = rng.integers(-1000, 1000, N, dtype=np.int64)
+    u64 = jax.device_put(
+        ((k_host.astype(np.uint64) << np.uint64(24))
+         | np.arange(N, dtype=np.uint64) & np.uint64((1 << 24) - 1))
+    )
+    u32 = jax.device_put(rng.integers(0, 1 << 32, N, dtype=np.uint64)
+                         .astype(np.uint32))
+    k_dev = jax.device_put(k_host)
+    v_dev = jax.device_put(v_host)
+    k32 = jax.device_put(k_host.astype(np.int32))
+    v32 = jax.device_put(v_host.astype(np.int32))
+    jax.block_until_ready(v32)
+
+    # --- gather: random permutation apply ------------------------------
+    idx = jax.device_put(rng.permutation(N).astype(np.int32))
+    f = jax.jit(lambda a, i: jnp.take(a, i, axis=0))
+    _emit("gather_16m_i64", _time(f, v_dev, idx))
+    _emit("gather_16m_i32", _time(f, v32, idx))
+
+    # --- single-operand sorts -----------------------------------------
+    f = jax.jit(lambda a: jax.lax.sort((a,), num_keys=1)[0])
+    _emit("sort_u32_1op", _time(f, u32))
+    _emit("sort_u64_1op", _time(f, u64))
+
+    # --- variadic: key + payload --------------------------------------
+    f = jax.jit(lambda a, b: jax.lax.sort((a, b), num_keys=1))
+    _emit("sort_u64_variadic2", _time(f, u64, v_dev))
+    f = jax.jit(
+        lambda a, b, c, d: jax.lax.sort((a, b, c, d), num_keys=1)
+    )
+    _emit(
+        "sort_u64_variadic4",
+        _time(f, u64, v_dev, k_dev, jnp.arange(N, dtype=jnp.int32)),
+    )
+
+    # --- batched chunk sorts (u32, single word) ------------------------
+    t = 8192
+    b32 = u32.reshape(N // t, t)
+    f = jax.jit(lambda a: jax.lax.sort((a,), dimension=1, num_keys=1)[0])
+    _emit("sort_u32_batched_8192", _time(f, b32))
+
+    # --- scatter segment-sum ------------------------------------------
+    f = jax.jit(
+        lambda v, s: jax.ops.segment_sum(v, s, num_segments=K_GROUPS)
+    )
+    _emit("segment_sum_scatter_i64", _time(f, v_dev, k32))
+    f = jax.jit(
+        lambda v, s: jax.ops.segment_sum(v, s, num_segments=K_GROUPS)
+    )
+    _emit(
+        "segment_sum_scatter_f32",
+        _time(f, v32.astype(jnp.float32), k32),
+    )
+
+    # --- one-hot MXU histogram ----------------------------------------
+    # bf16 one-hot @ bf16 limbs, f32 accumulate; R-row blocks keep the
+    # f32 partials exact (R * 255 < 2^24). Timing probe only: exact
+    # recombination is the production arm's job.
+    def onehot_sum(kk, vv, K, R):
+        kb = kk.reshape(N // R, R)
+        vb = vv.reshape(N // R, R)
+        iota = jnp.arange(K, dtype=jnp.int32)
+
+        def step(carry, kv):
+            kr, vr = kv
+            oh = (kr[:, None] == iota[None, :]).astype(jnp.bfloat16)
+            lo = (vr & 0xFF).astype(jnp.bfloat16)
+            hi = ((vr >> 8) & 0xFF).astype(jnp.bfloat16)
+            x = jnp.stack([lo, hi, jnp.ones_like(lo)], axis=1)
+            p = jax.lax.dot_general(
+                x, oh,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (3, K)
+            return carry + p.astype(jnp.int64), None
+
+        init = jnp.zeros((3, K), jnp.int64)
+        out, _ = jax.lax.scan(step, init, (kb, vb))
+        return out
+
+    for K in (128, 1024, 8192):
+        f = jax.jit(lambda kk, vv, K=K: onehot_sum(kk, vv, K, 32768))
+        kk = jax.device_put((k_host % K).astype(np.int32))
+        _emit(f"onehot_matmul_K{K}", _time(f, kk, v32), K=K)
+
+
+if __name__ == "__main__":
+    main()
