@@ -177,7 +177,9 @@ def bench_groupby_chunked(platform, n=100_000_000, n_inputs=2):
     )
 
 
-def bench_groupby_packed(platform, n=100_000_000, n_inputs=2):
+def bench_groupby_packed(platform, n=100_000_000, n_inputs=2,
+                         engine="lax", chunk_rows=1 << 18,
+                         chunk_segments=1 << 14):
     """Config 1 at scale via the packed-key formulation: ONE u64 sort
     word ((key-kmin)<<18 | iota) per row instead of (occupancy, key,
     iota, row_valid) — ~1.8x less sort traffic than the chunked path on
@@ -209,19 +211,21 @@ def bench_groupby_packed(platform, n=100_000_000, n_inputs=2):
             ["k"],
             [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")],
             num_segments=n_keys,
-            chunk_rows=1 << 18,
-            chunk_segments=1 << 14,
+            chunk_rows=chunk_rows,
+            chunk_segments=chunk_segments,
+            engine=engine,
         )
     )
     med, mn, std, out = _timeit(step, inputs)
     agg, ngroups, max_chunk, overflow = out
     assert not bool(overflow), "packed range overflow"
-    assert int(max_chunk) <= 1 << 14, "chunk capacity overflow"
+    assert int(max_chunk) <= chunk_segments, "chunk capacity overflow"
     total = int(np.asarray(agg["sum_v"].data)[: int(ngroups)].sum())
     assert total == int(hosts[-1][1].sum()), "groupby-sum mismatch vs numpy"
+    suffix = "" if engine == "lax" else f"_{engine}"
     return _entry(
-        1, f"groupby_sum_{n // 1_000_000}M_packed", n, med, mn, std,
-        n * 16, platform,
+        1, f"groupby_sum_{n // 1_000_000}M_packed{suffix}", n, med, mn,
+        std, n * 16, platform,
     )
 
 
@@ -727,7 +731,38 @@ def bench_chunk_sort_ab(platform, total_rows=16_777_216, t=8192):
     e2 = _entry("chunk-sort", f"pallas_bitonic_{c}x{t}", total_rows,
                 med_p, mn_p, std_p, bytes_moved, platform)
     e2["vs_lax"] = round(med_x / med_p, 2)
-    return [e1, e2]
+
+    # u32 single-word arm: the packed-word contract (distinct keys,
+    # permutation in the embedded iota, values follow by gather)
+    from spark_rapids_jni_tpu.kernels.bitonic_sort import batched_sort_u32
+
+    iota_bits = (t - 1).bit_length()
+    key32 = jnp.asarray(
+        (
+            (rng.integers(0, 1 << (32 - iota_bits), (c, t),
+                          dtype=np.uint64) << iota_bits)
+            | np.arange(t, dtype=np.uint64)[None, :]
+        ).astype(np.uint32)
+    )
+    jax.block_until_ready(key32)
+
+    def u32_sort(k, v):
+        s = batched_sort_u32(k, interpret=interp)[0]
+        perm = (s & jnp.uint32(t - 1)).astype(jnp.int32)
+        return s, jnp.take_along_axis(v, perm, axis=1)
+
+    u32_fn = jax.jit(u32_sort)
+    med_u, mn_u, std_u, out_u = _timeit(
+        u32_fn, [(key32, val)], reps_per_input=3
+    )
+    assert np.array_equal(
+        np.asarray(out_u[0][0]), np.sort(np.asarray(key32[0]))
+    ), "u32 pallas sort diverges from np.sort"
+    bytes_u32 = total_rows * 12 * 2  # u32 word + i64 value in/out
+    e3 = _entry("chunk-sort", f"pallas_u32_gather_{c}x{t}", total_rows,
+                med_u, mn_u, std_u, bytes_u32, platform)
+    e3["vs_lax"] = round(med_x / med_u, 2)
+    return [e1, e2, e3]
 
 
 def bench_strings(platform, n=10_000_000, pad=128):
@@ -988,6 +1023,16 @@ _SUBPROCESS_CONFIGS = {
     "groupby_highcard": bench_groupby_highcard,
     "groupby16m_packed": lambda p: bench_groupby_packed(p, 16_000_000),
     "groupby16m_chunked": lambda p: bench_groupby_chunked(p, 16_000_000),
+    # VMEM bitonic phase-1 engines (u32 word + value gather): the A/B
+    # that decides whether the packed formulation wins its sort back
+    "groupby16m_packed_pallas32": lambda p: bench_groupby_packed(
+        p, 16_000_000, engine="pallas32", chunk_rows=1 << 17,
+        chunk_segments=1 << 14,
+    ),
+    "groupby100m_packed_pallas32": lambda p: bench_groupby_packed(
+        p, 100_000_000, engine="pallas32", chunk_rows=1 << 17,
+        chunk_segments=1 << 14,
+    ),
     "transpose": bench_transpose,
     "transpose_pallas": bench_transpose_pallas,
     "join": bench_join,
@@ -1013,10 +1058,11 @@ _SUBPROCESS_CONFIGS = {
 # chunked-groupby A/B runs as soon as the cheap tier is banked.
 _LADDER = (
     "groupby1m", "groupby16m_packed", "groupby16m_chunked", "groupby16m",
-    "chunk_sort_ab",
+    "chunk_sort_ab", "groupby16m_packed_pallas32",
     "strings", "transpose", "transpose_pallas", "resident", "parquet",
     "parquet_device",
-    "groupby100m_packed", "groupby100m_chunked", "groupby100m",
+    "groupby100m_packed", "groupby100m_packed_pallas32",
+    "groupby100m_chunked", "groupby100m",
     "groupby_highcard", "sort",
     "sort_packed", "sort_gather",
     "join_batched", "join_batched_packed", "tpcds", "tpcds10",

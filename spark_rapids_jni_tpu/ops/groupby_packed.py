@@ -154,6 +154,7 @@ def groupby_aggregate_packed_chunked(
     chunk_rows: int = 1 << 18,
     chunk_segments: int = 1 << 14,
     field_bits: Optional[tuple] = None,
+    engine: str = "lax",
 ) -> tuple[Table, jax.Array, jax.Array, jax.Array]:
     """Jittable packed two-level groupby.
 
@@ -167,6 +168,24 @@ def groupby_aggregate_packed_chunked(
     order == numeric composite order. Required for multi-key shapes
     (the eager router measures spans and supplies it); the single-key
     default packs the one key into the whole word above the iota.
+
+    ``engine`` selects the phase-1 chunk-sort backend:
+
+    * ``"lax"`` — batched variadic ``lax.sort`` carrying the value
+      columns as sort payloads (the original formulation);
+    * ``"pallas"`` — the VMEM bitonic network (kernels/bitonic_sort)
+      sorting the packed WORD ONLY with the (hi, lo) u64 form; values
+      follow by a per-chunk gather of the embedded-iota permutation.
+    * ``"pallas32"`` — same, but the words ride the single-word u32
+      network. Whether they FIT u32 is data-dependent
+      (``key-range << iota_bits`` strictly below the all-ones
+      sentinel), so the fit rides the traced ``overflow`` flag: a
+      mis-sized call is detected, never silently wrong. Callers pick
+      this arm when ``chunk_segments << chunk_rows`` is comfortably
+      inside 32 bits.
+
+    Both Pallas engines need ``chunk_rows`` a power of two and a
+    multiple of 128.
     """
     key_names, key_cols = _validate_and_names(table, by, aggs, field_bits)
     n = table.row_count
@@ -208,9 +227,30 @@ def groupby_aggregate_packed_chunked(
         )
         for v in vals_in
     )
-    sorted_all = jax.lax.sort((packed,) + ops_2d, num_keys=1)
-    spacked = sorted_all[0]
-    svals = sorted_all[1:]
+    if engine == "lax":
+        sorted_all = jax.lax.sort((packed,) + ops_2d, num_keys=1)
+        spacked = sorted_all[0]
+        svals = sorted_all[1:]
+    elif engine in ("pallas", "pallas32"):
+        u32 = engine == "pallas32"
+        if u32:
+            # the narrowed word drops the high half: exact iff every
+            # real word fits STRICTLY below the all-ones u32 — the
+            # sentinel must stay above every real word (the module
+            # invariant), so 0xFFFFFFFF itself is reserved too. Traced
+            # into the same overflow protocol as the range checks.
+            overflow = overflow | (
+                jnp.max(jnp.where(occ2d, packed, 0))
+                >= jnp.uint64(0xFFFFFFFF)
+            )
+        spacked, perm = _pallas_word_sort(
+            packed, iota_bits, chunk_rows, u32
+        )
+        svals = tuple(
+            jnp.take_along_axis(v2d, perm, axis=1) for v2d in ops_2d
+        )
+    else:
+        raise ValueError(f"unknown packed-groupby engine {engine!r}")
 
     skey = spacked >> jnp.uint64(iota_bits)  # (C, T) relative key words
     boundary = jnp.concatenate(
@@ -308,6 +348,35 @@ def groupby_aggregate_packed_chunked(
         max_chunk,
         overflow,
     )
+
+
+def _pallas_word_sort(packed, iota_bits: int, chunk_rows: int, u32: bool):
+    """Sort the (C, T) packed u64 words with the VMEM bitonic network,
+    key only, and return ``(sorted_words, perm)`` where perm is each
+    row's embedded-iota source index — the permutation the caller
+    applies to value columns by gather.
+
+    ``u32=True`` runs the single-word u32 network on the narrowed
+    words (the ``"pallas32"`` engine); the caller is responsible for
+    OR-ing the ``rel < 2^(32 - iota_bits)`` fit into its traced
+    overflow flag, so a mis-sized call is detected, never silently
+    wrong. The all-ones sentinel padding word narrows to all-ones, and
+    its perm bits clip inside [0, T), so padding rows gather garbage
+    that lands in the trailing garbage segment — same contract as
+    riding the variadic sort."""
+    from ..kernels.bitonic_sort import batched_sort_u32, batched_sort_u64
+
+    mask = jnp.uint64((1 << iota_bits) - 1)
+    if u32:
+        s32 = batched_sort_u32(packed.astype(jnp.uint32))[0]
+        spacked = jnp.where(
+            s32 == ~jnp.uint32(0), _U64_MAX, s32.astype(jnp.uint64)
+        )
+    else:
+        spacked = batched_sort_u64(packed)[0]
+    perm = (spacked & mask).astype(jnp.int32)
+    perm = jnp.minimum(perm, jnp.int32(chunk_rows - 1))
+    return spacked, perm
 
 
 def _validate_and_names(table, by, aggs, field_bits):

@@ -434,3 +434,75 @@ class TestFlatVariant:
             wd[kk] = wd.get(kk, 0) + vv
         gd = dict(zip(got["k"].to_pylist(), got["sum_v"].to_pylist()))
         assert gd == wd
+
+
+class TestPallasEngines:
+    """The VMEM bitonic phase-1 engines must agree exactly with the
+    lax.sort engine (values follow the word sort by gather)."""
+
+    @pytest.mark.parametrize("engine", ["pallas", "pallas32"])
+    def test_engine_equivalence(self, engine):
+        rng = np.random.default_rng(17)
+        n = 2000
+        k = rng.integers(-40, 40, n, dtype=np.int64)
+        v = rng.integers(-1000, 1000, n, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        kwargs = dict(
+            num_segments=128, chunk_rows=256, chunk_segments=128,
+        )
+        want, ng0, mc0, ov0 = groupby_aggregate_packed_chunked(
+            t, ["k"], AGGS, **kwargs
+        )
+        got, ng, mc, ov = groupby_aggregate_packed_chunked(
+            t, ["k"], AGGS, engine=engine, **kwargs
+        )
+        assert not bool(ov) and not bool(ov0)
+        assert int(ng) == int(ng0)
+        g = int(ng)
+        for a, b in zip(got.columns, want.columns):
+            np.testing.assert_array_equal(
+                np.asarray(a.data)[:g], np.asarray(b.data)[:g]
+            )
+
+    def test_pallas32_overflow_flagged_not_silent(self):
+        # key range wider than 32 - iota_bits: the u32 narrowing would
+        # corrupt words, so the traced overflow flag must fire
+        n = 512
+        k = (np.arange(n, dtype=np.int64) * (1 << 22))  # span ~2^31
+        v = np.ones(n, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        _, _, _, ov = groupby_aggregate_packed_chunked(
+            t, ["k"], [GroupbyAgg("v", "sum")], num_segments=512,
+            chunk_rows=256, chunk_segments=512, engine="pallas32",
+        )
+        assert bool(ov)
+
+    def test_pallas32_all_ones_word_reserved(self):
+        # a REAL packed word equal to 0xFFFFFFFF would alias the u32
+        # padding sentinel after narrowing: the fit check must reserve
+        # it (flag overflow), not silently corrupt that row's key
+        chunk_rows = 256  # iota_bits = 8
+        n = chunk_rows
+        k = np.zeros(n, dtype=np.int64)
+        k[-1] = (1 << 24) - 1  # rel<<8 | iota 255 == 0xFFFFFFFF
+        v = np.ones(n, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        _, _, _, ov = groupby_aggregate_packed_chunked(
+            t, ["k"], [GroupbyAgg("v", "sum")], num_segments=n,
+            chunk_rows=chunk_rows, chunk_segments=n, engine="pallas32",
+        )
+        assert bool(ov)
+
+    def test_unknown_engine_rejected(self):
+        t = Table(
+            [
+                Column.from_numpy(np.zeros(8, dtype=np.int64)),
+                Column.from_numpy(np.zeros(8, dtype=np.int64)),
+            ],
+            ["k", "v"],
+        )
+        with pytest.raises(ValueError, match="engine"):
+            groupby_aggregate_packed_chunked(
+                t, ["k"], [GroupbyAgg("v", "sum")], num_segments=8,
+                chunk_rows=8, chunk_segments=8, engine="cuda",
+            )
