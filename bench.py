@@ -92,16 +92,14 @@ def _entry(config, name, rows, med, mn, std, bytes_moved, platform):
     return e
 
 
-def bench_groupby(platform, n, n_inputs=2):
+def _gen_groupby_inputs(n, n_inputs=2, n_keys=10_000):
+    """Shared config-1 data generator: every groupby A/B rung MUST draw
+    from this one (same seed, same shape) or the arms stop being
+    comparable (the r3 shrink lesson)."""
     import jax
 
     from spark_rapids_jni_tpu.column import Column, Table
-    from spark_rapids_jni_tpu.ops.groupby import (
-        GroupbyAgg,
-        groupby_aggregate_capped,
-    )
 
-    n_keys = 10_000
     rng = np.random.default_rng(42)
     hosts = []
     inputs = []
@@ -112,6 +110,20 @@ def bench_groupby(platform, n, n_inputs=2):
         t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
         jax.block_until_ready(t.columns[0].data)
         inputs.append((t,))
+    return hosts, inputs
+
+
+def bench_groupby(platform, n, n_inputs=2):
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import (
+        GroupbyAgg,
+        groupby_aggregate_capped,
+    )
+
+    n_keys = 10_000
+    hosts, inputs = _gen_groupby_inputs(n, n_inputs)
 
     step = jax.jit(
         lambda t: groupby_aggregate_capped(
@@ -145,16 +157,7 @@ def bench_groupby_chunked(platform, n=100_000_000, n_inputs=2):
     n_keys = 10_000
     chunk_rows = 1 << 18
     chunk_segments = 1 << 15  # 10k keys/chunk worst case + headroom
-    rng = np.random.default_rng(42)
-    hosts = []
-    inputs = []
-    for _ in range(n_inputs):
-        k = rng.integers(0, n_keys, n, dtype=np.int64)
-        v = rng.integers(-1000, 1000, n, dtype=np.int64)
-        hosts.append((k, v))
-        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
-        jax.block_until_ready(t.columns[0].data)
-        inputs.append((t,))
+    hosts, inputs = _gen_groupby_inputs(n, n_inputs)
 
     step = jax.jit(
         lambda t: groupby_aggregate_capped_chunked(
@@ -194,16 +197,7 @@ def bench_groupby_packed(platform, n=100_000_000, n_inputs=2,
     )
 
     n_keys = 10_000
-    rng = np.random.default_rng(42)
-    hosts = []
-    inputs = []
-    for _ in range(n_inputs):
-        k = rng.integers(0, n_keys, n, dtype=np.int64)
-        v = rng.integers(-1000, 1000, n, dtype=np.int64)
-        hosts.append((k, v))
-        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
-        jax.block_until_ready(t.columns[0].data)
-        inputs.append((t,))
+    hosts, inputs = _gen_groupby_inputs(n, n_inputs)
 
     step = jax.jit(
         lambda t: groupby_aggregate_packed_chunked(
@@ -226,6 +220,43 @@ def bench_groupby_packed(platform, n=100_000_000, n_inputs=2,
     return _entry(
         1, f"groupby_sum_{n // 1_000_000}M_packed{suffix}", n, med, mn,
         std, n * 16, platform,
+    )
+
+
+def bench_groupby_flat(platform, n=16_000_000, values_via="sort",
+                       n_inputs=2):
+    """Single-level flat-packed groupby on the LOW-cardinality headline
+    shape: one u64 word (key<<iota_bits | iota) through ONE full-column
+    sort — no chunking, no combine. ``values_via`` A/Bs carrying values
+    as sort payloads vs a word-only sort plus permutation gather."""
+    import jax
+
+    from spark_rapids_jni_tpu.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+    from spark_rapids_jni_tpu.ops.groupby_packed import (
+        groupby_aggregate_packed_flat,
+    )
+
+    n_keys = 10_000
+    hosts, inputs = _gen_groupby_inputs(n, n_inputs)
+
+    step = jax.jit(
+        lambda t: groupby_aggregate_packed_flat(
+            t,
+            ["k"],
+            [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")],
+            num_segments=n_keys,
+            values_via=values_via,
+        )
+    )
+    med, mn, std, out = _timeit(step, inputs)
+    agg, ngroups, overflow = out
+    assert not bool(overflow), "flat packed overflow"
+    total = int(np.asarray(agg["sum_v"].data)[: int(ngroups)].sum())
+    assert total == int(hosts[-1][1].sum()), "groupby-sum mismatch vs numpy"
+    return _entry(
+        1, f"groupby_sum_{n // 1_000_000}M_flat_{values_via}", n, med,
+        mn, std, n * 16, platform,
     )
 
 
@@ -1023,6 +1054,17 @@ _SUBPROCESS_CONFIGS = {
     "groupby_highcard": bench_groupby_highcard,
     "groupby16m_packed": lambda p: bench_groupby_packed(p, 16_000_000),
     "groupby16m_chunked": lambda p: bench_groupby_chunked(p, 16_000_000),
+    # flat single-level packing: values as sort payloads vs word-only
+    # sort + permutation gather
+    "groupby16m_flat_sort": lambda p: bench_groupby_flat(
+        p, 16_000_000, "sort"
+    ),
+    "groupby16m_flat_gather": lambda p: bench_groupby_flat(
+        p, 16_000_000, "gather"
+    ),
+    "groupby100m_flat_gather": lambda p: bench_groupby_flat(
+        p, 100_000_000, "gather"
+    ),
     # VMEM bitonic phase-1 engines (u32 word + value gather): the A/B
     # that decides whether the packed formulation wins its sort back
     "groupby16m_packed_pallas32": lambda p: bench_groupby_packed(
@@ -1059,9 +1101,11 @@ _SUBPROCESS_CONFIGS = {
 _LADDER = (
     "groupby1m", "groupby16m_packed", "groupby16m_chunked", "groupby16m",
     "chunk_sort_ab", "groupby16m_packed_pallas32",
+    "groupby16m_flat_sort", "groupby16m_flat_gather",
     "strings", "transpose", "transpose_pallas", "resident", "parquet",
     "parquet_device",
     "groupby100m_packed", "groupby100m_packed_pallas32",
+    "groupby100m_flat_gather",
     "groupby100m_chunked", "groupby100m",
     "groupby_highcard", "sort",
     "sort_packed", "sort_gather",

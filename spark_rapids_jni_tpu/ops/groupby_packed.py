@@ -237,12 +237,20 @@ def groupby_aggregate_packed_chunked(
             # the narrowed word drops the high half: exact iff every
             # real word fits STRICTLY below the all-ones u32 — the
             # sentinel must stay above every real word (the module
-            # invariant), so 0xFFFFFFFF itself is reserved too. Traced
-            # into the same overflow protocol as the range checks.
-            overflow = overflow | (
-                jnp.max(jnp.where(occ2d, packed, 0))
-                >= jnp.uint64(0xFFFFFFFF)
-            )
+            # invariant). The top word is rel_max << iota_bits | iota,
+            # which can reach 0xFFFFFFFF exactly when rel_max ==
+            # 2^(32 - iota_bits) - 1, so that rel value is reserved
+            # too (conservatively: flagging rides the exactness
+            # protocol, the router just falls back). Checked on rel
+            # (XLA CSEs the max with _composite_rel's own range
+            # reduction) rather than re-reducing the (C, T) words.
+            if iota_bits >= 32:
+                overflow = jnp.asarray(True)
+            else:
+                fit_line = (jnp.uint64(1) << jnp.uint64(
+                    32 - iota_bits
+                )) - jnp.uint64(1)
+                overflow = overflow | (jnp.max(rel) >= fit_line)
         spacked, perm = _pallas_word_sort(
             packed, iota_bits, chunk_rows, u32
         )
@@ -374,8 +382,10 @@ def _pallas_word_sort(packed, iota_bits: int, chunk_rows: int, u32: bool):
         )
     else:
         spacked = batched_sort_u64(packed)[0]
+    # perm needs no clamp: chunk_rows is a power of two, so the iota
+    # mask already bounds it to [0, T) — including the all-ones
+    # sentinel, whose masked bits gather discarded garbage
     perm = (spacked & mask).astype(jnp.int32)
-    perm = jnp.minimum(perm, jnp.int32(chunk_rows - 1))
     return spacked, perm
 
 
@@ -630,6 +640,7 @@ def groupby_aggregate_packed_flat(
     aggs: Sequence[GroupbyAgg],
     num_segments: int,
     field_bits: Optional[tuple] = None,
+    values_via: str = "sort",
 ) -> tuple[Table, jax.Array, jax.Array]:
     """Jittable SINGLE-LEVEL packed groupby — the high-cardinality arm.
 
@@ -638,6 +649,14 @@ def groupby_aggregate_packed_flat(
     packed sort is still strictly narrower than the general single-pass
     sort (one u64 vs key words + iota + occupancy). This variant is that
     single sort: pack, sort once over the whole column, segment-reduce.
+
+    ``values_via`` routes the value columns to sorted order: ``"sort"``
+    carries them as lax.sort payloads (each payload rides every one of
+    the network's O(log^2 n) passes); ``"gather"`` sorts the packed
+    word ALONE and applies the embedded-iota permutation with one
+    gather per value column (one extra O(n) pass each, no per-pass
+    cost). Which wins is a measured on-chip A/B (bench
+    ``groupby*_flat*`` rungs).
 
     Returns ``(padded result of num_segments rows, num_groups,
     overflow)`` — EXACT iff ``overflow`` is False (key fields fit AND
@@ -657,9 +676,19 @@ def groupby_aggregate_packed_flat(
     packed = (rel << jnp.uint64(iota_bits)) | jnp.arange(
         n, dtype=jnp.uint64
     )
-    sorted_all = jax.lax.sort((packed,) + tuple(vals_in), num_keys=1)
-    skey = sorted_all[0] >> jnp.uint64(iota_bits)
-    svals = sorted_all[1:]
+    if values_via == "sort":
+        sorted_all = jax.lax.sort((packed,) + tuple(vals_in), num_keys=1)
+        skey = sorted_all[0] >> jnp.uint64(iota_bits)
+        svals = sorted_all[1:]
+    elif values_via == "gather":
+        sword = jax.lax.sort((packed,), num_keys=1)[0]
+        skey = sword >> jnp.uint64(iota_bits)
+        perm = (sword & jnp.uint64((1 << iota_bits) - 1)).astype(
+            jnp.int32
+        )
+        svals = tuple(jnp.take(v, perm, axis=0) for v in vals_in)
+    else:
+        raise ValueError(f"unknown values_via {values_via!r}")
 
     boundary = jnp.concatenate(
         [jnp.ones((1,), jnp.bool_), skey[1:] != skey[:-1]]

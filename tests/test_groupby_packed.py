@@ -405,6 +405,34 @@ class TestFlatVariant:
             want[(x, y)] = want.get((x, y), 0) + z
         assert got == want
 
+    def test_gather_arm_matches_sort_arm(self):
+        from spark_rapids_jni_tpu.ops.groupby_packed import (
+            groupby_aggregate_packed_flat,
+        )
+
+        rng = np.random.default_rng(13)
+        n = 4000
+        k = rng.integers(0, 500, n, dtype=np.int64)
+        v = rng.integers(-50, 50, n, dtype=np.int64)
+        t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+        a, ng_a, ov_a = groupby_aggregate_packed_flat(
+            t, ["k"], AGGS, num_segments=512, values_via="sort"
+        )
+        b, ng_b, ov_b = groupby_aggregate_packed_flat(
+            t, ["k"], AGGS, num_segments=512, values_via="gather"
+        )
+        assert not bool(ov_a) and not bool(ov_b)
+        assert int(ng_a) == int(ng_b)
+        g = int(ng_a)
+        for ca, cb in zip(a.columns, b.columns):
+            np.testing.assert_array_equal(
+                np.asarray(ca.data)[:g], np.asarray(cb.data)[:g]
+            )
+        with pytest.raises(ValueError, match="values_via"):
+            groupby_aggregate_packed_flat(
+                t, ["k"], AGGS, num_segments=512, values_via="scatter"
+            )
+
     def test_capacity_overflow_flagged(self):
         from spark_rapids_jni_tpu.ops.groupby_packed import (
             groupby_aggregate_packed_flat,
