@@ -116,14 +116,13 @@ def _gen_groupby_inputs(n, n_inputs=2, n_keys=10_000):
 def bench_groupby(platform, n, n_inputs=2):
     import jax
 
-    from spark_rapids_jni_tpu.column import Column, Table
     from spark_rapids_jni_tpu.ops.groupby import (
         GroupbyAgg,
         groupby_aggregate_capped,
     )
 
     n_keys = 10_000
-    hosts, inputs = _gen_groupby_inputs(n, n_inputs)
+    hosts, inputs = _gen_groupby_inputs(n, n_inputs, n_keys)
 
     step = jax.jit(
         lambda t: groupby_aggregate_capped(
@@ -148,7 +147,6 @@ def bench_groupby_chunked(platform, n=100_000_000, n_inputs=2):
     single giant variadic sort of ``bench_groupby``."""
     import jax
 
-    from spark_rapids_jni_tpu.column import Column, Table
     from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
     from spark_rapids_jni_tpu.ops.groupby_chunked import (
         groupby_aggregate_capped_chunked,
@@ -190,14 +188,13 @@ def bench_groupby_packed(platform, n=100_000_000, n_inputs=2,
     groupby100m_chunked/groupby100m decides the headline formulation."""
     import jax
 
-    from spark_rapids_jni_tpu.column import Column, Table
     from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
     from spark_rapids_jni_tpu.ops.groupby_packed import (
         groupby_aggregate_packed_chunked,
     )
 
     n_keys = 10_000
-    hosts, inputs = _gen_groupby_inputs(n, n_inputs)
+    hosts, inputs = _gen_groupby_inputs(n, n_inputs, n_keys)
 
     step = jax.jit(
         lambda t: groupby_aggregate_packed_chunked(
@@ -231,14 +228,13 @@ def bench_groupby_flat(platform, n=16_000_000, values_via="sort",
     as sort payloads vs a word-only sort plus permutation gather."""
     import jax
 
-    from spark_rapids_jni_tpu.column import Column, Table
     from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
     from spark_rapids_jni_tpu.ops.groupby_packed import (
         groupby_aggregate_packed_flat,
     )
 
     n_keys = 10_000
-    hosts, inputs = _gen_groupby_inputs(n, n_inputs)
+    hosts, inputs = _gen_groupby_inputs(n, n_inputs, n_keys)
 
     step = jax.jit(
         lambda t: groupby_aggregate_packed_flat(

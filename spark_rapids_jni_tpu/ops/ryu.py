@@ -1,0 +1,477 @@
+"""Vectorized Ryu: shortest round-trip decimal digits for f64/f32.
+
+The float->string cast surface needs, per element, the shortest decimal
+``digits x 10^exp10`` that parses back to the exact same float — the
+problem GPU libcudf solves with a device Ryu port (its
+``ftos_converter`` inside strings/convert) and the reference inherits
+through cudf's cast surface. A TPU has no per-thread scalar loops, so
+this is Ryu re-expressed as fixed-shape u64 vector arithmetic:
+
+* the 128-bit ``(5^q)`` / ``(2^k / 5^q)`` factor tables are generated
+  at import time from exact Python bigints (no transcribed magic
+  tables — the bit counts are the published invariants);
+* the 64x128->shifted-64 multiplies decompose into 32-bit limbs (every
+  32x32 product is exact in u64);
+* the data-dependent digit-trimming loops become fixed-trip masked
+  ``fori_loop``s (<= 17 digits for f64, <= 9 for f32), shared by both
+  cores (:func:`_trim_loop`).
+
+Returns digits + decimal exponent + special-value masks; the string
+assembly (Java ``Double.toString`` placement rules: plain decimal for
+1e-3 <= |v| < 1e7, scientific otherwise) lives with the other
+formatters in ``ops/strings``.
+
+Reference parity: cudf ``cpp/src/strings/convert/convert_floats.cu``
+(ftos_converter's shortest-significand contract); algorithm: Ulf
+Adams, "Ryu: fast float-to-string conversion", PLDI 2018.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# tables (exact bigint generation, split into u64 limbs)
+# ---------------------------------------------------------------------------
+
+_D_POW5_INV_BITCOUNT = 125
+_D_POW5_BITCOUNT = 125
+_F_POW5_INV_BITCOUNT = 59
+_F_POW5_BITCOUNT = 61
+
+
+def _pow5bits(e: int) -> int:
+    """ceil(log2(5^e)) + 1-ish bound used by Ryu: exact for 0<=e<=3528."""
+    return ((e * 1217359) >> 19) + 1
+
+
+@functools.lru_cache(maxsize=1)
+def _double_tables():
+    inv_lo, inv_hi = [], []
+    for q in range(342):
+        pow5 = 5 ** q
+        j = _pow5bits(q) - 1 + _D_POW5_INV_BITCOUNT
+        inv = (1 << j) // pow5 + 1
+        inv_lo.append(inv & 0xFFFFFFFFFFFFFFFF)
+        inv_hi.append(inv >> 64)
+    sp_lo, sp_hi = [], []
+    for i in range(326):
+        pow5 = 5 ** i
+        shift = _pow5bits(i) - _D_POW5_BITCOUNT
+        v = pow5 >> shift if shift >= 0 else pow5 << -shift
+        sp_lo.append(v & 0xFFFFFFFFFFFFFFFF)
+        sp_hi.append(v >> 64)
+    u = lambda a: np.array(a, dtype=np.uint64)  # numpy: safe to cache
+    return u(inv_lo), u(inv_hi), u(sp_lo), u(sp_hi)
+
+
+@functools.lru_cache(maxsize=1)
+def _float_tables():
+    inv = []
+    for q in range(31):
+        pow5 = 5 ** q
+        j = _pow5bits(q) - 1 + _F_POW5_INV_BITCOUNT
+        inv.append((1 << j) // pow5 + 1)
+    sp = []
+    for i in range(48):
+        pow5 = 5 ** i
+        shift = _pow5bits(i) - _F_POW5_BITCOUNT
+        sp.append(pow5 >> shift if shift >= 0 else pow5 << -shift)
+    u = lambda a: np.array(a, dtype=np.uint64)  # numpy: safe to cache
+    return u(inv), u(sp)
+
+
+# ---------------------------------------------------------------------------
+# u64 limb arithmetic
+# ---------------------------------------------------------------------------
+
+_MASK32 = jnp.uint64(0xFFFFFFFF)
+
+
+def _umul128(a, b):
+    """(hi, lo) of the exact 128-bit product of two u64 vectors."""
+    a_lo = a & _MASK32
+    a_hi = a >> jnp.uint64(32)
+    b_lo = b & _MASK32
+    b_hi = b >> jnp.uint64(32)
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    mid = (ll >> jnp.uint64(32)) + (lh & _MASK32) + (hl & _MASK32)
+    lo = (mid << jnp.uint64(32)) | (ll & _MASK32)
+    hi = (
+        hh
+        + (lh >> jnp.uint64(32))
+        + (hl >> jnp.uint64(32))
+        + (mid >> jnp.uint64(32))
+    )
+    return hi, lo
+
+
+def _shiftright128(hi, lo, dist):
+    """(hi:lo) >> dist for 0 < dist < 64 (vector dist)."""
+    return (hi << (jnp.uint64(64) - dist)) | (lo >> dist)
+
+
+def _mulshift64(m, factor_hi, factor_lo, j):
+    """(m * (factor_hi:factor_lo)) >> j, with 64 < j < 128 and the
+    result guaranteed to fit u64 (Ryu's invariant)."""
+    b0_hi, _ = _umul128(m, factor_lo)
+    b2_hi, b2_lo = _umul128(m, factor_hi)
+    # sum = b2 + (b0 >> 64): 128-bit add, carry into the high word
+    s_lo = b2_lo + b0_hi
+    carry = (s_lo < b2_lo).astype(jnp.uint64)
+    s_hi = b2_hi + carry
+    return _shiftright128(s_hi, s_lo, j - jnp.uint64(64))
+
+
+def _mulshift32(m, factor, shift):
+    """(m * factor) >> shift with m < 2^27, factor < 2^61, 32 < shift:
+    the f2s decomposition — both partial products fit u64 exactly."""
+    f_lo = factor & _MASK32
+    f_hi = factor >> jnp.uint64(32)
+    return (((m * f_lo) >> jnp.uint64(32)) + m * f_hi) >> (
+        shift - jnp.uint64(32)
+    )
+
+
+def _pow5_factor_ge(value, p, max_iter):
+    """True where 5^p divides value (p data-dependent, p <= max_iter).
+
+    Counts factors of five with a fixed-trip masked loop."""
+    five = jnp.uint64(5)
+
+    def step(_, state):
+        v, count, live = state
+        div = v // five
+        is_mult = div * five == v
+        go = live & is_mult & (v != 0)
+        return (
+            jnp.where(go, div, v),
+            count + go.astype(jnp.int32),
+            go,
+        )
+
+    v0 = value
+    count0 = jnp.zeros(value.shape, jnp.int32)
+    live0 = jnp.ones(value.shape, jnp.bool_)
+    _, count, _ = jax.lax.fori_loop(
+        0, max_iter, step, (v0, count0, live0)
+    )
+    return count >= p
+
+
+def _multiple_of_pow2(value, p):
+    one = jnp.uint64(1)
+    return (value & ((one << p.astype(jnp.uint64)) - one)) == 0
+
+
+def _log10_pow2(e):  # e in [0, 1650)
+    return (e * 78913) >> 18
+
+
+def _log10_pow5(e):  # e in [0, 2620)
+    return (e * 732923) >> 20
+
+
+def _trim_loop(vr, vp, vm, last0, vr_tz, vm_tz, trips):
+    """The Ryu digit-removal loops as fixed-trip masked fori_loops.
+
+    First loop removes digits while ``vp/10 > vm/10`` (tracking the
+    last removed vr digit and both trailing-zero flags); the second
+    continues while vm ends in 0, applied only where ``vm_tz`` held
+    (the reference's acceptBounds path). Shared by both cores —
+    ``trips`` bounds the digit count (22 for f64, 11 for f32).
+
+    Returns ``(vr, removed, last, vr_tz, vm_tz)``."""
+    ten = jnp.uint64(10)
+
+    def trim_main(_, state):
+        vr_, vp_, vm_, removed, last, vr_tz_, vm_tz_ = state
+        vp_d = vp_ // ten
+        vm_d = vm_ // ten
+        go = vp_d > vm_d
+        vr_d = vr_ // ten
+        vr_rem = (vr_ - ten * vr_d).astype(jnp.int32)
+        vm_rem0 = vm_ - ten * vm_d == 0
+        return (
+            jnp.where(go, vr_d, vr_),
+            jnp.where(go, vp_d, vp_),
+            jnp.where(go, vm_d, vm_),
+            removed + go.astype(jnp.int32),
+            jnp.where(go, vr_rem, last),
+            jnp.where(go, vr_tz_ & (last == 0), vr_tz_),
+            jnp.where(go, vm_tz_ & vm_rem0, vm_tz_),
+        )
+
+    state = (
+        vr, vp, vm,
+        jnp.zeros(vr.shape, jnp.int32),
+        last0,
+        vr_tz, vm_tz,
+    )
+    vr, vp, vm, removed, last, vr_tz, vm_tz = jax.lax.fori_loop(
+        0, trips, trim_main, state
+    )
+
+    def trim_vm_zeros(_, state):
+        vr_, vp_, vm_, removed, last, vr_tz_ = state
+        vm_d = vm_ // ten
+        go = (vm_ - ten * vm_d == 0) & (vm_ != 0)
+        vr_d = vr_ // ten
+        vr_rem = (vr_ - ten * vr_d).astype(jnp.int32)
+        return (
+            jnp.where(go, vr_d, vr_),
+            jnp.where(go, vp_ // ten, vp_),
+            jnp.where(go, vm_d, vm_),
+            removed + go.astype(jnp.int32),
+            jnp.where(go, vr_rem, last),
+            jnp.where(go, vr_tz_ & (last == 0), vr_tz_),
+        )
+
+    state2 = (vr, vp, vm, removed, last, vr_tz)
+    vr2, _, _, removed2, last2, vr_tz2 = jax.lax.fori_loop(
+        0, trips, trim_vm_zeros, state2
+    )
+    vr = jnp.where(vm_tz, vr2, vr)
+    removed = jnp.where(vm_tz, removed2, removed)
+    last = jnp.where(vm_tz, last2, last)
+    vr_tz = jnp.where(vm_tz, vr_tz2, vr_tz)
+    return vr, removed, last, vr_tz, vm_tz
+
+
+# ---------------------------------------------------------------------------
+# f64 core
+# ---------------------------------------------------------------------------
+
+
+def shortest_decimal64(bits):
+    """Ryu d2d over a u64 bit-pattern vector.
+
+    Returns ``(sign, digits, exp10, is_zero, is_inf, is_nan)`` where
+    for finite nonzero values ``value = ±digits * 10^exp10`` is the
+    shortest, correctly-rounded representation (digits has no trailing
+    zeros)."""
+    bits = bits.astype(jnp.uint64)
+    one = jnp.uint64(1)
+    mant_mask = (one << jnp.uint64(52)) - one
+    ieee_m = bits & mant_mask
+    ieee_e = ((bits >> jnp.uint64(52)) & jnp.uint64(0x7FF)).astype(
+        jnp.int32
+    )
+    sign = (bits >> jnp.uint64(63)) != 0
+    is_zero = (ieee_e == 0) & (ieee_m == 0)
+    is_inf = (ieee_e == 0x7FF) & (ieee_m == 0)
+    is_nan = (ieee_e == 0x7FF) & (ieee_m != 0)
+
+    subnormal = ieee_e == 0
+    e2 = jnp.where(subnormal, 1, ieee_e) - 1023 - 52 - 2
+    m2 = jnp.where(
+        subnormal, ieee_m, ieee_m | (one << jnp.uint64(52))
+    )
+    even = (m2 & one) == 0
+    accept = even
+
+    mv = jnp.uint64(4) * m2
+    # mm = mv - 1 - mm_shift
+    mm_shift = ((ieee_m != 0) | (ieee_e <= 1)).astype(jnp.uint64)
+
+    inv_lo, inv_hi, sp_lo, sp_hi = (
+        jnp.asarray(t) for t in _double_tables()
+    )
+
+    # ---- e2 >= 0 branch -------------------------------------------------
+    e2c = jnp.maximum(e2, 0)
+    q_pos = _log10_pow2(e2c) - (e2c > 3).astype(jnp.int32)
+    k_pos = (
+        _D_POW5_INV_BITCOUNT
+        + (((q_pos * 1217359) >> 19) + 1)
+        - 1
+    )
+    j_pos = (-e2c + q_pos + k_pos).astype(jnp.uint64)
+    qp_idx = jnp.clip(q_pos, 0, 341)
+    fp_hi = inv_hi[qp_idx]
+    fp_lo = inv_lo[qp_idx]
+
+    # ---- e2 < 0 branch --------------------------------------------------
+    e2n = jnp.maximum(-e2, 0)
+    q_neg = _log10_pow5(e2n) - (e2n > 1).astype(jnp.int32)
+    i_neg = jnp.clip(e2n - q_neg, 0, 325)
+    k_neg = (((i_neg * 1217359) >> 19) + 1) - _D_POW5_BITCOUNT
+    j_neg = (q_neg - k_neg).astype(jnp.uint64)
+    fn_hi = sp_hi[i_neg]
+    fn_lo = sp_lo[i_neg]
+
+    pos = e2 >= 0
+    f_hi = jnp.where(pos, fp_hi, fn_hi)
+    f_lo = jnp.where(pos, fp_lo, fn_lo)
+    j = jnp.where(pos, j_pos, j_neg)
+    q = jnp.where(pos, q_pos, q_neg)
+    e10 = jnp.where(pos, q_pos, q_neg + e2)
+
+    mp = mv + jnp.uint64(2)
+    mm = mv - one - mm_shift
+    vr = _mulshift64(mv, f_hi, f_lo, j)
+    vp = _mulshift64(mp, f_hi, f_lo, j)
+    vm = _mulshift64(mm, f_hi, f_lo, j)
+
+    # trailing-zero bookkeeping
+    vr_tz = jnp.zeros(bits.shape, jnp.bool_)
+    vm_tz = jnp.zeros(bits.shape, jnp.bool_)
+    vp_adj = jnp.zeros(bits.shape, jnp.bool_)
+
+    # e2 >= 0, q <= 21 cases
+    small_q = pos & (q <= 21)
+    mv_mod5 = (mv - jnp.uint64(5) * (mv // jnp.uint64(5))) == 0
+    vr_tz = jnp.where(
+        small_q & mv_mod5, _pow5_factor_ge(mv, q, 23), vr_tz
+    )
+    vm_tz = jnp.where(
+        small_q & ~mv_mod5 & accept, _pow5_factor_ge(mm, q, 23), vm_tz
+    )
+    vp_adj = jnp.where(
+        small_q & ~mv_mod5 & ~accept, _pow5_factor_ge(mp, q, 23), vp_adj
+    )
+
+    # e2 < 0 cases
+    neg_q1 = ~pos & (q <= 1)
+    vr_tz = jnp.where(neg_q1, True, vr_tz)
+    vm_tz = jnp.where(neg_q1 & accept, mm_shift == one, vm_tz)
+    vp_adj = jnp.where(neg_q1 & ~accept, True, vp_adj)
+    neg_q63 = ~pos & (q > 1) & (q < 63)
+    vr_tz = jnp.where(
+        neg_q63, _multiple_of_pow2(mv, q - 1), vr_tz
+    )
+
+    vp = vp - vp_adj.astype(jnp.uint64)
+
+    vr, removed, last, vr_tz, vm_tz = _trim_loop(
+        vr, vp, vm, jnp.zeros(bits.shape, jnp.int32), vr_tz, vm_tz, 22
+    )
+
+    # round-to-even on the exact halfway remainder
+    half_even = vr_tz & (last == 5) & ((vr & one) == 0)
+    last = jnp.where(half_even, jnp.int32(4), last)
+    round_up = ((vr == vm) & (~accept | ~vm_tz)) | (last >= 5)
+    digits = vr + round_up.astype(jnp.uint64)
+    exp10 = e10 + removed
+    return sign, digits, exp10, is_zero, is_inf, is_nan
+
+
+# ---------------------------------------------------------------------------
+# f32 core
+# ---------------------------------------------------------------------------
+
+
+def shortest_decimal32(bits):
+    """Ryu f2f over a u32 bit-pattern vector; same contract as
+    :func:`shortest_decimal64` (digits fit 9 decimal digits)."""
+    bits = bits.astype(jnp.uint32)
+    one = jnp.uint64(1)
+    ieee_m = (bits & jnp.uint32((1 << 23) - 1)).astype(jnp.uint64)
+    ieee_e = ((bits >> jnp.uint32(23)) & jnp.uint32(0xFF)).astype(
+        jnp.int32
+    )
+    sign = (bits >> jnp.uint32(31)) != 0
+    is_zero = (ieee_e == 0) & (ieee_m == 0)
+    is_inf = (ieee_e == 0xFF) & (ieee_m == 0)
+    is_nan = (ieee_e == 0xFF) & (ieee_m != 0)
+
+    subnormal = ieee_e == 0
+    e2 = jnp.where(subnormal, 1, ieee_e) - 127 - 23 - 2
+    m2 = jnp.where(subnormal, ieee_m, ieee_m | (one << jnp.uint64(23)))
+    even = (m2 & one) == 0
+    accept = even
+
+    mv = jnp.uint64(4) * m2
+    mm_shift = ((ieee_m != 0) | (ieee_e <= 1)).astype(jnp.uint64)
+    mp = mv + jnp.uint64(2)
+    mm = mv - one - mm_shift
+
+    inv, sp = (jnp.asarray(t) for t in _float_tables())
+
+    # ---- e2 >= 0 -------------------------------------------------------
+    e2c = jnp.maximum(e2, 0)
+    q_pos = _log10_pow2(e2c)
+    k_pos = _F_POW5_INV_BITCOUNT + (((q_pos * 1217359) >> 19) + 1) - 1
+    j_pos = (-e2c + q_pos + k_pos).astype(jnp.uint64)
+    qp_idx = jnp.clip(q_pos, 0, 30)
+    f_pos = inv[qp_idx]
+    # one-digit-lower recompute for the no-trim rounding case
+    qm1 = jnp.clip(q_pos - 1, 0, 30)
+    k_pos1 = _F_POW5_INV_BITCOUNT + (((qm1 * 1217359) >> 19) + 1) - 1
+    j_pos1 = (-e2c + (q_pos - 1) + k_pos1).astype(jnp.uint64)
+    f_pos1 = inv[qm1]
+
+    # ---- e2 < 0 --------------------------------------------------------
+    e2n = jnp.maximum(-e2, 0)
+    q_neg = _log10_pow5(e2n)
+    i_neg = jnp.clip(e2n - q_neg, 0, 47)
+    k_neg = (((i_neg * 1217359) >> 19) + 1) - _F_POW5_BITCOUNT
+    j_neg = (q_neg - k_neg).astype(jnp.uint64)
+    f_neg = sp[i_neg]
+    i1 = jnp.clip(i_neg + 1, 0, 47)
+    j_neg1 = (
+        q_neg - 1 - ((((i1 * 1217359) >> 19) + 1) - _F_POW5_BITCOUNT)
+    ).astype(jnp.uint64)
+    f_neg1 = sp[i1]
+
+    pos = e2 >= 0
+    factor = jnp.where(pos, f_pos, f_neg)
+    j = jnp.where(pos, j_pos, j_neg)
+    q = jnp.where(pos, q_pos, q_neg)
+    e10 = jnp.where(pos, q_pos, q_neg + e2)
+    factor1 = jnp.where(pos, f_pos1, f_neg1)
+    j1 = jnp.where(pos, j_pos1, j_neg1)
+
+    vr = _mulshift32(mv, factor, j)
+    vp = _mulshift32(mp, factor, j)
+    vm = _mulshift32(mm, factor, j)
+
+    ten = jnp.uint64(10)
+    # f2s precomputes lastRemovedDigit one scale down when the trim
+    # loop will not run (q != 0 and (vp-1)/10 <= vm/10)
+    pre_last = (_mulshift32(mv, factor1, j1) % ten).astype(jnp.int32)
+    need_pre = (q != 0) & ((vp - one) // ten <= vm // ten)
+    last0 = jnp.where(need_pre, pre_last, 0)
+
+    vr_tz = jnp.zeros(bits.shape, jnp.bool_)
+    vm_tz = jnp.zeros(bits.shape, jnp.bool_)
+    vp_adj = jnp.zeros(bits.shape, jnp.bool_)
+
+    small_q = pos & (q <= 9)
+    mv_mod5 = (mv % jnp.uint64(5)) == 0
+    vr_tz = jnp.where(
+        small_q & mv_mod5, _pow5_factor_ge(mv, q, 11), vr_tz
+    )
+    vm_tz = jnp.where(
+        small_q & ~mv_mod5 & accept, _pow5_factor_ge(mm, q, 11), vm_tz
+    )
+    vp_adj = jnp.where(
+        small_q & ~mv_mod5 & ~accept, _pow5_factor_ge(mp, q, 11), vp_adj
+    )
+
+    neg_q1 = ~pos & (q <= 1)
+    vr_tz = jnp.where(neg_q1, True, vr_tz)
+    vm_tz = jnp.where(neg_q1 & accept, mm_shift == one, vm_tz)
+    vp_adj = jnp.where(neg_q1 & ~accept, True, vp_adj)
+    neg_q31 = ~pos & (q > 1) & (q < 31)
+    vr_tz = jnp.where(neg_q31, _multiple_of_pow2(mv, q - 1), vr_tz)
+
+    vp = vp - vp_adj.astype(jnp.uint64)
+
+    vr, removed, last, vr_tz, vm_tz = _trim_loop(
+        vr, vp, vm, last0, vr_tz, vm_tz, 11
+    )
+
+    half_even = vr_tz & (last == 5) & ((vr & one) == 0)
+    last = jnp.where(half_even, jnp.int32(4), last)
+    round_up = ((vr == vm) & (~accept | ~vm_tz)) | (last >= 5)
+    digits = vr + round_up.astype(jnp.uint64)
+    exp10 = e10 + removed
+    return sign, digits, exp10, is_zero, is_inf, is_nan

@@ -254,10 +254,11 @@ def cast(col: Column, to: dt.DType) -> Column:
 
     string -> int/float/bool/decimal parse fully on device (vectorized
     byte arithmetic over the padded matrix; unparseable rows become
-    null, the Spark non-ANSI contract). int/bool -> string format on
-    device; float/decimal -> string go through a host formatting pass
-    (eager, like the cudf call model) until a device float formatter
-    lands.
+    null, the Spark non-ANSI contract). int/bool/float -> string format
+    on device (floats via the vectorized Ryu core, ops/ryu.py);
+    decimal -> string formats on device for the common scale range,
+    with a host pass left only for the DECIMAL128 / positive-scale
+    corners.
     """
     if col.dtype.is_string and to.is_string:
         return col
@@ -288,8 +289,12 @@ def cast(col: Column, to: dt.DType) -> Column:
             # needs the 128-bit limb digit extraction and positive
             # scales are a host corner
             return _format_decimal(col)
-        # floats (shortest round-trip repr needs a Ryu-style kernel)
-        # and the decimal corners above: host formatting pass
+        if col.dtype.id in (dt.TypeId.FLOAT32, dt.TypeId.FLOAT64):
+            # device Ryu (ops/ryu.py): shortest round-trip digits +
+            # Java Double.toString placement, no host round-trip
+            return _format_float(col)
+        # remaining decimal corners (DECIMAL128, positive scales):
+        # host formatting pass
         return _format_host(col)
     raise TypeError(f"not a string cast: {col.dtype} -> {to}")
 
@@ -572,6 +577,23 @@ def _format_bool(col: Column) -> Column:
     return Column(data, dt.STRING, col.validity, lens)
 
 
+def _digit_matrix(mag, K):
+    """(digits least-significant-first (n, K+1) u8, digit count (n,))
+    of a u64 magnitude vector — the shared core of every decimal
+    formatter in this module."""
+    pows = jnp.asarray(
+        [np.uint64(10) ** np.uint64(k) for k in range(K + 1)]
+    )
+    digs = ((mag[:, None] // pows[None, :]) % jnp.uint64(10)).astype(
+        jnp.uint8
+    )
+    ndig = jnp.maximum(
+        jnp.sum((mag[:, None] >= pows[None, :]).astype(jnp.int32), axis=1),
+        1,
+    )
+    return digs, ndig
+
+
 def _format_int(col: Column) -> Column:
     """INT -> STRING fully on device: extract up to 19 decimal digits,
     suppress leading zeros, prepend the sign."""
@@ -581,21 +603,14 @@ def _format_int(col: Column) -> Column:
     # magnitude in uint64 (covers INT64_MIN, whose negation overflows i64)
     mag = jnp.where(neg, (~v.astype(jnp.uint64)) + jnp.uint64(1),
                     v.astype(jnp.uint64))
-    K = 20
-    pows = jnp.asarray([np.uint64(10) ** np.uint64(k) for k in range(K)])
-    digs = ((mag[:, None] // pows[None, :]) % jnp.uint64(10)).astype(
-        jnp.uint8
-    )  # digs[:, k] = 10^k digit (least significant first)
-    ndig = jnp.maximum(
-        jnp.sum((mag[:, None] >= pows[None, :]).astype(jnp.int32), axis=1),
-        1,
-    )
+    K = 19
+    digs, ndig = _digit_matrix(mag, K)
     lens = ndig + neg.astype(jnp.int32)
-    width = K + 1
+    width = K + 2
     j = jnp.arange(width)[None, :]
     # output byte j: '-' at 0 when negative, else digit (ndig-1-(j-neg))
     digit_idx = jnp.clip(
-        ndig[:, None] - 1 - (j - neg.astype(jnp.int32)[:, None]), 0, K - 1
+        ndig[:, None] - 1 - (j - neg.astype(jnp.int32)[:, None]), 0, K
     )
     chars = jnp.take_along_axis(digs, digit_idx, axis=1) + ord("0")
     out = jnp.where(
@@ -620,15 +635,8 @@ def _format_decimal(col: Column) -> Column:
     mag = jnp.where(
         neg, (~v.astype(jnp.uint64)) + jnp.uint64(1), v.astype(jnp.uint64)
     )
-    K = 20
-    pows = jnp.asarray([np.uint64(10) ** np.uint64(k) for k in range(K)])
-    digs = ((mag[:, None] // pows[None, :]) % jnp.uint64(10)).astype(
-        jnp.uint8
-    )
-    ndig = jnp.maximum(
-        jnp.sum((mag[:, None] >= pows[None, :]).astype(jnp.int32), axis=1),
-        1,
-    )
+    K = 19
+    digs, ndig = _digit_matrix(mag, K)
     int_digits = jnp.maximum(ndig - d, 1)
     lens = neg.astype(jnp.int32) + int_digits + 1 + d
     width = K + 3  # sign + up to K digits + point + slack
@@ -641,12 +649,162 @@ def _format_decimal(col: Column) -> Column:
     int_idx = int_digits[:, None] - 1 - p + d
     frac_idx = d - 1 - (p - point_at - 1)
     digit_idx = jnp.clip(
-        jnp.where(p < point_at, int_idx, frac_idx), 0, K - 1
+        jnp.where(p < point_at, int_idx, frac_idx), 0, K
     )
     chars = jnp.take_along_axis(digs, digit_idx, axis=1) + ord("0")
     out = jnp.where(p == point_at, ord("."), chars)
     out = jnp.where(
         neg[:, None] & (j == 0), ord("-"), out
+    )
+    out = jnp.where(j < lens[:, None], out, 0).astype(jnp.uint8)
+    return Column(out, dt.STRING, col.validity, lens.astype(jnp.int32))
+
+
+def _format_float(col: Column) -> Column:
+    """FLOAT32/64 -> STRING fully on device.
+
+    Digits come from the vectorized Ryu core (ops/ryu.py: shortest
+    round-trip significand, exactly libcudf's ftos_converter contract);
+    this function applies the Java ``Double.toString`` placement rules
+    the host fallback implemented: plain decimal when the normalized
+    exponent is in [-3, 7) (always at least one fractional digit, so
+    integral values read "4.0"), scientific ``d.fracEexp`` otherwise,
+    "NaN" / "Infinity" / "-Infinity" / signed zero verbatim."""
+    from .ryu import shortest_decimal32, shortest_decimal64
+
+    v = compute.values(col)
+    if col.dtype.id == dt.TypeId.FLOAT64:
+        bits = jax.lax.bitcast_convert_type(v, jnp.uint64)
+        sign, digits, exp10, is_zero, is_inf, is_nan = (
+            shortest_decimal64(bits)
+        )
+        K = 17  # max shortest-significand digits
+        width = 26  # sign + d + point + 16 frac + E + sign + 3 exp
+    else:
+        bits = jax.lax.bitcast_convert_type(
+            v.astype(jnp.float32), jnp.uint32
+        )
+        sign, digits, exp10, is_zero, is_inf, is_nan = (
+            shortest_decimal32(bits)
+        )
+        K = 9
+        width = 18
+    digs, olen = _digit_matrix(digits, K)
+    sci_exp = olen - 1 + exp10
+    plain = (sci_exp >= -3) & (sci_exp < 7)
+
+    neg = sign & ~is_nan
+    o = neg.astype(jnp.int32)
+    # integer-part digit count (plain): sciExp+1 real digits, padded
+    # with zeros when the digits run out (E >= 0); sciExp < 0 prints
+    # the single forced '0'
+    int_len = jnp.where(plain & (sci_exp >= 0), sci_exp + 1, 1)
+    lead_zeros = jnp.where(
+        plain & (sci_exp < 0), -sci_exp - 1, 0
+    )  # zeros after "0."
+    frac_digits = jnp.where(
+        plain,
+        jnp.where(
+            sci_exp >= 0,
+            jnp.maximum(olen - (sci_exp + 1), 1),
+            lead_zeros + olen,
+        ),
+        jnp.maximum(olen - 1, 1),
+    )
+    point_at = o + int_len
+
+    # exponent suffix (scientific only): E[-]ddd, no leading zeros
+    eabs = jnp.abs(sci_exp)
+    e_ndig = jnp.where(eabs >= 100, 3, jnp.where(eabs >= 10, 2, 1))
+    e_neg = (sci_exp < 0).astype(jnp.int32)
+    suffix_len = jnp.where(plain, 0, 1 + e_neg + e_ndig)
+
+    lens = o + int_len + 1 + frac_digits + suffix_len
+    lens = jnp.where(is_nan, 3, lens)
+    lens = jnp.where(is_inf, 8 + o, lens)
+    lens = jnp.where(is_zero, 3 + o, lens)
+
+    j = jnp.arange(width)[None, :]
+    p = j - o[:, None]  # position after the sign
+
+    # ---- mantissa digit index per position ---------------------------
+    # most-significant-first index i -> ls index olen-1-i
+    int_i = p  # i for integer positions (plain, sciExp >= 0)
+    frac_start = point_at[:, None] + 1
+    frac_k = j - frac_start  # 0-based fraction position
+    plain_pos_i = jnp.where(
+        j < point_at[:, None], int_i, int_len[:, None] + frac_k
+    )
+    # sciExp < 0 plain: '0' . zeros digits
+    planb_digit = frac_k - lead_zeros[:, None]  # index into digits
+    sci_i = jnp.where(j < point_at[:, None], 0, 1 + frac_k)
+
+    ms_i = jnp.where(
+        plain[:, None],
+        jnp.where(
+            (sci_exp >= 0)[:, None], plain_pos_i,
+            jnp.where(j < point_at[:, None], K, planb_digit),
+        ),
+        sci_i,
+    )  # index K = forced zero sentinel
+    in_digits = (ms_i >= 0) & (ms_i < olen[:, None])
+    ls_idx = jnp.clip(olen[:, None] - 1 - ms_i, 0, K)
+    digit_chars = jnp.where(
+        in_digits,
+        jnp.take_along_axis(digs, ls_idx, axis=1),
+        0,
+    ) + ord("0")
+
+    out = jnp.where(j == point_at[:, None], ord("."), digit_chars)
+
+    # ---- scientific suffix ------------------------------------------
+    e_at = point_at + 1 + frac_digits  # position of 'E'
+    out = jnp.where(
+        ~plain[:, None] & (j == e_at[:, None]), ord("E"), out
+    )
+    out = jnp.where(
+        ~plain[:, None] & (e_neg == 1)[:, None]
+        & (j == (e_at + 1)[:, None]),
+        ord("-"),
+        out,
+    )
+    e_digit_ms = j - (e_at + 1 + e_neg)[:, None]  # 0-based ms index
+    e_pows = jnp.asarray([1, 10, 100, 1000], dtype=jnp.int32)
+    e_ls = jnp.clip(e_ndig[:, None] - 1 - e_digit_ms, 0, 3)
+    e_chars = (
+        (eabs[:, None] // jnp.take(e_pows, e_ls)) % 10
+    ).astype(jnp.uint8) + ord("0")
+    in_exp = (e_digit_ms >= 0) & (e_digit_ms < e_ndig[:, None])
+    out = jnp.where(~plain[:, None] & in_exp, e_chars, out)
+
+    # ---- sign + specials --------------------------------------------
+    out = jnp.where(neg[:, None] & (j == 0), ord("-"), out)
+    nan_s = jnp.asarray(
+        np.frombuffer(b"NaN".ljust(width, b"\0"), dtype=np.uint8)
+    )
+    inf_s = jnp.asarray(
+        np.frombuffer(b"Infinity".ljust(width, b"\0"), dtype=np.uint8)
+    )
+    zero_s = jnp.asarray(
+        np.frombuffer(b"0.0".ljust(width, b"\0"), dtype=np.uint8)
+    )
+    out = jnp.where(is_nan[:, None], nan_s[None, :], out)
+    shifted_inf = jnp.where(
+        (j - o[:, None] >= 0) & (j - o[:, None] < 8),
+        inf_s[jnp.clip(j - o[:, None], 0, width - 1)],
+        0,
+    )
+    out = jnp.where(is_inf[:, None], shifted_inf, out)
+    shifted_zero = jnp.where(
+        (j - o[:, None] >= 0) & (j - o[:, None] < 3),
+        zero_s[jnp.clip(j - o[:, None], 0, width - 1)],
+        0,
+    )
+    out = jnp.where(is_zero[:, None], shifted_zero, out)
+    out = jnp.where(
+        (is_inf | is_zero)[:, None] & neg[:, None] & (j == 0),
+        ord("-"),
+        out,
     )
     out = jnp.where(j < lens[:, None], out, 0).astype(jnp.uint8)
     return Column(out, dt.STRING, col.validity, lens.astype(jnp.int32))

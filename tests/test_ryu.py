@@ -1,0 +1,155 @@
+"""Device Ryu float->string (ops/ryu.py + strings._format_float).
+
+Oracles: Python repr IS shortest-round-trip for f64 (same contract as
+Ryu), so digit/exponent agreement is exact; for f32 numpy's
+``format_float_scientific(unique=True)`` provides the shortest f32
+significand. The formatted-string layer is checked against the host
+formatter (f64, byte-identical) and against round-trip + Java
+placement properties (f32, where the old host fallback formatted the
+promoted double and was simply wider than Java's Float.toString)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column
+from spark_rapids_jni_tpu.ops import strings as S
+from spark_rapids_jni_tpu.ops.ryu import (
+    shortest_decimal32,
+    shortest_decimal64,
+)
+
+EDGE64 = np.array(
+    [0.0, -0.0, 1.0, -1.0, 0.5, 0.1, 0.3, 1e-3, 9.999e-4, 1e7,
+     9999999.5, 123456.789, 5e-324, -5e-324, 2.2250738585072014e-308,
+     1.7976931348623157e308, 1 / 3, 2 / 3, 1e22, 1e23, 8e9, 3.14159,
+     100.0, 4.0, float("nan"), float("inf"), float("-inf")]
+)
+
+
+def _repr_digits(v):
+    s = repr(float(v))
+    if "e" in s:
+        m, e = s.split("e")
+        e = int(e)
+    else:
+        m, e = s, 0
+    m = m.lstrip("-")
+    ip, _, fp = m.partition(".")
+    digs = (ip + fp).lstrip("0")
+    exp10 = e - len(fp)
+    d2 = digs.rstrip("0")
+    exp10 += len(digs) - len(d2)
+    return int(d2 or "0"), exp10
+
+
+def test_f64_digits_match_python_repr():
+    rng = np.random.default_rng(7)
+    bits = rng.integers(0, 1 << 64, 30000, dtype=np.uint64)
+    vals = bits.view(np.float64)
+    vals = vals[np.isfinite(vals) & (vals != 0)][:15000]
+    sign, digits, exp10, *_ = jax.jit(shortest_decimal64)(
+        jnp.asarray(vals.view(np.uint64))
+    )
+    digits = np.asarray(digits)
+    exp10 = np.asarray(exp10)
+    sign = np.asarray(sign)
+    for k in range(len(vals)):
+        dw, ew = _repr_digits(abs(vals[k]))
+        assert (int(digits[k]), int(exp10[k])) == (dw, ew), vals[k].hex()
+        assert bool(sign[k]) == (vals[k] < 0)
+
+
+def test_f32_digits_shortest_roundtrip():
+    rng = np.random.default_rng(8)
+    bits = rng.integers(0, 1 << 32, 30000, dtype=np.uint64).astype(
+        np.uint32
+    )
+    vals = bits.view(np.float32)
+    vals = vals[np.isfinite(vals) & (vals != 0)][:15000]
+    sign, digits, exp10, *_ = jax.jit(shortest_decimal32)(
+        jnp.asarray(vals.view(np.uint32))
+    )
+    digits = np.asarray(digits)
+    exp10 = np.asarray(exp10)
+    for k in range(len(vals)):
+        s = np.format_float_scientific(
+            np.float32(abs(vals[k])), unique=True, trim="-"
+        )
+        m, e = s.split("e")
+        m = m.replace(".", "")
+        digs = m.lstrip("0").rstrip("0") or "0"
+        got = str(int(digits[k]))
+        # same significand digits (shortest + correctly rounded)
+        assert got == digs, (vals[k], got, digs)
+    # bitwise round-trip via the decimal string
+    col = Column.from_numpy(vals)
+    strs = S.cast(col, dt.STRING).to_pylist()
+    back = np.array([np.float32(s) for s in strs], dtype=np.float32)
+    np.testing.assert_array_equal(
+        back.view(np.uint32), vals.view(np.uint32)
+    )
+
+
+def test_f64_format_matches_host_formatter():
+    rng = np.random.default_rng(9)
+    bits = rng.integers(0, 1 << 64, 20000, dtype=np.uint64)
+    vals = bits.view(np.float64)
+    vals = vals[np.isfinite(vals)][:10000]
+    vals = np.concatenate([vals, EDGE64])
+    col = Column.from_numpy(vals)
+    got = S.cast(col, dt.STRING).to_pylist()
+    want = S._format_host(col).to_pylist()
+    assert got == want
+
+
+def test_f64_format_java_examples():
+    vals = np.array(
+        [4.0, 0.001, 5e-4, 1e7, 1234.5678, float("nan"), float("inf"),
+         float("-inf"), 0.0, -0.0, 1e-3, 123456.78]
+    )
+    col = Column.from_numpy(vals)
+    got = S.cast(col, dt.STRING).to_pylist()
+    assert got == [
+        "4.0", "0.001", "5.0E-4", "1.0E7", "1234.5678", "NaN",
+        "Infinity", "-Infinity", "0.0", "-0.0", "0.001", "123456.78",
+    ]
+
+
+def test_f32_format_java_examples():
+    vals = np.array(
+        [0.1, 4.0, 5e-4, 3.4028235e38, 1.4e-45, 0.0, -2.5],
+        dtype=np.float32,
+    )
+    col = Column.from_numpy(vals)
+    got = S.cast(col, dt.STRING).to_pylist()
+    # note 1.0E-45 for FLOAT_MIN_SUBNORMAL: the true shortest
+    # round-trip (Ryu / cudf contract) — legacy Java printed the
+    # longer "1.4E-45"
+    assert got == [
+        "0.1", "4.0", "5.0E-4", "3.4028235E38", "1.0E-45", "0.0",
+        "-2.5",
+    ]
+
+
+def test_f64_roundtrip_bitexact():
+    rng = np.random.default_rng(10)
+    bits = rng.integers(0, 1 << 64, 20000, dtype=np.uint64)
+    vals = bits.view(np.float64)
+    vals = vals[np.isfinite(vals)][:10000]
+    col = Column.from_numpy(vals)
+    strs = S.cast(col, dt.STRING).to_pylist()
+    back = np.array([float(s) for s in strs])
+    np.testing.assert_array_equal(
+        back.view(np.uint64), vals.view(np.uint64)
+    )
+
+
+def test_nulls_preserved():
+    from spark_rapids_jni_tpu.column import Table
+
+    t = Table.from_pydict({"a": [1.5, None, float("nan")]})
+    got = S.cast(t["a"], dt.STRING).to_pylist()
+    assert got == ["1.5", None, "NaN"]
