@@ -44,8 +44,10 @@ _F_POW5_INV_BITCOUNT = 59
 _F_POW5_BITCOUNT = 61
 
 
-def _pow5bits(e: int) -> int:
-    """ceil(log2(5^e)) + 1-ish bound used by Ryu: exact for 0<=e<=3528."""
+def _pow5bits(e):
+    """ceil(log2(5^e)) + 1-ish bound used by Ryu: exact for
+    0 <= e <= 3528. Works on Python ints (table generation) and traced
+    int32 arrays (per-element j/k shifts) alike."""
     return ((e * 1217359) >> 19) + 1
 
 
@@ -140,30 +142,16 @@ def _mulshift32(m, factor, shift):
     )
 
 
-def _pow5_factor_ge(value, p, max_iter):
-    """True where 5^p divides value (p data-dependent, p <= max_iter).
+_POW5_U64 = np.array([5 ** k for k in range(23)], dtype=np.uint64)
 
-    Counts factors of five with a fixed-trip masked loop."""
-    five = jnp.uint64(5)
 
-    def step(_, state):
-        v, count, live = state
-        div = v // five
-        is_mult = div * five == v
-        go = live & is_mult & (v != 0)
-        return (
-            jnp.where(go, div, v),
-            count + go.astype(jnp.int32),
-            go,
-        )
-
-    v0 = value
-    count0 = jnp.zeros(value.shape, jnp.int32)
-    live0 = jnp.ones(value.shape, jnp.bool_)
-    _, count, _ = jax.lax.fori_loop(
-        0, max_iter, step, (v0, count0, live0)
-    )
-    return count >= p
+def _pow5_factor_ge(value, p, max_iter=None):
+    """True where 5^p divides value (value != 0; p <= 22, inside the
+    callers' q-window guards). One gather + one mod — 5^22 fits u64,
+    so no loop is needed."""
+    del max_iter  # kept for call-site symmetry; the table covers p
+    t = jnp.asarray(_POW5_U64)[jnp.clip(p, 0, 22)]
+    return value % t == 0
 
 
 def _multiple_of_pow2(value, p):
@@ -188,7 +176,10 @@ def _trim_loop(vr, vp, vm, last0, vr_tz, vm_tz, trips):
     (the reference's acceptBounds path). Shared by both cores —
     ``trips`` bounds the digit count (22 for f64, 11 for f32).
 
-    Returns ``(vr, removed, last, vr_tz, vm_tz)``."""
+    Returns ``(vr, vm, removed, last, vr_tz, vm_tz)`` — vm is the
+    TRIMMED lower bound: the final ``vr == vm`` round-up decision must
+    compare like with like (comparing against the pre-trim vm breaks
+    the boundary round-up on e.g. 2^-24)."""
     ten = jnp.uint64(10)
 
     def trim_main(_, state):
@@ -235,14 +226,15 @@ def _trim_loop(vr, vp, vm, last0, vr_tz, vm_tz, trips):
         )
 
     state2 = (vr, vp, vm, removed, last, vr_tz)
-    vr2, _, _, removed2, last2, vr_tz2 = jax.lax.fori_loop(
+    vr2, _, vm2, removed2, last2, vr_tz2 = jax.lax.fori_loop(
         0, trips, trim_vm_zeros, state2
     )
     vr = jnp.where(vm_tz, vr2, vr)
+    vm = jnp.where(vm_tz, vm2, vm)
     removed = jnp.where(vm_tz, removed2, removed)
     last = jnp.where(vm_tz, last2, last)
     vr_tz = jnp.where(vm_tz, vr_tz2, vr_tz)
-    return vr, removed, last, vr_tz, vm_tz
+    return vr, vm, removed, last, vr_tz, vm_tz
 
 
 # ---------------------------------------------------------------------------
@@ -288,11 +280,7 @@ def shortest_decimal64(bits):
     # ---- e2 >= 0 branch -------------------------------------------------
     e2c = jnp.maximum(e2, 0)
     q_pos = _log10_pow2(e2c) - (e2c > 3).astype(jnp.int32)
-    k_pos = (
-        _D_POW5_INV_BITCOUNT
-        + (((q_pos * 1217359) >> 19) + 1)
-        - 1
-    )
+    k_pos = _D_POW5_INV_BITCOUNT + _pow5bits(q_pos) - 1
     j_pos = (-e2c + q_pos + k_pos).astype(jnp.uint64)
     qp_idx = jnp.clip(q_pos, 0, 341)
     fp_hi = inv_hi[qp_idx]
@@ -302,7 +290,7 @@ def shortest_decimal64(bits):
     e2n = jnp.maximum(-e2, 0)
     q_neg = _log10_pow5(e2n) - (e2n > 1).astype(jnp.int32)
     i_neg = jnp.clip(e2n - q_neg, 0, 325)
-    k_neg = (((i_neg * 1217359) >> 19) + 1) - _D_POW5_BITCOUNT
+    k_neg = _pow5bits(i_neg) - _D_POW5_BITCOUNT
     j_neg = (q_neg - k_neg).astype(jnp.uint64)
     fn_hi = sp_hi[i_neg]
     fn_lo = sp_lo[i_neg]
@@ -350,7 +338,7 @@ def shortest_decimal64(bits):
 
     vp = vp - vp_adj.astype(jnp.uint64)
 
-    vr, removed, last, vr_tz, vm_tz = _trim_loop(
+    vr, vm, removed, last, vr_tz, vm_tz = _trim_loop(
         vr, vp, vm, jnp.zeros(bits.shape, jnp.int32), vr_tz, vm_tz, 22
     )
 
@@ -398,13 +386,13 @@ def shortest_decimal32(bits):
     # ---- e2 >= 0 -------------------------------------------------------
     e2c = jnp.maximum(e2, 0)
     q_pos = _log10_pow2(e2c)
-    k_pos = _F_POW5_INV_BITCOUNT + (((q_pos * 1217359) >> 19) + 1) - 1
+    k_pos = _F_POW5_INV_BITCOUNT + _pow5bits(q_pos) - 1
     j_pos = (-e2c + q_pos + k_pos).astype(jnp.uint64)
     qp_idx = jnp.clip(q_pos, 0, 30)
     f_pos = inv[qp_idx]
     # one-digit-lower recompute for the no-trim rounding case
     qm1 = jnp.clip(q_pos - 1, 0, 30)
-    k_pos1 = _F_POW5_INV_BITCOUNT + (((qm1 * 1217359) >> 19) + 1) - 1
+    k_pos1 = _F_POW5_INV_BITCOUNT + _pow5bits(qm1) - 1
     j_pos1 = (-e2c + (q_pos - 1) + k_pos1).astype(jnp.uint64)
     f_pos1 = inv[qm1]
 
@@ -412,12 +400,12 @@ def shortest_decimal32(bits):
     e2n = jnp.maximum(-e2, 0)
     q_neg = _log10_pow5(e2n)
     i_neg = jnp.clip(e2n - q_neg, 0, 47)
-    k_neg = (((i_neg * 1217359) >> 19) + 1) - _F_POW5_BITCOUNT
+    k_neg = _pow5bits(i_neg) - _F_POW5_BITCOUNT
     j_neg = (q_neg - k_neg).astype(jnp.uint64)
     f_neg = sp[i_neg]
     i1 = jnp.clip(i_neg + 1, 0, 47)
     j_neg1 = (
-        q_neg - 1 - ((((i1 * 1217359) >> 19) + 1) - _F_POW5_BITCOUNT)
+        q_neg - 1 - (_pow5bits(i1) - _F_POW5_BITCOUNT)
     ).astype(jnp.uint64)
     f_neg1 = sp[i1]
 
@@ -465,7 +453,7 @@ def shortest_decimal32(bits):
 
     vp = vp - vp_adj.astype(jnp.uint64)
 
-    vr, removed, last, vr_tz, vm_tz = _trim_loop(
+    vr, vm, removed, last, vr_tz, vm_tz = _trim_loop(
         vr, vp, vm, last0, vr_tz, vm_tz, 11
     )
 
@@ -475,3 +463,143 @@ def shortest_decimal32(bits):
     digits = vr + round_up.astype(jnp.uint64)
     exp10 = e10 + removed
     return sign, digits, exp10, is_zero, is_inf, is_nan
+
+
+# ---------------------------------------------------------------------------
+# Eisel-Lemire: correctly-rounded decimal -> binary (the parse inverse)
+# ---------------------------------------------------------------------------
+#
+# The string->float cast needs w x 10^q rounded correctly to f64/f32.
+# This is the Eisel-Lemire fast path (Lemire, "Number parsing at a
+# gigabyte per second", SP&E 2021; the algorithm under fast_float and
+# Go/Rust strconv) vectorized the same way as the Ryu core above: one
+# 128-bit truncated power-of-five table (exact bigint generation), the
+# 64x64->128 product in 32-bit limbs, and branch-free mask selection.
+# For w <= 19 digits the 128-bit product provably suffices (paper
+# Thm. 1 + the explicit round-to-even window), so no slow path exists
+# on this route; callers truncate longer mantissas to their top 19
+# digits (documented <=1-ulp corner shared with every fast parser
+# before its big-int fallback).
+
+_EL_SMALLEST_Q = -342
+_EL_LARGEST_Q = 308
+
+
+@functools.lru_cache(maxsize=1)
+def _el_pow5_tables():
+    his, los = [], []
+    for q in range(_EL_SMALLEST_Q, _EL_LARGEST_Q + 1):
+        if q >= 0:
+            v = 5 ** q
+            b = v.bit_length()
+            v = v << (128 - b) if b <= 128 else v >> (b - 128)
+        else:
+            p = 5 ** (-q)
+            b = p.bit_length() + 127
+            v = (1 << b) // p + 1
+        assert v.bit_length() == 128
+        his.append((v >> 64) & 0xFFFFFFFFFFFFFFFF)
+        los.append(v & 0xFFFFFFFFFFFFFFFF)
+    u = lambda a: np.array(a, dtype=np.uint64)  # numpy: safe to cache
+    return u(his), u(los)
+
+
+def _clz64(w):
+    """Count leading zeros of a u64 vector (w != 0)."""
+    n = jnp.zeros(w.shape, jnp.uint64)
+    x = w
+    for shift in (32, 16, 8, 4, 2, 1):
+        s = jnp.uint64(shift)
+        top_empty = (x >> (jnp.uint64(64) - s)) == 0  # top s bits clear
+        n = n + jnp.where(top_empty, s, jnp.uint64(0))
+        x = jnp.where(top_empty, x << s, x)
+    return n
+
+
+def decimal_to_bits(w, q, bits64=True):
+    """w x 10^q correctly rounded to an IEEE bit pattern (positive).
+
+    ``w`` u64 (non-zero mantissa; callers handle w == 0), ``q`` i32
+    decimal exponent. Returns u64 bit patterns (f64) or u32-valued u64
+    (f32), with overflow -> +inf bits and underflow -> +0 bits."""
+    w = w.astype(jnp.uint64)
+    q = q.astype(jnp.int32)
+    one = jnp.uint64(1)
+
+    if bits64:
+        expl_bits, prec_shift = 52, jnp.uint64(9)  # 64 - (52 + 3)
+        min_exp = -1023
+        tie_lo, tie_hi = -4, 23
+        inf_exp = 0x7FF
+    else:
+        expl_bits, prec_shift = 23, jnp.uint64(38)  # 64 - (23 + 3)
+        min_exp = -127
+        tie_lo, tie_hi = -17, 10
+        inf_exp = 0xFF
+
+    qc = jnp.clip(q, _EL_SMALLEST_Q, _EL_LARGEST_Q)
+    t_hi, t_lo = (jnp.asarray(t) for t in _el_pow5_tables())
+    f_hi = t_hi[qc - _EL_SMALLEST_Q]
+    f_lo = t_lo[qc - _EL_SMALLEST_Q]
+
+    lz = _clz64(jnp.where(w == 0, one, w))
+    wn = w << lz
+
+    hi, lo = _umul128(wn, f_hi)
+    # refine with the low table word when the top bits are ambiguous
+    prec_mask = (one << prec_shift) - one
+    need2 = (hi & prec_mask) == prec_mask
+    hi2, _ = _umul128(wn, f_lo)
+    lo_r = lo + hi2
+    carry = (lo_r < lo).astype(jnp.uint64)
+    hi = jnp.where(need2, hi + carry, hi)
+    lo = jnp.where(need2, lo_r, lo)
+
+    upperbit = hi >> jnp.uint64(63)
+    m = hi >> (upperbit + prec_shift)
+    # power(q) = floor(q * log2(10)) + 63
+    pow_q = ((217706 * q) >> 16) + 63
+    power2 = (
+        pow_q + upperbit.astype(jnp.int32) - lz.astype(jnp.int32)
+        - min_exp
+    )
+
+    # ---- subnormal path ---------------------------------------------
+    sub_shift = jnp.clip(1 - power2, 0, 63).astype(jnp.uint64)
+    m_sub = m >> sub_shift
+    m_sub = (m_sub + (m_sub & one)) >> one
+    sub_pow = (m_sub >> jnp.uint64(expl_bits)).astype(jnp.int32)
+    sub_bits = m_sub | (
+        sub_pow.astype(jnp.uint64) << jnp.uint64(expl_bits)
+    )
+    underflow = (1 - power2) >= 64
+
+    # ---- normal path ------------------------------------------------
+    # round-ties-to-even window: the product can be exactly halfway
+    # only for q in [tie_lo, tie_hi]; detect and clear the round bit
+    tie = (
+        (lo <= one)
+        & (q >= tie_lo)
+        & (q <= tie_hi)
+        & ((m & jnp.uint64(3)) == one)
+        & ((m << (upperbit + prec_shift)) == hi)
+    )
+    m_n = jnp.where(tie, m & ~one, m)
+    m_n = (m_n + (m_n & one)) >> one
+    ovf = m_n >= (one << jnp.uint64(expl_bits + 1))
+    m_n = jnp.where(ovf, one << jnp.uint64(expl_bits), m_n)
+    power2 = power2 + ovf.astype(jnp.int32)
+    m_n = m_n & ~(one << jnp.uint64(expl_bits))
+    norm_bits = (
+        power2.astype(jnp.uint64) << jnp.uint64(expl_bits)
+    ) | m_n
+
+    bits = jnp.where(power2 <= 0, sub_bits, norm_bits)
+    bits = jnp.where(underflow & (power2 <= 0), jnp.uint64(0), bits)
+    inf_bits = jnp.uint64(inf_exp) << jnp.uint64(expl_bits)
+    bits = jnp.where(power2 >= inf_exp, inf_bits, bits)
+    # range clamps on q (beyond the table the value saturates)
+    bits = jnp.where(q > _EL_LARGEST_Q, inf_bits, bits)
+    bits = jnp.where(q < _EL_SMALLEST_Q, jnp.uint64(0), bits)
+    bits = jnp.where(w == 0, jnp.uint64(0), bits)
+    return bits

@@ -435,14 +435,39 @@ def _parse_float(col: Column, to: dt.DType) -> Column:
         & (p["j"] < p["epos"][:, None])
     )
     mant_mask = int_mask | frac_mask
-    # mantissa as one integer (float64 accumulation for >18 digits)
+    # mantissa as one EXACT u64: ranked digit weights over the top-19
+    # window (a u64 holds 19 full decimal digits); digits below the
+    # window shift into the decimal exponent instead. >19 significant
+    # digits therefore truncate — the same corner every fast-path
+    # parser (fast_float, Go/Rust strconv) resolves only via a big-int
+    # slow path, <=1 ulp here.
     cum = jnp.cumsum(mant_mask.astype(jnp.int32), axis=1)
-    total = cum[:, -1:]
-    rank = (total - cum).astype(jnp.float64)
-    dig = (p["mat"] - ord("0")).astype(jnp.float64)
-    mant = jnp.sum(
-        jnp.where(mant_mask, dig * 10.0 ** rank, 0.0), axis=1
+    total = cum[:, -1]
+    rank = total[:, None] - cum  # digit's power of ten within mantissa
+    dig_u = (p["mat"] - ord("0")).astype(jnp.uint64)
+    # leading mantissa zeros carry no information but would eat window
+    # slots ("0.00<17 digits>" has 20 mantissa characters): the window
+    # covers the top 19 SIGNIFICANT digits
+    nz_seen = jnp.cumsum(
+        (mant_mask & (dig_u != 0)).astype(jnp.int32), axis=1
     )
+    lead = jnp.sum(mant_mask & (nz_seen == 0), axis=1)
+    hi_cut = total - lead  # first significant rank (exclusive bound)
+    lo_cut = jnp.maximum(hi_cut - 19, 0)
+    in_window = (
+        mant_mask
+        & (rank < hi_cut[:, None])
+        & (rank >= lo_cut[:, None])
+    )
+    w_rank = jnp.clip(rank - lo_cut[:, None], 0, 18).astype(jnp.uint64)
+    ten_pows = jnp.asarray(
+        [np.uint64(10) ** np.uint64(k) for k in range(19)]
+    )
+    mant_w = jnp.sum(
+        jnp.where(in_window, dig_u * ten_pows[w_rank], jnp.uint64(0)),
+        axis=1,
+    )
+    window = lo_cut  # digits dropped below the window shift the exponent
     n_frac = jnp.sum(frac_mask, axis=1)
     # exponent: optional sign then digits after e/E
     e_start = p["epos"] + 1
@@ -457,10 +482,24 @@ def _parse_float(col: Column, to: dt.DType) -> Column:
     e_val, e_count, _ = _weighted_int(e_digits, p["mat"], max_digits=3)
     has_e = p["nes"] > 0
     exp = jnp.where(has_e, jnp.where(e_neg, -e_val, e_val), 0)
-    value = mant * 10.0 ** (
-        exp.astype(jnp.float64) - n_frac.astype(jnp.float64)
-    )
-    value = jnp.where(p["neg"], -value, value)
+    # correctly-rounded binary conversion (Eisel-Lemire, ops/ryu.py)
+    from .ryu import decimal_to_bits
+
+    q10 = (exp - n_frac + window).astype(jnp.int32)
+    is64 = to.id == dt.TypeId.FLOAT64
+    bits = decimal_to_bits(mant_w, q10, bits64=is64)
+    # sign applied on the BIT pattern, and float32 never routed through
+    # float64 arithmetic: XLA's CPU backend flushes f32 subnormals to
+    # zero in conversions, which would zero correctly-parsed values
+    # near 1e-39 (caught by the format->parse bit-exactness drive)
+    if is64:
+        bits = bits | (p["neg"].astype(jnp.uint64) << jnp.uint64(63))
+        value = jax.lax.bitcast_convert_type(bits, jnp.float64)
+    else:
+        b32 = bits.astype(jnp.uint32) | (
+            p["neg"].astype(jnp.uint32) << jnp.uint32(31)
+        )
+        value = jax.lax.bitcast_convert_type(b32, jnp.float32)
 
     # syntax: mantissa bytes are digits/dot; exponent is signed digits
     body = p["in_str"] & (p["j"] >= p["start"][:, None]) & (
@@ -834,17 +873,27 @@ def _format_host(col: Column) -> Column:
         elif 1e-3 <= abs(v) < 1e7:
             out.append(repr(float(v)))
         else:
-            # shortest round-trip mantissa (Java Double.toString shape:
-            # 5.0E-4, not the 17-digit binary-noise form)
-            for p in range(17):
-                s = f"{v:.{p}e}"
-                if float(s) == v:
-                    break
-            m, _, e = s.partition("e")
-            m = m.rstrip("0").rstrip(".")
-            if "." not in m:
-                m += ".0"
-            out.append(f"{m}E{int(e)}")
+            # shortest round-trip mantissa from repr (Python repr IS
+            # shortest; the old %.{p}e scan missed it on exact-halfway
+            # mantissas like 2^-24, where round-half-even truncation
+            # skips the nearer 16-digit form), re-laid-out in the Java
+            # Double.toString scientific shape (5.0E-4)
+            s = repr(abs(float(v)))
+            if "e" in s:
+                m, e = s.split("e")
+                e10 = int(e)
+            else:
+                m, e10 = s, 0
+            ip, _, fp = m.partition(".")
+            raw = ip + fp
+            digs = raw.lstrip("0").rstrip("0") or "0"
+            # decimal exponent of the last KEPT digit
+            stripped_right = len(raw) - len(raw.rstrip("0"))
+            exp10 = e10 - len(fp) + stripped_right
+            sci_exp = len(digs) - 1 + exp10
+            mant = digs[0] + "." + (digs[1:] or "0")
+            sign = "-" if v < 0 else ""
+            out.append(f"{sign}{mant}E{sci_exp}")
     res = Column.from_strings(out)
     valid = res.validity
     if col.validity is not None:

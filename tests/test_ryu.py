@@ -25,7 +25,11 @@ EDGE64 = np.array(
     [0.0, -0.0, 1.0, -1.0, 0.5, 0.1, 0.3, 1e-3, 9.999e-4, 1e7,
      9999999.5, 123456.789, 5e-324, -5e-324, 2.2250738585072014e-308,
      1.7976931348623157e308, 1 / 3, 2 / 3, 1e22, 1e23, 8e9, 3.14159,
-     100.0, 4.0, float("nan"), float("inf"), float("-inf")]
+     100.0, 4.0, float("nan"), float("inf"), float("-inf"),
+     # exact-halfway mantissas: vr == vm boundary in the trim loop
+     # (review catch: requires comparing against the TRIMMED vm)
+     2.0 ** -24, -(2.0 ** -24), 2.0 ** -96, 5.986310706507379e51,
+     2.0 ** 122, 2.0 ** -120]
 )
 
 
@@ -153,3 +157,114 @@ def test_nulls_preserved():
     t = Table.from_pydict({"a": [1.5, None, float("nan")]})
     got = S.cast(t["a"], dt.STRING).to_pylist()
     assert got == ["1.5", None, "NaN"]
+
+
+# ---------------------------------------------------------------------------
+# Eisel-Lemire parse direction
+# ---------------------------------------------------------------------------
+
+
+def test_el_random_wq_vs_python():
+    from spark_rapids_jni_tpu.ops.ryu import decimal_to_bits
+
+    rng = np.random.default_rng(12)
+    w = rng.integers(1, 10 ** 19, 5000, dtype=np.uint64)
+    q = rng.integers(-340, 300, 5000, dtype=np.int64).astype(np.int32)
+    got = np.asarray(
+        jax.jit(lambda w, q: decimal_to_bits(w, q, bits64=True))(
+            jnp.asarray(w), jnp.asarray(q)
+        )
+    )
+    for k in range(len(w)):
+        want = np.float64(float(f"{int(w[k])}e{int(q[k])}"))
+        assert got[k] == want.view(np.uint64), (int(w[k]), int(q[k]))
+
+
+def test_el_edges():
+    from spark_rapids_jni_tpu.ops.ryu import decimal_to_bits
+
+    cases = [
+        (1, 0), (5, -1), (25, -2),
+        (9007199254740993, 0), (9007199254740995, 0),  # ties at 2^53
+        (17976931348623157, 292),  # DBL_MAX
+        (2, 308), (1, 309),  # overflow line
+        (49406564584124654, -340),  # min subnormal
+        (22250738585072014, -324),  # min normal boundary
+        (1, -400), (123456789012345678, -390),  # deep underflow
+    ]
+    w = np.array([c[0] for c in cases], dtype=np.uint64)
+    q = np.array([c[1] for c in cases], dtype=np.int32)
+    got = np.asarray(
+        decimal_to_bits(jnp.asarray(w), jnp.asarray(q), bits64=True)
+    )
+    for k in range(len(w)):
+        want = np.float64(float(f"{int(w[k])}e{int(q[k])}"))
+        assert got[k] == want.view(np.uint64), cases[k]
+
+
+def test_parse_format_roundtrip_bitexact_f64():
+    rng = np.random.default_rng(13)
+    bits = rng.integers(0, 1 << 64, 16000, dtype=np.uint64)
+    vals = bits.view(np.float64)
+    vals = vals[np.isfinite(vals)][:8000]
+    s = S.cast(Column.from_numpy(vals), dt.STRING)
+    back = S.cast(s, dt.FLOAT64)
+    np.testing.assert_array_equal(
+        np.asarray(back.data).view(np.uint64), vals.view(np.uint64)
+    )
+
+
+def test_parse_format_roundtrip_bitexact_f32_subnormals():
+    # includes the f32 subnormal band that XLA's CPU backend flushes in
+    # f32->f64 conversions (the parse path must stay in bits)
+    rng = np.random.default_rng(14)
+    bits = rng.integers(0, 1 << 32, 16000, dtype=np.uint64).astype(
+        np.uint32
+    )
+    sub = rng.integers(1, 1 << 23, 500, dtype=np.uint64).astype(
+        np.uint32
+    )  # raw subnormal patterns
+    bits = np.concatenate([bits, sub])
+    vals = bits.view(np.float32)
+    vals = vals[np.isfinite(vals)][:8000]
+    s = S.cast(Column.from_numpy(vals), dt.STRING)
+    back = S.cast(s, dt.FLOAT32)
+    np.testing.assert_array_equal(
+        np.asarray(back.data).view(np.uint32), vals.view(np.uint32)
+    )
+
+
+def test_parse_long_mantissa_and_leading_zeros():
+    from spark_rapids_jni_tpu.column import Table
+
+    strs = [
+        "0.00054881343708050815",      # leading zeros + 17 sig digits
+        "123456789012345678901234567890",  # >19 digits (top-19 window)
+        "0.000000000000000000000001",  # 1e-24
+        "10000000000000000000000",     # 1e22 exact
+    ]
+    t = Table.from_pydict({"s": strs})
+    got = S.cast(t["s"], dt.FLOAT64).to_pylist()
+    want = [float(x) for x in strs]
+    assert got == want
+
+
+def test_pow2_boundary_sweep():
+    """Powers of two sit on vr == vm boundaries after trimming — the
+    class the random-bits tests almost never sample."""
+    vals = np.array([2.0 ** k for k in range(-250, 250, 3)])
+    col = Column.from_numpy(vals)
+    got = S.cast(col, dt.STRING).to_pylist()
+    for v, g in zip(vals, got):
+        assert float(g) == v
+        # digits must equal Python repr's (both shortest + nearest)
+        assert _repr_digits(v) == _repr_digits(float(g))
+    got32 = S.cast(
+        Column.from_numpy(np.array(
+            [np.float32(2.0 ** k) for k in range(-140, 120, 3)],
+            dtype=np.float32,
+        )),
+        dt.STRING,
+    ).to_pylist()
+    for k, g in zip(range(-140, 120, 3), got32):
+        assert np.float32(g) == np.float32(2.0 ** k)
