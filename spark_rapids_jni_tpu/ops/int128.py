@@ -136,9 +136,9 @@ def pow10_limbs(k: int):
     return np.uint64(u & ((1 << 64) - 1)), np.uint64(u >> 64)
 
 
-def divmod_u32(lo, hi, d: int):
+def divmod_u32_rem(lo, hi, d: int):
     """128-bit unsigned division by a u32 constant via base-2^32 long
-    division (d < 2**32). Returns (q_lo, q_hi); remainder discarded."""
+    division (d < 2**32). Returns (q_lo, q_hi, remainder u64)."""
     if not 0 < d < 2**32:
         raise ValueError("divisor must fit in u32")
     dd = jnp.uint64(d)
@@ -156,6 +156,12 @@ def divmod_u32(lo, hi, d: int):
         r = cur % dd
     q_hi = (q[0] << jnp.uint64(32)) | (q[1] & _MASK32)
     q_lo = (q[2] << jnp.uint64(32)) | (q[3] & _MASK32)
+    return q_lo, q_hi, r
+
+
+def divmod_u32(lo, hi, d: int):
+    """128-bit unsigned division by a u32 constant; remainder discarded."""
+    q_lo, q_hi, _ = divmod_u32_rem(lo, hi, d)
     return q_lo, q_hi
 
 

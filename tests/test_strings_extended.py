@@ -567,3 +567,50 @@ class TestDeviceDecimalFormat:
         )
         out = jax.jit(_format_decimal)(col)
         assert out.to_pylist() == ["12.34", "-0.05", "0.00"]
+
+
+S = strings
+
+
+class TestDecimalFormatDevice:
+    """Every decimal width and scale formats on device (round-5: the
+    last _format_host corners closed — DECIMAL128 via base-10^9 limb
+    division, positive scales as appended zeros)."""
+
+    @staticmethod
+    def _oracle(vals, scale):
+        out = []
+        for u in vals:
+            sgn = "-" if u < 0 else ""
+            digits = str(abs(int(u)))
+            if scale > 0:
+                out.append(sgn + digits + "0" * scale)
+            elif scale == 0:
+                out.append(sgn + digits)
+            else:
+                digits = digits.rjust(-scale + 1, "0")
+                out.append(sgn + digits[:scale] + "." + digits[scale:])
+        return out
+
+    @pytest.mark.parametrize("scale", [0, -2, -19, -25, 3])
+    def test_decimal64_all_scales(self, scale):
+        rng = np.random.default_rng(21)
+        v = rng.integers(-(10 ** 17), 10 ** 17, 300).astype(np.int64)
+        col = Column.from_numpy(
+            v, dtype=dt.DType(dt.TypeId.DECIMAL64, scale)
+        )
+        got = S.cast(col, dt.STRING).to_pylist()
+        assert got == self._oracle(v, scale)
+
+    @pytest.mark.parametrize("scale", [0, -10, -37, 4])
+    def test_decimal128_all_scales(self, scale):
+        rng = np.random.default_rng(22)
+        vals = [
+            int(rng.integers(-(10 ** 18), 10 ** 18))
+            * int(rng.integers(1, 10 ** 18))
+            for _ in range(200)
+        ] + [0, 10 ** 37, -(10 ** 37), 1 << 126, (1 << 127) - 1,
+             -(1 << 127)]
+        col = Column.from_decimal128(vals, scale=scale)
+        got = S.cast(col, dt.STRING).to_pylist()
+        assert got == self._oracle(vals, scale)
