@@ -543,24 +543,106 @@ def _parse_bool(col: Column) -> Column:
     return Column(is_true, dt.BOOL8, valid)
 
 
-def _parse_decimal(col: Column, to: dt.DType) -> Column:
-    """STRING -> DECIMAL32/64: exact integer arithmetic. The unscaled
-    result is int_part * 10^-scale plus the first -scale fractional
-    digits (excess fractional digits truncate, cudf fixed_point)."""
-    if to.scale > 0:
-        raise TypeError("positive decimal scales not supported in cast")
-    p = _parse_parts(col)
+def _decimal_parts(p, k: int):
+    """Shared STRING->decimal decomposition: kept-digit masks and the
+    significant-integer-digit count, for both accumulator widths."""
     int_mask = (
         p["isdigit"]
         & (p["j"] >= p["start"][:, None])
         & (p["j"] < p["dotpos"][:, None])
     )
-    k = -to.scale
     frac_keep = (
         p["isdigit"]
         & (p["j"] > p["dotpos"][:, None])
         & (p["j"] <= (p["dotpos"] + k)[:, None])
     )
+    frac_mask = p["isdigit"] & (p["j"] > p["dotpos"][:, None])
+    nonzero = int_mask & (p["mat"] != ord("0"))
+    lead = int_mask & (
+        jnp.cumsum(nonzero.astype(jnp.int32), axis=1) == 0
+    )
+    sig_int = jnp.sum(int_mask, axis=1) - jnp.sum(lead, axis=1)
+    return int_mask, frac_keep, frac_mask, sig_int
+
+
+def _parse_decimal128(col: Column, to: dt.DType) -> Column:
+    """STRING -> DECIMAL128: exact 128-bit integer arithmetic.
+
+    Masked Horner over the byte matrix: per character, the running
+    (lo, hi) limb pair multiplies by ten and adds the digit wherever
+    the position is a kept mantissa digit (integer digits, then the
+    first ``-scale`` fractional digits); missing fractional places
+    fill with a trailing power-of-ten multiply. Up to 38 significant
+    digits (Spark's DECIMAL(38) bound, < 2^127), beyond -> null."""
+    from . import int128
+
+    if to.scale > 0:
+        raise TypeError("positive decimal scales not supported in cast")
+    p = _parse_parts(col)
+    k = -to.scale
+    int_mask, frac_keep, frac_mask, sig_int = _decimal_parts(p, k)
+    kept = int_mask | frac_keep
+    dig = (p["mat"] - ord("0")).astype(jnp.uint64)
+    n = p["mat"].shape[0]
+
+    def horner(carry, xs):
+        lo, hi = carry
+        keep_j, dig_j = xs
+        tlo, thi = int128.mul_u64(lo, hi, jnp.uint64(10))
+        nlo = tlo + dig_j
+        nhi = thi + (nlo < tlo).astype(jnp.uint64)
+        return (
+            jnp.where(keep_j, nlo, lo),
+            jnp.where(keep_j, nhi, hi),
+        ), None
+
+    (lo, hi), _ = jax.lax.scan(
+        horner,
+        (jnp.zeros(n, jnp.uint64), jnp.zeros(n, jnp.uint64)),
+        (kept.T, dig.T),
+    )
+    # fill the missing fractional places with trailing zeros: one or
+    # two u64 power-of-ten multiplies (10^t, t <= 38 splits as <=19+19)
+    n_frac = jnp.sum(frac_keep, axis=1)
+    fill = jnp.clip(k - n_frac, 0, 38)
+    p10 = jnp.asarray(
+        [np.uint64(10) ** np.uint64(t) for t in range(20)]
+    )
+    m1 = p10[jnp.minimum(fill, 19)]
+    m2 = p10[jnp.clip(fill - 19, 0, 19)]
+    lo, hi = int128.mul_u64(lo, hi, m1)
+    lo, hi = int128.mul_u64(lo, hi, m2)
+
+    # representability: significant integer digits + k <= 38
+    representable = (sig_int + k) <= 38
+    ok = (
+        _int_syntax_ok(p, int_mask, frac_mask)
+        & (p["nes"] == 0)
+        & representable
+    )
+    nlo, nhi = int128.negate(lo, hi)
+    lo = jnp.where(p["neg"], nlo, lo)
+    hi = jnp.where(p["neg"], nhi, hi)
+    limbs = jnp.stack(
+        [jnp.where(ok, lo, 0), jnp.where(ok, hi, 0)], axis=1
+    )
+    valid = ok if col.validity is None else jnp.logical_and(
+        col.validity, ok
+    )
+    return Column(limbs, to, valid)
+
+
+def _parse_decimal(col: Column, to: dt.DType) -> Column:
+    """STRING -> DECIMAL32/64: exact integer arithmetic. The unscaled
+    result is int_part * 10^-scale plus the first -scale fractional
+    digits (excess fractional digits truncate, cudf fixed_point)."""
+    if to.id == dt.TypeId.DECIMAL128:
+        return _parse_decimal128(col, to)
+    if to.scale > 0:
+        raise TypeError("positive decimal scales not supported in cast")
+    p = _parse_parts(col)
+    k = -to.scale
+    int_mask, frac_keep, frac_mask, sig_int = _decimal_parts(p, k)
     int_val, _, int_over = _weighted_int(int_mask, p["mat"])
     # frac digits weighted to exactly k places (missing digits = 0)
     cum = jnp.cumsum(frac_keep.astype(jnp.int32), axis=1)
@@ -571,16 +653,10 @@ def _parse_decimal(col: Column, to: dt.DType) -> Column:
     dig = (p["mat"] - ord("0")).astype(jnp.int64)
     frac_val = jnp.sum(jnp.where(frac_keep, dig * w, 0), axis=1)
     unscaled = int_val * (10 ** min(k, 18)) + frac_val
-    frac_mask = p["isdigit"] & (p["j"] > p["dotpos"][:, None])
     # representability: integer digits (after leading zeros) + the k
     # fractional places must fit the 18-digit exact window, and the
     # scaled value must fit the target storage — otherwise NULL, never
     # a wrapped value marked valid
-    nonzero = int_mask & (p["mat"] != ord("0"))
-    lead = int_mask & (
-        jnp.cumsum(nonzero.astype(jnp.int32), axis=1) == 0
-    )
-    sig_int = jnp.sum(int_mask, axis=1) - jnp.sum(lead, axis=1)
     representable = (sig_int + k) <= 18
     info = np.iinfo(np.dtype(to.storage_dtype))
     signed = jnp.where(p["neg"], -unscaled, unscaled)
@@ -693,14 +769,17 @@ def _format_decimal(col: Column) -> Column:
     with no point."""
     s = col.dtype.scale
     d = -s
+    if s == 0 and col.dtype.id != dt.TypeId.DECIMAL128:
+        return _format_int(col)  # the generic path below also handles
+        # d == 0, but the int formatter's narrower matrix is cheaper
     if col.dtype.id == dt.TypeId.DECIMAL128:
+        from .int128 import negate as _negate128
+
         limbs = col.data
         lo = limbs[:, 0]
         hi = limbs[:, 1]
         neg = (hi >> jnp.uint64(63)) != 0
-        # two's-complement negate for the magnitude
-        nlo = ~lo + jnp.uint64(1)
-        nhi = ~hi + (nlo == 0).astype(jnp.uint64)
+        nlo, nhi = _negate128(lo, hi)
         mlo = jnp.where(neg, nlo, lo)
         mhi = jnp.where(neg, nhi, hi)
         digs, ndig = _digit_matrix128(mlo, mhi)
@@ -714,9 +793,6 @@ def _format_decimal(col: Column) -> Column:
         )
         K = 19
         digs, ndig = _digit_matrix(mag, K)
-    if s == 0 and col.dtype.id != dt.TypeId.DECIMAL128:
-        return _format_int(col)  # the generic path below also handles
-        # d == 0, but the int formatter's narrower matrix is cheaper
     if s > 0:
         # trailing zeros, no point: magnitude digits then s zeros
         lens = neg.astype(jnp.int32) + ndig + s

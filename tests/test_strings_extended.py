@@ -614,3 +614,62 @@ class TestDecimalFormatDevice:
         col = Column.from_decimal128(vals, scale=scale)
         got = S.cast(col, dt.STRING).to_pylist()
         assert got == self._oracle(vals, scale)
+
+
+class TestDecimal128Parse:
+    """STRING -> DECIMAL128: exact 128-bit masked-Horner accumulation."""
+
+    def test_vs_decimal_oracle(self):
+        from decimal import Decimal, localcontext
+
+        from spark_rapids_jni_tpu.ops.int128 import to_py_ints
+
+        strs = [
+            "1234567890123456789012345678.12", "-0.99", "0.005",
+            "  -42  ", "12.3.4", "",
+            "99999999999999999999999999999999999.999",
+            "-12345678901234567890123456789012345.678",
+            "170141183460469231731687303715884105727",  # 39 digits
+            "0", "-0.0", ".5", "00001.5",
+        ]
+        t = Table.from_pydict({"s": strs})
+        got = S.cast(t["s"], dt.DType(dt.TypeId.DECIMAL128, -3))
+        vals = to_py_ints(np.asarray(got.data))
+        ok = np.asarray(got.validity)
+        for s_, v, o in zip(strs, vals, ok):
+            want = None
+            try:
+                with localcontext() as ctx:
+                    ctx.prec = 60
+                    d = Decimal(s_.strip())
+                    if "e" in s_.lower() or s_.count(".") > 1:
+                        raise ValueError
+                    unscaled = int(
+                        d.scaleb(3).to_integral_value(rounding="ROUND_DOWN")
+                    )
+                    # representable: sig int digits + k <= 38
+                    sig = len(str(abs(int(d))).lstrip("0"))
+                    if int(d) == 0:
+                        sig = 0
+                    if sig + 3 <= 38:
+                        want = unscaled
+            except Exception:
+                want = None
+            got_v = int(v) if o else None
+            assert got_v == want, (s_, got_v, want)
+
+    def test_format_parse_roundtrip(self):
+        from spark_rapids_jni_tpu.ops.int128 import to_py_ints
+
+        rng = np.random.default_rng(33)
+        vals = [
+            int(rng.integers(-(10 ** 18), 10 ** 18))
+            * int(rng.integers(1, 10 ** 17))
+            for _ in range(300)
+        ] + [0, 10 ** 34, -(10 ** 34)]
+        col = Column.from_decimal128(vals, scale=-4)
+        s = S.cast(col, dt.STRING)
+        back = S.cast(s, dt.DType(dt.TypeId.DECIMAL128, -4))
+        assert back.validity is None or bool(np.asarray(back.validity).all())
+        got = to_py_ints(np.asarray(back.data))
+        assert [int(g) for g in got] == vals
