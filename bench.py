@@ -113,7 +113,7 @@ def _gen_groupby_inputs(n, n_inputs=2, n_keys=10_000):
     return hosts, inputs
 
 
-def bench_groupby(platform, n, n_inputs=2):
+def bench_groupby(platform, n, n_inputs=2, values_via="sort"):
     import jax
 
     from spark_rapids_jni_tpu.ops.groupby import (
@@ -130,6 +130,7 @@ def bench_groupby(platform, n, n_inputs=2):
             ["k"],
             [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")],
             num_segments=n_keys,
+            values_via=values_via,
         )
     )
     med, mn, std, out = _timeit(step, inputs)
@@ -137,8 +138,11 @@ def bench_groupby(platform, n, n_inputs=2):
     agg, ngroups = out
     total = int(np.asarray(agg["sum_v"].data)[: int(ngroups)].sum())
     assert total == int(hosts[-1][1].sum()), "groupby-sum mismatch vs numpy"
-    return _entry(1, f"groupby_sum_{n // 1_000_000}M", n, med, mn, std,
-                  n * 16, platform), med
+    suffix = "" if values_via == "sort" else f"_{values_via}"
+    return _entry(
+        1, f"groupby_sum_{n // 1_000_000}M{suffix}", n, med, mn, std,
+        n * 16, platform,
+    ), med
 
 
 def bench_groupby_chunked(platform, n=100_000_000, n_inputs=2):
@@ -1052,6 +1056,12 @@ _SUBPROCESS_CONFIGS = {
     "groupby16m_chunked": lambda p: bench_groupby_chunked(p, 16_000_000),
     # flat single-level packing: values as sort payloads vs word-only
     # sort + permutation gather
+    "groupby16m_gather": lambda p: bench_groupby(
+        p, 16_000_000, values_via="gather"
+    )[0],
+    "groupby100m_gather": lambda p: bench_groupby(
+        p, 100_000_000, values_via="gather"
+    )[0],
     "groupby16m_flat_sort": lambda p: bench_groupby_flat(
         p, 16_000_000, "sort"
     ),
@@ -1098,10 +1108,11 @@ _LADDER = (
     "groupby1m", "groupby16m_packed", "groupby16m_chunked", "groupby16m",
     "chunk_sort_ab", "groupby16m_packed_pallas32",
     "groupby16m_flat_sort", "groupby16m_flat_gather",
+    "groupby16m_gather",
     "strings", "transpose", "transpose_pallas", "resident", "parquet",
     "parquet_device",
     "groupby100m_packed", "groupby100m_packed_pallas32",
-    "groupby100m_flat_gather",
+    "groupby100m_flat_gather", "groupby100m_gather",
     "groupby100m_chunked", "groupby100m",
     "groupby_highcard", "sort",
     "sort_packed", "sort_gather",

@@ -66,6 +66,7 @@ def _segment_ids(
     key_cols: Sequence[Column],
     row_valid: Optional[jax.Array] = None,
     payload: Sequence[jax.Array] = (),
+    values_via: str = "sort",
 ):
     """(perm, seg_ids, num_groups_device, sorted_payload): stable sort +
     boundary scan.
@@ -75,9 +76,13 @@ def _segment_ids(
     garbage keys may split into any number of trailing segments; the group
     count is therefore the highest segment id holding a valid row.
 
-    ``payload`` arrays ride the variadic sort as non-key operands and come
-    back row-sorted — on TPU this is much cheaper than sorting a
-    permutation and paying a big device gather per value column.
+    ``values_via`` routes the ``payload`` arrays to sorted order:
+    ``"sort"`` rides them through the variadic sort as non-key operands
+    (each payload then pays every one of the network's O(log^2 n)
+    passes); ``"gather"`` sorts only the key words + iota and applies
+    the permutation with one O(n) gather per payload. Which wins on
+    TPU is a measured A/B (bench ``groupby16m``/``_gather`` rungs) —
+    the flat-packed CPU A/B had gather 3.5x ahead.
     """
     words: list[jax.Array] = []
     if row_valid is not None:
@@ -99,15 +104,23 @@ def _segment_ids(
     # re-gather of each word (jnp.lexsort would return only the perm)
     n_rows = words[0].shape[0]
     iota = jnp.arange(n_rows, dtype=jnp.int32)
-    extra = tuple(payload)
-    if row_valid is not None:
-        extra = (row_valid,) + extra  # ride the sort, no perm gather
-    sorted_all = jax.lax.sort(
-        tuple(words) + (iota,) + extra, num_keys=len(words)
-    )
-    sorted_words = list(sorted_all[: len(words)])
-    perm = sorted_all[len(words)]
-    sorted_payload = list(sorted_all[len(words) + 1 :])
+    if values_via == "sort":
+        sorted_all = jax.lax.sort(
+            tuple(words) + (iota,) + tuple(payload),
+            num_keys=len(words),
+        )
+        sorted_words = list(sorted_all[: len(words)])
+        perm = sorted_all[len(words)]
+        sorted_payload = list(sorted_all[len(words) + 1 :])
+    elif values_via == "gather":
+        sorted_all = jax.lax.sort(
+            tuple(words) + (iota,), num_keys=len(words)
+        )
+        sorted_words = list(sorted_all[: len(words)])
+        perm = sorted_all[len(words)]
+        sorted_payload = [jnp.take(p, perm, axis=0) for p in payload]
+    else:
+        raise ValueError(f"unknown values_via {values_via!r}")
     boundary = jnp.zeros(perm.shape, dtype=jnp.bool_).at[0].set(True)
     for w in sorted_words:
         boundary = boundary | jnp.concatenate(
@@ -118,7 +131,9 @@ def _segment_ids(
         # Padding rows sort behind every real row (leading occupancy word)
         # but can form any number of trailing garbage segments — the real
         # group count is the highest segment id holding a valid row.
-        rv_sorted = sorted_payload.pop(0)
+        # Sorted validity is the sorted occupancy word itself (word 0 =
+        # valid), so it neither rides the sort nor pays a gather.
+        rv_sorted = sorted_words[0] == jnp.uint64(0)
         num_groups = jnp.max(jnp.where(rv_sorted, seg + 1, 0))
     else:
         num_groups = seg[-1] + 1
@@ -401,6 +416,7 @@ def groupby_aggregate_capped(
     num_segments: int,
     row_valid: Optional[jax.Array] = None,
     return_collect_overflow: bool = False,
+    values_via: str = "sort",
 ) -> tuple[Table, jax.Array]:
     """Jittable groupby: (padded result of ``num_segments`` rows, count).
 
@@ -435,7 +451,7 @@ def groupby_aggregate_capped(
             distinct[id(col)] = (len(payload), len(v_entries))
             payload.extend(v_entries + [m])
     perm, seg, num_groups, sorted_payload = _segment_ids(
-        key_cols, row_valid, payload
+        key_cols, row_valid, payload, values_via=values_via
     )
 
     # representative (first) sorted row of each segment -> key values

@@ -534,3 +534,45 @@ class TestPallasEngines:
                 t, ["k"], [GroupbyAgg("v", "sum")], num_segments=8,
                 chunk_rows=8, chunk_segments=8, engine="cuda",
             )
+
+
+class TestCappedGatherArm:
+    def test_gather_matches_sort_arm(self):
+        from spark_rapids_jni_tpu.ops.groupby import (
+            groupby_aggregate_capped,
+        )
+
+        rng = np.random.default_rng(29)
+        n = 4000
+        k = rng.integers(0, 200, n, dtype=np.int64)
+        v = rng.integers(-50, 50, n, dtype=np.int64)
+        # with nulls + row_valid: the gather arm must route the
+        # validity payload identically
+        import jax.numpy as jnp
+
+        kv = np.ones(n, dtype=bool)
+        kv[::17] = False
+        t = Table(
+            [Column.from_numpy(k),
+             Column.from_numpy(v, validity=kv)],
+            ["k", "v"],
+        )
+        rv = jnp.asarray(np.arange(n) < (n - 100))
+        a, ng_a = groupby_aggregate_capped(
+            t, ["k"], AGGS, num_segments=256, row_valid=rv
+        )
+        b, ng_b = groupby_aggregate_capped(
+            t, ["k"], AGGS, num_segments=256, row_valid=rv,
+            values_via="gather",
+        )
+        assert int(ng_a) == int(ng_b)
+        g = int(ng_a)
+        for ca, cb in zip(a.columns, b.columns):
+            np.testing.assert_array_equal(
+                np.asarray(ca.data)[:g], np.asarray(cb.data)[:g]
+            )
+            if ca.validity is not None or cb.validity is not None:
+                np.testing.assert_array_equal(
+                    np.asarray(ca.validity)[:g],
+                    np.asarray(cb.validity)[:g],
+                )
