@@ -517,9 +517,13 @@ def groupby_aggregate_capped(
     return out, num_groups
 
 
-# above this, decomposable aggregations route through the two-level
-# chunked design (ops/groupby_chunked.py) — one giant variadic sort
-# becomes C batched VMEM-sized sorts plus a small combine pass
+# above this, SPARK_RAPIDS_TPU_GROUPBY_FORMULATION=packed/chunked can
+# route decomposable aggregations through the two-level designs. The
+# default stays on the single variadic sort: the round-5 chip window
+# measured it 2.9x/7x AHEAD of the packed/chunked bets at 16M rows
+# (BASELINE.md round-5 measured state) — XLA's batched small sorts are
+# not VMEM-resident, so the two-level constant only comes back via the
+# explicit Pallas engines, which are still an A/B in progress.
 CHUNKED_MIN_ROWS = 4_000_000
 
 
@@ -532,12 +536,16 @@ def groupby_aggregate(
     aggregations without an explicit ``list_capacity`` get sized from
     the largest group's valid-row count (a cheap count pre-pass).
 
-    Large inputs with decomposable aggregations take the two-level
-    chunked path automatically (exact; falls back here when chunk
-    cardinality is too high for chunking to win)."""
+    Large inputs route by SPARK_RAPIDS_TPU_GROUPBY_FORMULATION:
+    the default "single" keeps the one-variadic-sort path that won the
+    round-5 on-chip A/B; "packed"/"chunked" opt into the two-level
+    designs (exact-or-fallback) for measurement."""
+    formulation = "single"
     if table.row_count > CHUNKED_MIN_ROWS:
-        # narrow-key packed path first (half the sort traffic), then the
-        # general chunked path; both are exact-or-None
+        from ..utils.config import get_flag
+
+        formulation = get_flag("GROUPBY_FORMULATION")
+    if formulation == "packed":
         from .groupby_packed import (
             groupby_aggregate_packed,
             packed_groupby_supported,
@@ -547,6 +555,7 @@ def groupby_aggregate(
             out = groupby_aggregate_packed(table, by, aggs)
             if out is not None:
                 return out
+    if formulation in ("packed", "chunked"):
         from .groupby_chunked import (
             chunked_groupby_supported,
             groupby_aggregate_chunked,

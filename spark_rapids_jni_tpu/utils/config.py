@@ -38,6 +38,18 @@ def _as_bool(v: str) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _parse_formulation(v: str) -> str:
+    got = v.strip().lower()
+    if got not in ("single", "packed", "chunked"):
+        # a typo'd A/B arm must fail loudly, not silently measure
+        # the default formulation under the wrong label
+        raise ValueError(
+            f"GROUPBY_FORMULATION must be single|packed|chunked, "
+            f"got {v!r}"
+        )
+    return got
+
+
 @dataclasses.dataclass(frozen=True)
 class Flag:
     name: str
@@ -72,6 +84,12 @@ _FLAGS = {
             "HBM_BUDGET_GB", 0.0, float,
             "per-chip HBM budget in GiB for the footprint planner "
             "(utils/hbm.py); 0 = backend default (v5e: 16)",
+        ),
+        Flag(
+            "GROUPBY_FORMULATION", "single", _parse_formulation,
+            "large-input eager groupby routing: single (one variadic "
+            "sort - the round-5 on-chip winner) | packed | chunked "
+            "(the two-level designs, kept for A/B)",
         ),
     ]
 }

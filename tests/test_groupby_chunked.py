@@ -129,9 +129,14 @@ def test_eager_falls_back_on_high_cardinality():
 
 
 def test_router_uses_chunked_path(monkeypatch):
-    """Above CHUNKED_MIN_ROWS the public eager API takes the new path."""
+    """With the chunked formulation opted in (round 5 made "single"
+    the measured default), the public eager API takes the new path
+    above CHUNKED_MIN_ROWS."""
     t, df = _table(n=30_000, seed=5)
     monkeypatch.setattr(groupby_mod, "CHUNKED_MIN_ROWS", 10_000)
+    monkeypatch.setenv(
+        "SPARK_RAPIDS_TPU_GROUPBY_FORMULATION", "chunked"
+    )
     calls = {}
 
     import spark_rapids_jni_tpu.ops.groupby_chunked as gc

@@ -191,9 +191,14 @@ class TestProtocol:
         )
 
     def test_router_uses_packed(self, monkeypatch):
-        # shrink the routing threshold; the packed path must produce the
-        # exact result through the public eager API
+        # shrink the routing threshold and opt into the packed
+        # formulation (round 5 made "single" the measured default);
+        # the packed path must produce the exact result through the
+        # public eager API
         monkeypatch.setattr(groupby_mod, "CHUNKED_MIN_ROWS", 512)
+        monkeypatch.setenv(
+            "SPARK_RAPIDS_TPU_GROUPBY_FORMULATION", "packed"
+        )
         rng = np.random.default_rng(6)
         n = 4096
         k = rng.integers(0, 64, n, dtype=np.int64)
