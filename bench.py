@@ -418,6 +418,11 @@ def bench_sort_gather(platform, n=100_000_000):
     return _bench_sort_formulation(platform, n, "gather")
 
 
+def bench_sort_packed_gather(platform, n=100_000_000):
+    """Config 3b fourth arm: packed word-only sort + payload gather."""
+    return _bench_sort_formulation(platform, n, "packed_gather")
+
+
 def bench_sort_packed(platform, n=100_000_000):
     """Config 3b third arm: the packed formulation (sort_packed.py) —
     key word, iota AND the key column's payload in ONE u64 (16 B/row of
@@ -444,11 +449,13 @@ def _bench_sort_formulation(platform, n, form):
     jax.block_until_ready(t.columns[0].data)
     if form == "payload":
         sort_fn = jax.jit(lambda tt: sort_table(tt, [SortKey("k")]))
-    elif form == "packed":
+    elif form in ("packed", "packed_gather"):
         from spark_rapids_jni_tpu.ops.sort_packed import sort_table_packed
 
+        via = "gather" if form.endswith("gather") else "sort"
+
         def sort_fn(tt):
-            out = sort_table_packed(tt, [SortKey("k")])
+            out = sort_table_packed(tt, [SortKey("k")], values_via=via)
             assert out is not None, "packed sort declined the bench shape"
             return out
     else:
@@ -1089,6 +1096,7 @@ _SUBPROCESS_CONFIGS = {
     "sort": bench_sort,
     "sort_gather": bench_sort_gather,
     "sort_packed": bench_sort_packed,
+    "sort_packed_gather": bench_sort_packed_gather,
     "chunk_sort_ab": bench_chunk_sort_ab,
     "strings": bench_strings,
     "resident": bench_resident_chain,
@@ -1115,7 +1123,7 @@ _LADDER = (
     "groupby100m_flat_gather", "groupby100m_gather",
     "groupby100m_chunked", "groupby100m",
     "groupby_highcard", "sort",
-    "sort_packed", "sort_gather",
+    "sort_packed", "sort_packed_gather", "sort_gather",
     "join_batched", "join_batched_packed", "tpcds", "tpcds10",
 )
 

@@ -41,7 +41,8 @@ from .sort import SortKey
 
 @functools.lru_cache(maxsize=64)
 def _packed_sort_fn(
-    bits: int, directions: tuple, field_bits: tuple, key_cis: tuple
+    bits: int, directions: tuple, field_bits: tuple, key_cis: tuple,
+    values_via: str = "sort",
 ):
     mask = jnp.uint64((1 << bits) - 1)
 
@@ -68,9 +69,21 @@ def _packed_sort_fn(
             if c.lengths is not None:
                 plan.append((ci, "lengths"))
                 operands.append(c.lengths)
-        out = jax.lax.sort(tuple(operands), num_keys=1)
-        packed_s = out[0]
-        perm = (packed_s & mask).astype(jnp.int32)
+        if values_via == "sort":
+            out = jax.lax.sort(tuple(operands), num_keys=1)
+            packed_s = out[0]
+            perm = (packed_s & mask).astype(jnp.int32)
+            payload_s = list(out[1:])
+        elif values_via == "gather":
+            # word-only sort; every payload follows by one O(n)
+            # gather through the embedded-iota permutation
+            packed_s = jax.lax.sort((packed,), num_keys=1)[0]
+            perm = (packed_s & mask).astype(jnp.int32)
+            payload_s = [
+                jnp.take(arr, perm, axis=0) for arr in operands[1:]
+            ]
+        else:
+            raise ValueError(f"unknown values_via {values_via!r}")
         rel_s = packed_s >> jnp.uint64(bits)
 
         # peel the sorted key fields back off (last key in low bits)
@@ -81,7 +94,7 @@ def _packed_sort_fn(
         }
 
         by_col: dict = {}
-        for (ci, attr), arr in zip(plan, out[1:]):
+        for (ci, attr), arr in zip(plan, payload_s):
             by_col.setdefault(ci, {})[attr] = arr
         cols = []
         for ci, c in enumerate(table.columns):
@@ -111,6 +124,7 @@ def _packed_sort_fn(
 def sort_table_packed(
     table: Table,
     sort_keys: Sequence[Union[SortKey, str, int]],
+    values_via: str = "sort",
 ) -> Optional[Table]:
     """Eager packed ORDER BY, or ``None`` when ineligible (nulls,
     non-integer keys, duplicate key columns, combined span too wide) —
@@ -148,5 +162,6 @@ def sort_table_packed(
         tuple(bool(k.ascending) for k in keys),
         tuple(field_bits),
         tuple(key_cis),
+        values_via,
     )
     return fn(table, jnp.asarray(kbases, dtype=jnp.uint64))

@@ -132,3 +132,34 @@ class TestMultiKey:
         assert sort_table_packed(
             t, [SortKey("k"), SortKey("k", ascending=False)]
         ) is None
+
+
+def test_gather_arm_matches_sort_arm():
+    from spark_rapids_jni_tpu.ops.sort_packed import sort_table_packed
+
+    rng = np.random.default_rng(41)
+    n = 3000
+    k = rng.integers(-500, 500, n, dtype=np.int64)
+    v = rng.standard_normal(n)
+    w = rng.integers(0, 9, n, dtype=np.int64)
+    kv = np.ones(n, dtype=bool)
+    kv[::13] = False
+    t = Table(
+        [
+            Column.from_numpy(k),
+            Column.from_numpy(v, validity=kv),
+            Column.from_numpy(w),
+        ],
+        ["k", "v", "w"],
+    )
+    a = sort_table_packed(t, [SortKey("k")])
+    b = sort_table_packed(t, [SortKey("k")], values_via="gather")
+    assert a is not None and b is not None
+    for ca, cb in zip(a.columns, b.columns):
+        np.testing.assert_array_equal(
+            np.asarray(ca.data), np.asarray(cb.data)
+        )
+        if ca.validity is not None:
+            np.testing.assert_array_equal(
+                np.asarray(ca.validity), np.asarray(cb.validity)
+            )
