@@ -1113,17 +1113,20 @@ _SUBPROCESS_CONFIGS = {
 # configs land before the multi-minute 100M uploads; the headline
 # chunked-groupby A/B runs as soon as the cheap tier is banked.
 _LADDER = (
+    # banked in the round-5 window (daemon skips completed entries)
     "groupby1m", "groupby16m_packed", "groupby16m_chunked", "groupby16m",
-    "chunk_sort_ab", "groupby16m_packed_pallas32",
-    "groupby16m_flat_sort", "groupby16m_flat_gather",
-    "groupby16m_gather",
+    # decisive cheap A/Bs first: plain-XLA gather arms compile fast,
+    # the Pallas engines (slow Mosaic compiles) right after
+    "groupby16m_flat_gather", "groupby16m_flat_sort", "groupby16m_gather",
+    "groupby16m_packed_pallas32", "chunk_sort_ab",
     "strings", "transpose", "transpose_pallas", "resident", "parquet",
     "parquet_device",
-    "groupby100m_packed", "groupby100m_packed_pallas32",
-    "groupby100m_flat_gather", "groupby100m_gather",
-    "groupby100m_chunked", "groupby100m",
+    # 100M tier: likely winners first
+    "groupby100m_flat_gather", "groupby100m_gather", "groupby100m",
+    "groupby100m_packed_pallas32", "groupby100m_packed",
+    "groupby100m_chunked",
     "groupby_highcard", "sort",
-    "sort_packed", "sort_packed_gather", "sort_gather",
+    "sort_packed_gather", "sort_packed", "sort_gather",
     "join_batched", "join_batched_packed", "tpcds", "tpcds10",
 )
 
