@@ -543,26 +543,40 @@ def _parse_bool(col: Column) -> Column:
     return Column(is_true, dt.BOOL8, valid)
 
 
-def _decimal_parts(p, k: int):
+def _decimal_parts(p, k: int, drop_int: int = 0):
     """Shared STRING->decimal decomposition: kept-digit masks and the
-    significant-integer-digit count, for both accumulator widths."""
+    significant-KEPT-integer-digit count, for both accumulator widths.
+
+    ``drop_int`` (a positive target scale) excludes the last that many
+    INTEGER digits from the kept window — fixed_point truncation
+    toward zero done by never accumulating the dropped digits, so a
+    wide string whose post-truncation value fits is representable and
+    the accumulator cannot wrap on digits that would be divided away.
+    Returns ``(int_keep, frac_keep, frac_mask, sig_int, int_mask)``."""
     int_mask = (
         p["isdigit"]
         & (p["j"] >= p["start"][:, None])
         & (p["j"] < p["dotpos"][:, None])
     )
+    if drop_int > 0:
+        cum_int = jnp.cumsum(int_mask.astype(jnp.int32), axis=1)
+        total_int = cum_int[:, -1:]
+        int_rank = total_int - cum_int  # digits after this one
+        int_keep = int_mask & (int_rank >= drop_int)
+    else:
+        int_keep = int_mask
     frac_keep = (
         p["isdigit"]
         & (p["j"] > p["dotpos"][:, None])
         & (p["j"] <= (p["dotpos"] + k)[:, None])
     )
     frac_mask = p["isdigit"] & (p["j"] > p["dotpos"][:, None])
-    nonzero = int_mask & (p["mat"] != ord("0"))
-    lead = int_mask & (
+    nonzero = int_keep & (p["mat"] != ord("0"))
+    lead = int_keep & (
         jnp.cumsum(nonzero.astype(jnp.int32), axis=1) == 0
     )
-    sig_int = jnp.sum(int_mask, axis=1) - jnp.sum(lead, axis=1)
-    return int_mask, frac_keep, frac_mask, sig_int
+    sig_int = jnp.sum(int_keep, axis=1) - jnp.sum(lead, axis=1)
+    return int_keep, frac_keep, frac_mask, sig_int, int_mask
 
 
 def _parse_decimal128(col: Column, to: dt.DType) -> Column:
@@ -576,12 +590,12 @@ def _parse_decimal128(col: Column, to: dt.DType) -> Column:
     digits (Spark's DECIMAL(38) bound, < 2^127), beyond -> null."""
     from . import int128
 
-    if to.scale > 0:
-        raise TypeError("positive decimal scales not supported in cast")
     p = _parse_parts(col)
-    k = -to.scale
-    int_mask, frac_keep, frac_mask, sig_int = _decimal_parts(p, k)
-    kept = int_mask | frac_keep
+    k = max(-to.scale, 0)
+    int_keep, frac_keep, frac_mask, sig_int, int_mask = _decimal_parts(
+        p, k, drop_int=max(to.scale, 0)
+    )
+    kept = int_keep | frac_keep
     dig = (p["mat"] - ord("0")).astype(jnp.uint64)
     n = p["mat"].shape[0]
 
@@ -638,12 +652,12 @@ def _parse_decimal(col: Column, to: dt.DType) -> Column:
     digits (excess fractional digits truncate, cudf fixed_point)."""
     if to.id == dt.TypeId.DECIMAL128:
         return _parse_decimal128(col, to)
-    if to.scale > 0:
-        raise TypeError("positive decimal scales not supported in cast")
     p = _parse_parts(col)
-    k = -to.scale
-    int_mask, frac_keep, frac_mask, sig_int = _decimal_parts(p, k)
-    int_val, _, int_over = _weighted_int(int_mask, p["mat"])
+    k = max(-to.scale, 0)
+    int_keep, frac_keep, frac_mask, sig_int, int_mask = _decimal_parts(
+        p, k, drop_int=max(to.scale, 0)
+    )
+    int_val, _, int_over = _weighted_int(int_keep, p["mat"])
     # frac digits weighted to exactly k places (missing digits = 0)
     cum = jnp.cumsum(frac_keep.astype(jnp.int32), axis=1)
     pos = jnp.where(frac_keep, cum, 0)  # 1-based frac position

@@ -673,3 +673,53 @@ class TestDecimal128Parse:
         assert back.validity is None or bool(np.asarray(back.validity).all())
         got = to_py_ints(np.asarray(back.data))
         assert [int(g) for g in got] == vals
+
+
+class TestPositiveScaleDecimalParse:
+    @pytest.mark.parametrize("tid,width", [
+        (dt.TypeId.DECIMAL64, 64), (dt.TypeId.DECIMAL128, 128),
+    ])
+    def test_truncates_toward_zero(self, tid, width):
+        from spark_rapids_jni_tpu.ops.int128 import to_py_ints
+
+        t = Table.from_pydict(
+            {"s": ["123456", "-9876.5", "999", "1000", "-1000", "0"]}
+        )
+        got = S.cast(t["s"], dt.DType(tid, 3))
+        if width == 128:
+            vals = [int(x) for x in to_py_ints(np.asarray(got.data))]
+        else:
+            vals = [int(x) for x in np.asarray(got.data)]
+        assert vals == [123, -9, 0, 1, -1, 0]
+        # format side: round-trip of the representable values
+        back = S.cast(got, dt.STRING).to_pylist()
+        assert back == ["123000", "-9000", "0000", "1000", "-1000", "0000"]
+
+    def test_wide_string_fits_after_truncation(self):
+        # review catch: 20 integer digits with scale 3 has a 17-digit
+        # unscaled value - representable, and must not be nulled by a
+        # pre-truncation width check (the dropped digits never touch
+        # the accumulator)
+        from spark_rapids_jni_tpu.ops.int128 import to_py_ints
+
+        t = Table.from_pydict(
+            {"s": ["12345678901234567890", "-12345678901234567890.9"]}
+        )
+        got64 = S.cast(t["s"], dt.DType(dt.TypeId.DECIMAL64, 3))
+        assert got64.validity is None or bool(
+            np.asarray(got64.validity).all()
+        )
+        assert [int(x) for x in np.asarray(got64.data)] == [
+            12345678901234567, -12345678901234567,
+        ]
+        # 40-digit integer, scale 5: 35-digit unscaled fits DECIMAL128
+        wide = "1234567890" * 4
+        t2 = Table.from_pydict({"s": [wide, "-" + wide]})
+        got128 = S.cast(t2["s"], dt.DType(dt.TypeId.DECIMAL128, 5))
+        assert got128.validity is None or bool(
+            np.asarray(got128.validity).all()
+        )
+        want = int(wide) // 10 ** 5
+        assert [int(x) for x in to_py_ints(np.asarray(got128.data))] == [
+            want, -want,
+        ]
