@@ -1,0 +1,113 @@
+"""Print the formulation-A/B verdicts from the banked bench state.
+
+Reads benchmarks/bench_state.json (the daemon's merge file) and/or a
+BENCH_r*.json line, groups the config-1/3 arms by shape, and prints
+each A/B with its winner — the round-5 decision table (which
+formulation becomes each op's default) generated from data instead of
+eyeballs.
+
+Usage: python tools/analyze_bench.py [path-to-state-or-bench-json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_STATE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "bench_state.json",
+)
+
+# shape key -> arms, in "formulation" order (first = current default)
+_GROUPS = {
+    "groupby 16M": [
+        "groupby_sum_16M", "groupby_sum_16M_gather",
+        "groupby_sum_16M_flat_sort", "groupby_sum_16M_flat_gather",
+        "groupby_sum_16M_packed", "groupby_sum_16M_packed_pallas32",
+        "groupby_sum_16M_chunked",
+    ],
+    "groupby 100M": [
+        "groupby_sum_100M", "groupby_sum_100M_gather",
+        "groupby_sum_100M_flat_gather", "groupby_sum_100M_packed",
+        "groupby_sum_100M_packed_pallas32", "groupby_sum_100M_chunked",
+    ],
+    "sort 100M": [
+        "sort_100M_int64_payload", "sort_100M_int64_gather",
+        "sort_100M_int64_packed", "sort_100M_int64_packed_gather",
+    ],
+    "chunk sort 16.7M": [
+        "lax_sort_2048x8192", "pallas_bitonic_2048x8192",
+        "pallas_u32_gather_2048x8192",
+    ],
+    "join 100M": [
+        "inner_join_100M_batched_probe",
+        "inner_join_100M_batched_packed",
+    ],
+    "transpose 4M": [
+        "transpose_cast_round_trip", "transpose_cast_round_trip_pallas",
+    ],
+    "parquet 6M": [
+        "parquet_pipeline_4x1500k", "parquet_device_decode_4x1500k",
+    ],
+}
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # BENCH_r*.json: take the LAST parseable line
+        doc = None
+        for line in text.splitlines():
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        if doc is None:
+            raise
+    entries = {}
+    if "entries" in doc:  # daemon state file
+        for cfg in doc["entries"].values():
+            for e in cfg["results"]:
+                entries[e.get("name")] = e
+    # BENCH_r*.json wraps the bench summary under "parsed"
+    summary = doc.get("parsed") or doc
+    for e in summary.get("configs", []) or []:
+        if "name" in e and "seconds_median" in e:
+            entries.setdefault(e["name"], e)
+    return entries
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else _STATE
+    entries = _load(path)
+    if not entries:
+        print("no measured entries")
+        return
+    for label, arms in _GROUPS.items():
+        got = [(a, entries[a]) for a in arms if a in entries]
+        if not got:
+            continue
+        best = min(got, key=lambda kv: kv[1]["seconds_median"])
+        print(f"\n{label}  (winner: {best[0]})")
+        for name, e in got:
+            ratio = e["seconds_median"] / best[1]["seconds_median"]
+            mark = " <== winner" if name == best[0] else f"  {ratio:.2f}x"
+            print(
+                f"  {name:42} {e['seconds_median']:9.3f}s "
+                f"{e.get('rows_per_s', 0) / 1e6:9.1f}M rows/s{mark}"
+            )
+    extra = sorted(
+        n for n in entries
+        if not any(n in arms for arms in _GROUPS.values())
+    )
+    if extra:
+        print("\nother measured entries:", ", ".join(extra))
+
+
+if __name__ == "__main__":
+    main()
