@@ -49,7 +49,8 @@ _GROUPS = {
         "transpose_cast_round_trip", "transpose_cast_round_trip_pallas",
     ],
     "parquet 6M": [
-        "parquet_pipeline_4x1500k", "parquet_device_decode_4x1500k",
+        "parquet_scan_filter_agg_4x1500k",
+        "parquet_device_decode_4x1500k",
     ],
 }
 
