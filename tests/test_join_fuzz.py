@@ -25,35 +25,45 @@ from spark_rapids_jni_tpu.ops.join import (
 
 
 def _mk_table(rng, n, key_kind, null_keys, null_vals):
+    kvalid = rng.random(n) > 0.15 if null_keys else None
     if key_kind == "str":
         kidx = rng.integers(0, 12, n)
         keys = [f"sku{int(v):03d}" for v in kidx]
-        kcols = [Column.from_strings(keys)]
+        kcols = [
+            Column.from_strings(keys)
+            if kvalid is None
+            else Column.from_strings(
+                [k if ok else None
+                 for k, ok in zip(keys, kvalid)]
+            )
+        ]
         knames = ["k"]
         pdk = {"k": keys}
     elif key_kind == "multi":
         a = rng.integers(-5, 5, n, dtype=np.int64)
         b = rng.integers(0, 4, n, dtype=np.int64)
-        kcols = [Column.from_numpy(a), Column.from_numpy(b)]
+        kcols = [
+            Column.from_numpy(a, validity=kvalid),
+            Column.from_numpy(b),
+        ]
         knames = ["a", "b"]
         pdk = {"a": a, "b": b}
     else:
         k = rng.integers(-8, 8, n, dtype=np.int64)
-        kcols = [Column.from_numpy(k)]
+        kcols = [Column.from_numpy(k, validity=kvalid)]
         knames = ["k"]
         pdk = {"k": k}
-    kvalid = None
-    if null_keys and key_kind == "int":
-        kvalid = rng.random(n) > 0.15
-        kcols = [Column(kcols[0].data, kcols[0].dtype, kvalid)]
     v = rng.integers(0, 1000, n, dtype=np.int64)
     vvalid = rng.random(n) > 0.1 if null_vals else None
     vcol = Column.from_numpy(v, validity=vvalid)
     t = Table(kcols + [vcol], knames + ["v"])
     pdf = pd.DataFrame(pdk)
     if kvalid is not None:
-        pdf["k"] = pdf["k"].astype("Int64")
-        pdf.loc[~kvalid, "k"] = pd.NA
+        nk = knames[0]
+        if key_kind != "str":
+            pdf[nk] = pdf[nk].astype("Int64")
+        pdf[nk] = pdf[nk].astype("object") if key_kind == "str" else pdf[nk]
+        pdf.loc[~kvalid, nk] = pd.NA
     pdf["v"] = pd.array(v, dtype="Int64")
     if vvalid is not None:
         pdf.loc[~vvalid, "v"] = pd.NA
@@ -79,7 +89,8 @@ def _pd_rows(df):
 @pytest.mark.parametrize("seed", [0, 1, 2])
 @pytest.mark.parametrize(
     "key_kind,null_keys", [("int", False), ("int", True),
-                           ("multi", False), ("str", False)]
+                           ("multi", False), ("multi", True),
+                           ("str", False), ("str", True)]
 )
 def test_join_variants_vs_pandas(seed, key_kind, null_keys):
     kind_salt = {"int": 0, "multi": 1, "str": 2}[key_kind]
