@@ -1,0 +1,104 @@
+"""Randomized string-op sweep vs the Python str/bytes oracle.
+
+Random ASCII subjects (embedded spaces, digits, repeats, empties)
+through the byte-level op surface — length/upper/lower/strip family,
+find/contains/replace with random needles, concat, reverse, pad/zfill,
+slice — all checked element-for-element against Python's own string
+semantics. The directed suites pin the UTF-8 tier and edge syntax;
+this sweep guards the byte-path plumbing (lengths, padded matrices,
+validity) across arbitrary shape mixes."""
+
+import random
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.column import Column
+from spark_rapids_jni_tpu.ops import strings as S
+
+_ALPHA = "abcXYZ019 _-=."
+
+
+def _subjects(rng, n):
+    out = []
+    for _ in range(n):
+        ln = rng.randint(0, 14)
+        out.append("".join(rng.choice(_ALPHA) for _ in range(ln)))
+    # guaranteed edge shapes
+    out[:4] = ["", " ", "aaa", "  ab  "]
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_unary_ops_vs_python(seed):
+    rng = random.Random(seed)
+    subs = _subjects(rng, 200)
+    col = Column.from_strings(subs)
+    checks = [
+        (S.length(col), [len(s) for s in subs]),
+        (S.upper(col), [s.upper() for s in subs]),
+        (S.lower(col), [s.lower() for s in subs]),
+        (S.strip(col), [s.strip(" ") for s in subs]),
+        (S.lstrip(col), [s.lstrip(" ") for s in subs]),
+        (S.rstrip(col), [s.rstrip(" ") for s in subs]),
+        (S.reverse(col), [s[::-1] for s in subs]),
+        (S.capitalize(col), [s[:1].upper() + s[1:].lower() for s in subs]),
+    ]
+    for got_col, want in checks:
+        got = got_col.to_pylist()
+        assert got == want, (got_col, [
+            (s, g, w) for s, g, w in zip(subs, got, want) if g != w
+        ][:5])
+
+
+@pytest.mark.parametrize("seed", [3, 4, 5])
+def test_needle_ops_vs_python(seed):
+    rng = random.Random(seed)
+    subs = _subjects(rng, 200)
+    col = Column.from_strings(subs)
+    for _ in range(8):
+        nl = rng.randint(1, 3)
+        needle = "".join(rng.choice("abX0 ") for _ in range(nl))
+        got_c = S.contains(col, needle).to_pylist()
+        assert got_c == [needle in s for s in subs], needle
+        got_f = S.find(col, needle).to_pylist()
+        assert got_f == [s.find(needle) for s in subs], needle
+        repl = "".join(rng.choice("zQ_") for _ in range(rng.randint(0, 2)))
+        got_r = S.replace(col, needle, repl).to_pylist()
+        assert got_r == [s.replace(needle, repl) for s in subs], (
+            needle, repl,
+        )
+
+
+@pytest.mark.parametrize("seed", [6, 7])
+def test_binary_and_width_ops_vs_python(seed):
+    rng = random.Random(seed)
+    subs_a = _subjects(rng, 150)
+    subs_b = _subjects(rng, 150)
+    a = Column.from_strings(subs_a)
+    b = Column.from_strings(subs_b)
+    got = S.concat(a, b).to_pylist()
+    assert got == [x + y for x, y in zip(subs_a, subs_b)]
+    for width in (0, 3, 9):
+        # Spark lpad/rpad semantics: EXACTLY width bytes - truncate
+        # when longer (unlike Python ljust/rjust, which never truncate)
+        assert S.pad(a, width, "right", "*").to_pylist() == [
+            s[:width].ljust(width, "*") for s in subs_a
+        ]
+        assert S.pad(a, width, "left", "*").to_pylist() == [
+            s[:width].rjust(width, "*") for s in subs_a
+        ]
+    for width in (0, 3, 9):
+        assert S.zfill(a, width).to_pylist() == [
+            s.zfill(width) for s in subs_a
+        ]
+
+
+def test_nulls_propagate():
+    subs = ["ab", None, "", None, "x y"]
+    col = Column.from_strings(subs)
+    assert S.upper(col).to_pylist() == ["AB", None, "", None, "X Y"]
+    assert S.length(col).to_pylist() == [2, None, 0, None, 3]
+    assert S.replace(col, "x", "z").to_pylist() == [
+        "ab", None, "", None, "z y",
+    ]
