@@ -148,9 +148,19 @@ def binary_op(op: str, a: Column, b: Column) -> Column:
                 out_dtype.scale,
             )
         elif op in ("div", "true_div"):
-            zero = bv == 0
-            safe_b = jnp.where(zero, 1, bv)
-            res = av // safe_b
+            # quotient AT THE OUTPUT SCALE: rescale the dividend by
+            # 10^(scale_a - scale_b - scale_out) before the truncated
+            # divide (review catch: dividing two same-scale unscaled
+            # values yields a scale-0 quotient, which was mislabeled
+            # as scale_out — 7.50/2.00 read as 0.03). Truncation is
+            # toward zero (cudf fixed_point / Java), via lax.div.
+            e = a.dtype.scale - b.dtype.scale - out_dtype.scale
+            av_raw = compute.values(a).astype(jnp.int64)
+            bv_raw = compute.values(b).astype(jnp.int64)
+            num = av_raw * (10 ** e) if e >= 0 else av_raw
+            den = bv_raw if e >= 0 else bv_raw * (10 ** (-e))
+            zero = bv_raw == 0
+            res = jax.lax.div(num, jnp.where(zero, 1, den))
             valid = (
                 ~zero if valid is None else jnp.logical_and(valid, ~zero)
             )
@@ -173,8 +183,14 @@ def binary_op(op: str, a: Column, b: Column) -> Column:
         if is_float:
             res = av / bv  # IEEE inf/NaN on zero divide
         else:
+            # Spark IntegralDivide / Java: truncation toward zero, the
+            # same convention as mod (lax.rem) so a == b*div + mod
+            # holds for mixed signs; jnp's // floors (-7 div 2 must be
+            # -3, not -4) — caught by the binaryop fuzz
             zero = bv == 0
-            res = jnp.where(zero, 0, av // jnp.where(zero, 1, bv))
+            res = jnp.where(
+                zero, 0, jax.lax.div(av, jnp.where(zero, 1, bv))
+            )
             valid = ~zero if valid is None else jnp.logical_and(valid, ~zero)
     elif op == "floor_div":
         if is_float:
