@@ -1,0 +1,92 @@
+"""Reduction fuzz vs the pandas nullable-dtype oracle.
+
+Random columns (int64/float64/bool, random null rates including
+all-null and empty) through every reduction — sum/mean/min/max/count/
+any/all/product/variance/std — against pandas' null-skipping
+reductions, plus the null-result contract (no valid rows -> null,
+variance needs two)."""
+
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column
+from spark_rapids_jni_tpu.ops.reductions import reduce
+
+
+def _int_col(rng, n, null_rate):
+    v = rng.integers(-100, 100, max(n, 1), dtype=np.int64)[:n]
+    valid = rng.random(n) >= null_rate if n else np.zeros(0, bool)
+    return (
+        Column.from_numpy(v, validity=valid if n else None),
+        pd.Series(v, dtype="Int64").mask(~valid) if n else pd.Series([], dtype="Int64"),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("null_rate", [0.0, 0.3, 1.0])
+def test_int_reductions_vs_pandas(seed, null_rate):
+    rng = np.random.default_rng(seed)
+    col, ser = _int_col(rng, 500, null_rate)
+    for op, want in [
+        ("sum", ser.sum() if ser.count() else None),
+        ("count", ser.count()),
+        ("min", ser.min()), ("max", ser.max()),
+        ("mean", ser.mean()),
+        ("variance", ser.var(ddof=1)),
+        ("std", ser.std(ddof=1)),
+    ]:
+        got = reduce(col, op).to_pylist()[0]
+        if want is None or want is pd.NA or (
+            isinstance(want, float) and math.isnan(want)
+        ):
+            assert got is None, (op, got)
+        elif isinstance(want, float) or op in ("mean", "variance", "std"):
+            assert got == pytest.approx(float(want), rel=1e-9), op
+        else:
+            assert got == int(want), (op, got, want)
+
+
+def test_float_reductions_vs_pandas():
+    rng = np.random.default_rng(5)
+    n = 400
+    v = rng.standard_normal(n) * 10
+    valid = rng.random(n) > 0.2
+    col = Column.from_numpy(v, validity=valid)
+    ser = pd.Series(v).mask(~valid)
+    assert reduce(col, "sum").to_pylist()[0] == pytest.approx(ser.sum())
+    assert reduce(col, "mean").to_pylist()[0] == pytest.approx(ser.mean())
+    assert reduce(col, "min").to_pylist()[0] == pytest.approx(ser.min())
+    assert reduce(col, "max").to_pylist()[0] == pytest.approx(ser.max())
+    assert reduce(col, "variance").to_pylist()[0] == pytest.approx(
+        ser.var(ddof=1)
+    )
+
+
+def test_bool_any_all_vs_pandas():
+    rng = np.random.default_rng(6)
+    for null_rate in (0.0, 0.4, 1.0):
+        n = 60
+        v = rng.random(n) > 0.5
+        valid = rng.random(n) >= null_rate
+        col = Column(np.asarray(v), dt.BOOL8, np.asarray(valid))
+        ser = pd.Series(v, dtype="boolean").mask(~valid)
+        got_any = reduce(col, "any").to_pylist()[0]
+        got_all = reduce(col, "all").to_pylist()[0]
+        if ser.count() == 0:
+            assert got_any is None and got_all is None
+        else:
+            assert got_any == bool(ser.dropna().any())
+            assert got_all == bool(ser.dropna().all())
+
+
+def test_variance_needs_two_valid():
+    col = Column.from_numpy(
+        np.array([5, 9], dtype=np.int64),
+        validity=np.array([True, False]),
+    )
+    assert reduce(col, "variance").to_pylist() == [None]
+    assert reduce(col, "std").to_pylist() == [None]
