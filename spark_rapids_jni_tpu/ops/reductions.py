@@ -88,8 +88,13 @@ def reduce(col: Column, op: str) -> Column:
     else:
         info = np.iinfo(np.dtype(col.dtype.storage_dtype))
         sentinel = info.max if op == "min" else info.min
-    masked = jnp.where(valid, vals, jnp.asarray(sentinel, vals.dtype))
-    out = jnp.min(masked) if op == "min" else jnp.max(masked)
+    if vals.shape[0] == 0:
+        # jnp.min/max have no identity and raise on 0 rows; an empty
+        # reduction is simply null (has_result is already False)
+        out = jnp.asarray(sentinel, vals.dtype)
+    else:
+        masked = jnp.where(valid, vals, jnp.asarray(sentinel, vals.dtype))
+        out = jnp.min(masked) if op == "min" else jnp.max(masked)
     return compute.from_values(out[None], col.dtype, has_result)
 
 

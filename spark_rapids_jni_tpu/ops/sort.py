@@ -47,6 +47,13 @@ def _key_words(col: Column, key: SortKey) -> list[jax.Array]:
     if not key.ascending:
         words = [~w for w in words]
     if col.validity is not None:
+        # All nulls are EQUAL under ORDER BY: zero their key words so
+        # masked garbage cannot order the null block — ties must fall
+        # through to the next sort key / stability (caught by the sort
+        # fuzz: null-primary rows were ordered by their hidden values)
+        words = [
+            jnp.where(col.validity, w, jnp.uint64(0)) for w in words
+        ]
         # Leading null-placement word: 0 sorts before 1, so nulls get 0 when
         # they go first and 1 when they go last.
         if key.resolved_nulls_first:

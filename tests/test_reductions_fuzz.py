@@ -72,7 +72,7 @@ def test_bool_any_all_vs_pandas():
         n = 60
         v = rng.random(n) > 0.5
         valid = rng.random(n) >= null_rate
-        col = Column(np.asarray(v), dt.BOOL8, np.asarray(valid))
+        col = Column.from_numpy(v, validity=valid)
         ser = pd.Series(v, dtype="boolean").mask(~valid)
         got_any = reduce(col, "any").to_pylist()[0]
         got_all = reduce(col, "all").to_pylist()[0]
@@ -81,6 +81,23 @@ def test_bool_any_all_vs_pandas():
         else:
             assert got_any == bool(ser.dropna().any())
             assert got_all == bool(ser.dropna().all())
+
+
+def test_product_and_empty():
+    rng = np.random.default_rng(8)
+    v = rng.integers(1, 5, 20, dtype=np.int64)
+    valid = rng.random(20) > 0.3
+    col = Column.from_numpy(v, validity=valid)
+    want = int(np.prod(v[valid]))
+    assert reduce(col, "product").to_pylist() == [want]
+    # all-null -> identity product but null result
+    col2 = Column.from_numpy(v, validity=np.zeros(20, bool))
+    assert reduce(col2, "product").to_pylist() == [None]
+    # empty column: every reduction must be null (count 0)
+    empty = Column.from_numpy(np.zeros(0, dtype=np.int64))
+    assert reduce(empty, "count").to_pylist() == [0]
+    for op in ("sum", "min", "max", "mean", "variance", "product"):
+        assert reduce(empty, op).to_pylist() == [None], op
 
 
 def test_variance_needs_two_valid():
