@@ -80,7 +80,10 @@ def _rescale_decimal(vals: jax.Array, from_scale: int, to_scale: int) -> jax.Arr
         return vals
     if to_scale < from_scale:
         return vals * (10 ** (from_scale - to_scale))
-    return vals // (10 ** (to_scale - from_scale))
+    # narrowing truncates toward zero (cudf fixed_point / int128.rescale
+    # convention; // would floor negatives: -3.75 at scale -1 is -3.7)
+    return jax.lax.div(vals, jnp.asarray(10 ** (to_scale - from_scale),
+                                         vals.dtype))
 
 
 def binary_op(op: str, a: Column, b: Column) -> Column:

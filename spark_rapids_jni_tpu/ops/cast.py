@@ -49,11 +49,11 @@ def cast(col: Column, to: dt.DType) -> Column:
 
 
 def _rescale(vals, from_scale: int, to_scale: int):
-    if from_scale == to_scale:
-        return vals
-    if to_scale < from_scale:
-        return vals * (10 ** (from_scale - to_scale))
-    return vals // (10 ** (to_scale - from_scale))
+    """Decimal rescale: one shared implementation (truncation toward
+    zero on narrowing) lives in binaryop._rescale_decimal."""
+    from .binaryop import _rescale_decimal
+
+    return _rescale_decimal(vals, from_scale, to_scale)
 
 
 def _cast_decimal128(col: Column, to: dt.DType) -> Column:

@@ -1,0 +1,77 @@
+"""Numeric cast-lattice fuzz vs a Spark non-ANSI oracle.
+
+Random values through int-width narrowing (two's-complement wrap),
+float->int truncation, int->float, bool conversions, and
+decimal<->int rescales — null passthrough everywhere."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu.column import Column
+from spark_rapids_jni_tpu.ops.cast import cast
+
+_INTS = [
+    (dt.INT8, np.int8), (dt.INT16, np.int16),
+    (dt.INT32, np.int32), (dt.INT64, np.int64),
+]
+
+
+@pytest.mark.parametrize("src_dt,src_np", _INTS)
+@pytest.mark.parametrize("dst_dt,dst_np", _INTS)
+def test_int_width_lattice_wraps(src_dt, src_np, dst_dt, dst_np):
+    rng = np.random.default_rng(1)
+    info = np.iinfo(src_np)
+    v = rng.integers(
+        info.min, int(info.max) + 1, 300, dtype=np.int64
+    ).astype(src_np)
+    valid = rng.random(300) > 0.15
+    col = Column.from_numpy(v, validity=valid)
+    got = cast(col, dst_dt).to_pylist()
+    want = [
+        int(x.astype(dst_np)) if ok else None
+        for x, ok in zip(v, valid)
+    ]
+    assert got == want
+
+
+def test_float_to_int_truncates_toward_zero():
+    v = np.array([1.9, -1.9, 0.5, -0.5, 2.0, -2.0, 1e9 + 0.7])
+    col = Column.from_numpy(v)
+    got = cast(col, dt.INT64).to_pylist()
+    assert got == [1, -1, 0, 0, 2, -2, 1000000000]
+
+
+def test_int_to_float_and_back():
+    rng = np.random.default_rng(2)
+    v = rng.integers(-(2 ** 50), 2 ** 50, 200, dtype=np.int64)
+    col = Column.from_numpy(v)
+    f = cast(col, dt.FLOAT64)
+    back = cast(f, dt.INT64).to_pylist()
+    # within 2^53, float64 round-trips ints exactly
+    assert back == [int(x) for x in v]
+
+
+def test_bool_conversions():
+    v = np.array([0, 1, -3, 7, 0], dtype=np.int64)
+    col = Column.from_numpy(v)
+    got = cast(col, dt.BOOL8).to_pylist()
+    assert got == [False, True, True, True, False]
+    b = Column.from_numpy(np.array([True, False, True]))
+    assert cast(b, dt.INT32).to_pylist() == [1, 0, 1]
+    assert cast(b, dt.FLOAT64).to_pylist() == [1.0, 0.0, 1.0]
+
+
+def test_decimal_int_rescales():
+    d2 = dt.DType(dt.TypeId.DECIMAL64, -2)
+    v = np.array([150, -375, 0, 999], dtype=np.int64)  # 1.50 -3.75 0 9.99
+    col = Column.from_numpy(v, dtype=d2)
+    # decimal -> wider scale decimal
+    d1 = dt.DType(dt.TypeId.DECIMAL64, -1)
+    # cudf fixed_point rescale truncates toward zero: -3.75 -> -3.7
+    assert np.asarray(cast(col, d1).data).tolist() == [15, -37, 0, 99]
+    # int -> decimal and back
+    i = Column.from_numpy(np.array([7, -3], dtype=np.int64))
+    dec = cast(i, d2)
+    assert np.asarray(dec.data).tolist() == [700, -300]
+    assert cast(dec, dt.INT64).to_pylist() == [7, -3]
