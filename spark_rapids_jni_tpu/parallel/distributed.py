@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..column import Table
+from ..utils import metrics
 from ..ops.groupby import GroupbyAgg, groupby_aggregate_capped
 from ..ops.join import (
     inner_join_capped,
@@ -68,7 +69,11 @@ def _warn_if_recv_exceeds_hbm(cap: int, table: Table, label: str) -> None:
         estimated_bytes=int(est), budget_bytes=int(budget),
         fits=bool(est <= budget),
     )
+    if metrics.enabled():
+        metrics.counter_add("shuffle.recv_plans")
+        metrics.bytes_add("shuffle.recv_planned_bytes", int(est))
     if est > budget:
+        metrics.counter_add("shuffle.recv_over_budget")
         import warnings
 
         warnings.warn(
@@ -93,6 +98,7 @@ class GroupOverflowError(RuntimeError):
     wrappers; never silent."""
 
 
+@metrics.traced("distributed.groupby")
 def distributed_groupby(
     table: Table,
     by: Sequence[Union[int, str]],
@@ -154,6 +160,7 @@ def distributed_groupby(
     return agg, ngroups, overflow
 
 
+@metrics.traced("distributed.inner_join")
 def distributed_inner_join(
     left: Table,
     right: Table,
@@ -286,6 +293,7 @@ def _co_partition(
     return ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts
 
 
+@metrics.traced("distributed.left_join")
 def distributed_left_join(
     left: Table,
     right: Table,
@@ -334,6 +342,7 @@ def _distributed_membership_join(
     return ls_g, occ, lov, rov
 
 
+@metrics.traced("distributed.semi_join")
 def distributed_semi_join(
     left: Table,
     right: Table,
@@ -353,6 +362,7 @@ def distributed_semi_join(
     )
 
 
+@metrics.traced("distributed.anti_join")
 def distributed_anti_join(
     left: Table,
     right: Table,
@@ -368,6 +378,7 @@ def distributed_anti_join(
     )
 
 
+@metrics.traced("distributed.distinct")
 def distributed_distinct(
     table: Table,
     keys: Optional[Sequence[Union[int, str]]] = None,
@@ -399,6 +410,7 @@ def distributed_distinct(
     )
 
 
+@metrics.traced("distributed.broadcast_join")
 def broadcast_inner_join(
     left: Table,
     right: Table,
@@ -496,6 +508,7 @@ def broadcast_inner_join(
     return out, count
 
 
+@metrics.traced("distributed.sort")
 def distributed_sort(
     table: Table,
     sort_keys,

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..column import Column, Table
 from ..ops.partition import partition_ids_hash
+from ..utils import metrics
 from .mesh import SHUFFLE_AXIS, shard_map, shard_table
 
 
@@ -113,8 +114,13 @@ def plan_capacity(
     axis: str = SHUFFLE_AXIS,
 ) -> int:
     """Exact-overflow-free exchange capacity for ``sharded`` (host sync)."""
-    counts = partition_counts(sharded, columns, mesh, axis)
-    return _round_capacity(int(jnp.max(counts)))
+    with metrics.span("shuffle.plan"):
+        counts = partition_counts(sharded, columns, mesh, axis)
+        cap = _round_capacity(int(jnp.max(counts)))
+    if metrics.enabled():
+        metrics.counter_add("shuffle.plans")
+        metrics.gauge_set("shuffle.pair_capacity", cap)
+    return cap
 
 
 def exchange(
@@ -198,7 +204,11 @@ def total_recv_capacity(counts) -> int:
     the same output shape, so the best possible per-device buffer is the
     hottest destination's actual row total, NOT num_partitions x the
     hottest (src, dst) pair (the round-2 skew-OOM failure mode)."""
-    return _round_capacity(int(jnp.max(jnp.sum(counts, axis=0))))
+    cap = _round_capacity(int(jnp.max(jnp.sum(counts, axis=0))))
+    if metrics.enabled():
+        metrics.counter_add("shuffle.plans")
+        metrics.gauge_set("shuffle.recv_capacity", cap)
+    return cap
 
 
 def _ragged_impl(impl: Optional[str]) -> str:
@@ -360,6 +370,7 @@ def exchange_ragged_by_hash(
     )
 
 
+@metrics.traced("shuffle.table_compact")
 def shuffle_table_compact(
     table: Table,
     columns: Optional[Sequence[Union[int, str]]],
@@ -378,6 +389,8 @@ def shuffle_table_compact(
     one destination) no longer inflates every device's allocation by a
     factor of P. Returns (sharded compact table, occupancy, overflow).
     """
+    metrics.counter_add("shuffle.exchanges")
+    metrics.counter_add("shuffle.rows_exchanged", table.row_count)
     validate_on_overflow(on_overflow)
     impl = _ragged_impl(impl)
     sharded = shard_table(table, mesh, axis)
@@ -405,6 +418,7 @@ def shuffle_table_compact(
     return out, occ, overflow
 
 
+@metrics.traced("shuffle.table")
 def shuffle_table(
     table: Table,
     columns: Optional[Sequence[Union[int, str]]],
@@ -422,6 +436,8 @@ def shuffle_table(
     (default) raises ``ShuffleOverflowError``; ``"allow"`` opts into the
     caller checking the returned overflow counts itself.
     """
+    metrics.counter_add("shuffle.exchanges")
+    metrics.counter_add("shuffle.rows_exchanged", table.row_count)
     validate_on_overflow(on_overflow)
     num = int(mesh.shape[axis])
     sharded = shard_table(table, mesh, axis)

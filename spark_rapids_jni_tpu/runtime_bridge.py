@@ -38,7 +38,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 
 from . import dtype as dt
 from .column import Column, Table
-from .utils import log
+from .utils import log, metrics
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -97,6 +97,13 @@ def _column_from_wire(
     type_id: int, scale: int, data: Optional[bytes],
     valid: Optional[bytes], num_rows: int,
 ) -> Column:
+    if metrics.enabled():
+        metrics.bytes_add(
+            "wire.bytes_in",
+            (len(data) if data is not None else 0)
+            + (len(valid) if valid is not None else 0),
+        )
+        metrics.counter_add("wire.columns_in")
     if dt.TypeId(type_id) == dt.TypeId.LIST:
         # LIST wire convention: the scale slot carries the CHILD type id
         # (scale is meaningless for LIST); payload per _padded_from_offsets.
@@ -156,6 +163,17 @@ def _column_to_wire(c: Column):
     LIST columns use the convention documented in _column_from_wire:
     scale = child type id, data = int32 offsets then child values.
     """
+    out = _column_to_wire_impl(c)
+    if metrics.enabled():
+        metrics.bytes_add(
+            "wire.bytes_out",
+            len(out[2]) + (len(out[3]) if out[3] is not None else 0),
+        )
+        metrics.counter_add("wire.columns_out")
+    return out
+
+
+def _column_to_wire_impl(c: Column):
     if c.dtype.id == dt.TypeId.STRING:
         valid = (
             None
@@ -205,13 +223,34 @@ def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
     ``rest`` carries additional input tables for multi-table ops
     (``join`` takes the probe side as ``table`` and the build side as
     ``rest[0]``; ``concat`` appends every table in ``rest``).
+
+    Every op runs inside a ``metrics.span`` and feeds the per-op
+    call/row counters — the ``GpuMetric`` plane of the dispatch layer.
+    The disabled path costs one string concat and the span's cheap
+    gate checks.
     """
+    name = op["op"]
+    with metrics.span("dispatch." + name):
+        out = _dispatch_impl(op, table, rest, name)
+    if metrics.enabled():
+        rows_in = int(table.row_count) + sum(
+            int(t.row_count) for t in rest
+        )
+        metrics.counter_add("op." + name + ".calls")
+        metrics.counter_add("op." + name + ".rows_in", rows_in)
+        metrics.counter_add("op." + name + ".rows_out", int(out.row_count))
+        metrics.hist_observe("dispatch.rows_in", rows_in)
+    return out
+
+
+def _dispatch_impl(
+    op: dict, table: Table, rest: Sequence[Table], name: str
+) -> Table:
     import jax.numpy as jnp
 
     from . import ops
     from . import rows as rows_mod
 
-    name = op["op"]
     if name == "join":
         how = op.get("how", "inner")
         fn = {
@@ -328,18 +367,20 @@ def table_op_wire(
     Returns (out_type_ids, out_scales, out_datas, out_valids, out_rows).
     """
     op = json.loads(op_json)
-    cols = [
-        _column_from_wire(t, s, d, v, num_rows)
-        for t, s, d, v in zip(type_ids, scales, datas, valids)
-    ]
+    with metrics.span("wire.deserialize"):
+        cols = [
+            _column_from_wire(t, s, d, v, num_rows)
+            for t, s, d, v in zip(type_ids, scales, datas, valids)
+        ]
     result = _dispatch(op, Table(cols))
     out_t, out_s, out_d, out_v = [], [], [], []
-    for c in result.columns:
-        t, s, d, v = _column_to_wire(c)
-        out_t.append(t)
-        out_s.append(s)
-        out_d.append(d)
-        out_v.append(v)
+    with metrics.span("wire.serialize"):
+        for c in result.columns:
+            t, s, d, v = _column_to_wire(c)
+            out_t.append(t)
+            out_s.append(s)
+            out_d.append(d)
+            out_v.append(v)
     return out_t, out_s, out_d, out_v, int(result.row_count)
 
 
@@ -379,6 +420,7 @@ def _resident_get(table_id: int) -> Table:
         t = _RESIDENT.get(int(table_id))
     if t is None:
         raise KeyError(f"unknown device table id {table_id}")
+    metrics.counter_add("resident.get")
     return t
 
 
@@ -389,6 +431,11 @@ def _resident_put(t: Table) -> int:
         live = len(_RESIDENT)
     log.log("DEBUG", "handles", "resident_put", table_id=tid,
             rows=int(t.row_count), live=live)
+    # resident.live's high-water mark is the leak-report analog: a chain
+    # that frees what it allocates returns to the pre-chain value while
+    # high_water records the peak resident set
+    metrics.counter_add("resident.put")
+    metrics.gauge_set("resident.live", live)
     return tid
 
 
@@ -400,10 +447,11 @@ def table_upload_wire(
     num_rows: int,
 ) -> int:
     """Host bytes -> device-resident table; returns its id."""
-    cols = [
-        _column_from_wire(t, s, d, v, num_rows)
-        for t, s, d, v in zip(type_ids, scales, datas, valids)
-    ]
+    with metrics.span("wire.deserialize"):
+        cols = [
+            _column_from_wire(t, s, d, v, num_rows)
+            for t, s, d, v in zip(type_ids, scales, datas, valids)
+        ]
     return _resident_put(Table(cols))
 
 
@@ -425,12 +473,13 @@ def table_download_wire(table_id: int):
     """Resident table -> the wire 5-tuple of table_op_wire."""
     t = _resident_get(table_id)
     out_t, out_s, out_d, out_v = [], [], [], []
-    for c in t.columns:
-        ti, s, d, v = _column_to_wire(c)
-        out_t.append(ti)
-        out_s.append(s)
-        out_d.append(d)
-        out_v.append(v)
+    with metrics.span("wire.serialize"):
+        for c in t.columns:
+            ti, s, d, v = _column_to_wire(c)
+            out_t.append(ti)
+            out_s.append(s)
+            out_d.append(d)
+            out_v.append(v)
     return out_t, out_s, out_d, out_v, int(t.row_count)
 
 
@@ -446,6 +495,8 @@ def table_free(table_id: int) -> None:
         raise KeyError(f"unknown device table id {table_id}")
     log.log("DEBUG", "handles", "table_free", table_id=int(table_id),
             live=live)
+    metrics.counter_add("resident.free")
+    metrics.gauge_set("resident.live", live)
 
 
 def resident_table_count() -> int:

@@ -12,6 +12,10 @@ Flags (reference analog in parens):
 
 * ``TRACE``            — profiler range annotations on/off
                          (``ai.rapids.cudf.nvtx.enabled``, pom.xml:85,200).
+* ``METRICS``          — op-level metrics registry (utils/metrics.py),
+                         the per-operator ``GpuMetric`` counters analog.
+* ``METRICS_DUMP``     — path to write the metrics snapshot JSON at
+                         process exit; setting it implies ``METRICS``.
 * ``REFCOUNT_DEBUG``   — buffer-registry leak tracking with provenance
                          (``ai.rapids.refcount.debug``, pom.xml:86,199).
 * ``ALLOC_LOG_LEVEL``  — allocation logging verbosity
@@ -66,6 +70,16 @@ _FLAGS = {
     f.name: f
     for f in [
         Flag("TRACE", False, _as_bool, "profiler trace annotations"),
+        Flag(
+            "METRICS", False, _as_bool,
+            "op-level metrics registry + spans (utils/metrics.py): op "
+            "counts, wire bytes, timers, resident-handle high-water",
+        ),
+        Flag(
+            "METRICS_DUMP", "", str,
+            "path to write metrics.snapshot() JSON at process exit "
+            "(atexit); a non-empty path implies METRICS",
+        ),
         Flag("REFCOUNT_DEBUG", False, _as_bool, "buffer leak tracking"),
         Flag(
             "LOG_LEVEL", "OFF", str.upper,
@@ -97,6 +111,19 @@ _FLAGS = {
 # Test/runtime overrides set via set_flag (take precedence over env).
 _overrides: dict = {}
 
+# Monotonic counter bumped on every set_flag/clear_flag: the cache-
+# invalidation key for hot-path gates (utils/metrics.py caches its
+# enabled() verdict against it so a disabled instrumentation site costs
+# an int compare, not an environ read per call). Environment-variable
+# changes made mid-process after the first read are NOT observed by
+# cached gates — set flags through this API (tests already must, since
+# exported shell values are pinned per-process anyway).
+_generation = 0
+
+
+def generation() -> int:
+    return _generation
+
 
 def get_flag(name: str):
     """Current value of a declared flag (override > env > default)."""
@@ -118,14 +145,24 @@ def flag_is_set(name: str) -> bool:
     return name in _overrides or flag.env_var in os.environ
 
 
+def flag_default(name: str):
+    """Declared default of a flag — the fallback target when an
+    explicitly set value fails to parse (log.py's invalid-level path)."""
+    return _FLAGS[name].default
+
+
 def set_flag(name: str, value) -> None:
+    global _generation
     if name not in _FLAGS:
         raise KeyError(f"unknown flag {name!r}")
     _overrides[name] = value
+    _generation += 1
 
 
 def clear_flag(name: str) -> None:
+    global _generation
     _overrides.pop(name, None)
+    _generation += 1
 
 
 def describe_flags() -> str:

@@ -25,6 +25,7 @@ import numpy as np
 
 from . import config
 from . import log
+from . import metrics
 
 GIB = 1 << 30
 
@@ -97,6 +98,19 @@ def key_word_count(cols: Sequence) -> int:
     return words
 
 
+def _record_plan(kind: str, plan: dict, planned_bytes: int) -> None:
+    """Plan-vs-budget decisions on the metrics plane: how many plans ran,
+    how many bytes they committed, and how often a shape failed to fit
+    (the spill/chunk trigger)."""
+    if not metrics.enabled():
+        return
+    metrics.counter_add("hbm.plan." + kind)
+    metrics.bytes_add("hbm.planned_bytes", planned_bytes)
+    metrics.gauge_set("hbm.budget_bytes", plan["budget_bytes"])
+    if not plan["fits"]:
+        metrics.counter_add("hbm.plan_over_budget")
+
+
 def join_plan(
     left,
     right,
@@ -144,6 +158,10 @@ def join_plan(
         "fits": avail > 0,
     }
     log.log("INFO", "hbm", "join_plan", **plan)
+    _record_plan("join", plan, int(fixed))
+    if metrics.enabled() and probe_rows < left.row_count:
+        # the plan decided the probe side must be chunked
+        metrics.counter_add("hbm.join_chunk_decisions")
     return plan
 
 
@@ -159,6 +177,7 @@ def sort_plan(table, n_key_words: int, platform: Optional[str] = None) -> dict:
         "fits": total <= budget_bytes(platform),
     }
     log.log("INFO", "hbm", "sort_plan", rows=n, **plan)
+    _record_plan("sort", plan, int(total))
     return plan
 
 
@@ -183,4 +202,5 @@ def groupby_plan(
     }
     log.log("INFO", "hbm", "groupby_plan", rows=n, segments=num_segments,
             **plan)
+    _record_plan("groupby", plan, int(total))
     return plan

@@ -40,6 +40,26 @@ LEVELS = {
 
 _ALLOC_CHANNELS = frozenset({"hbm", "handles"})
 
+# (flag, value) pairs already warned about — an invalid level must be
+# reported exactly once, not on every gated call
+_WARNED_INVALID: set = set()
+
+
+def _warn_invalid_level(flag: str, value: str, fallback: str) -> None:
+    """One-time, ungated WARN for a typo'd level value: the user
+    explicitly asked for logging, so silently mapping the typo to OFF
+    (the pre-fix behavior) silenced the one person who opted in."""
+    key = (flag, value)
+    if key in _WARNED_INVALID:
+        return
+    _WARNED_INVALID.add(key)
+    print(
+        f"[srt][log][WARN] invalid {flag}={value!r} "
+        f"(expected {'|'.join(LEVELS)}); falling back to {fallback}",
+        file=sys.stderr,
+        flush=True,
+    )
+
 
 def _resolve_level(channel: str) -> int:
     from . import config
@@ -55,7 +75,16 @@ def _resolve_level(channel: str) -> int:
             return LEVELS[alloc]
         # invalid value: fall back to LOG_LEVEL rather than silently
         # killing the channel
-    return LEVELS.get(str(config.get_flag("LOG_LEVEL")).upper(), 0)
+        _warn_invalid_level(
+            "SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL", alloc, "LOG_LEVEL"
+        )
+    level = str(config.get_flag("LOG_LEVEL")).upper()
+    got = LEVELS.get(level)
+    if got is None:
+        default = str(config.flag_default("LOG_LEVEL")).upper()
+        _warn_invalid_level("SPARK_RAPIDS_TPU_LOG_LEVEL", level, default)
+        got = LEVELS.get(default, 0)
+    return got
 
 
 def enabled(level: str, channel: str = "general") -> bool:

@@ -14,6 +14,8 @@ reference's ship-it-disabled default.
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 from typing import Iterator, Optional
 
 from . import config
@@ -55,8 +57,23 @@ def annotate(name: Optional[str] = None):
 
 @contextlib.contextmanager
 def capture_trace(log_dir: str) -> Iterator[None]:
-    """Capture a full profiler trace (Perfetto) into ``log_dir``."""
+    """Capture a full profiler trace (Perfetto) into ``log_dir``.
+
+    Creates ``log_dir`` if missing, and WARNs (ungated — a silent empty
+    capture wasted a round-5 debugging session) when the capture leaves
+    the directory empty, which usually means the profiler backend never
+    attached (e.g. a tunnel drop mid-capture).
+    """
     import jax.profiler
 
+    os.makedirs(log_dir, exist_ok=True)
     with jax.profiler.trace(log_dir):
         yield
+    if not any(files for _, _, files in os.walk(log_dir)):
+        print(
+            f"[srt][trace][WARN] capture_trace({log_dir!r}) produced no "
+            "files — the profiler backend likely never attached; the "
+            "capture is empty",
+            file=sys.stderr,
+            flush=True,
+        )

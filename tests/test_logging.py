@@ -15,9 +15,11 @@ def _reset_flags(monkeypatch):
     # the developer's shell must not flip these assertions
     monkeypatch.delenv("SPARK_RAPIDS_TPU_LOG_LEVEL", raising=False)
     monkeypatch.delenv("SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL", raising=False)
+    log._WARNED_INVALID.clear()  # one-time warnings: once per TEST
     yield
     config.clear_flag("LOG_LEVEL")
     config.clear_flag("ALLOC_LOG_LEVEL")
+    log._WARNED_INVALID.clear()
 
 
 def _table(n=64):
@@ -98,3 +100,36 @@ def test_invalid_alloc_level_falls_back(capsys):
     config.set_flag("ALLOC_LOG_LEVEL", "VERBOSE")  # typo'd value
     log.log("INFO", "hbm", "hbm-line")
     assert "hbm-line" in capsys.readouterr().err
+
+
+def test_invalid_log_level_warns_once_and_names_value(capsys):
+    # the pre-fix behavior mapped a typo silently to OFF — the one user
+    # who opted into logging got total silence with no indication why
+    config.set_flag("LOG_LEVEL", "CHATTY")
+    log.log("ERROR", "general", "first")
+    err = capsys.readouterr().err
+    assert "[srt][log][WARN]" in err
+    assert "CHATTY" in err and "SPARK_RAPIDS_TPU_LOG_LEVEL" in err
+    # one-time: a second gated call must not repeat the warning
+    log.log("ERROR", "general", "second")
+    assert "CHATTY" not in capsys.readouterr().err
+
+
+def test_invalid_log_level_falls_back_to_default(capsys):
+    # fallback target is the DECLARED default, not hardcoded OFF
+    config.set_flag("LOG_LEVEL", "NOPE")
+    assert not log.enabled("ERROR")
+    assert log._resolve_level("general") == log.LEVELS[
+        str(config.flag_default("LOG_LEVEL"))
+    ]
+
+
+def test_invalid_alloc_level_warns_once(capsys):
+    config.set_flag("LOG_LEVEL", "INFO")
+    config.set_flag("ALLOC_LOG_LEVEL", "VERBOSE")
+    log.log("INFO", "hbm", "a")
+    err = capsys.readouterr().err
+    assert "SPARK_RAPIDS_TPU_ALLOC_LOG_LEVEL" in err
+    assert "VERBOSE" in err
+    log.log("INFO", "hbm", "b")
+    assert "VERBOSE" not in capsys.readouterr().err

@@ -6,6 +6,11 @@ each A/B with its winner — the round-5 decision table (which
 formulation becomes each op's default) generated from data instead of
 eyeballs.
 
+Also summarizes the per-config "metrics" blocks bench entries carry
+since the observability PR (top ops by time and by bytes moved, plus
+structured failure records), tolerating old BENCH files that predate
+them.
+
 Usage: python tools/analyze_bench.py [path-to-state-or-bench-json]
 """
 
@@ -55,7 +60,8 @@ _GROUPS = {
 }
 
 
-def _load(path: str) -> dict:
+def _load(path: str) -> tuple:
+    """(ranked-entries-by-name, raw entry list incl. failures/metrics)."""
     with open(path) as f:
         text = f.read()
     try:
@@ -71,23 +77,112 @@ def _load(path: str) -> dict:
         if doc is None:
             raise
     entries = {}
+    raw = []
     if "entries" in doc:  # daemon state file
         for cfg in doc["entries"].values():
             for e in cfg["results"]:
-                entries[e.get("name")] = e
+                raw.append(e)
+                if "seconds_median" in e:
+                    entries[e.get("name")] = e
     # BENCH_r*.json wraps the bench summary under "parsed"
     summary = doc.get("parsed") or doc
     for e in summary.get("configs", []) or []:
+        raw.append(e)
         if "name" in e and "seconds_median" in e:
             entries.setdefault(e["name"], e)
-    return entries
+    return entries, raw
+
+
+def _merge_metrics(raw: list) -> dict:
+    """Fold every entry's "metrics" block into one {timers, bytes,
+    counters} aggregate. Identical blocks (several entries of one
+    config share a snapshot) are folded once."""
+    timers: dict = {}
+    byte_ctrs: dict = {}
+    counters: dict = {}
+    seen = set()
+    for e in raw:
+        m = e.get("metrics")
+        if not isinstance(m, dict):
+            continue
+        key = json.dumps(m, sort_keys=True)
+        if key in seen:
+            continue
+        seen.add(key)
+        for name, t in (m.get("timers") or {}).items():
+            agg = timers.setdefault(name, {"count": 0, "total_s": 0.0})
+            agg["count"] += int(t.get("count", 0))
+            agg["total_s"] += float(t.get("total_s", 0.0))
+        for name, v in (m.get("bytes") or {}).items():
+            byte_ctrs[name] = byte_ctrs.get(name, 0) + int(v)
+        for name, v in (m.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + int(v)
+    return {"timers": timers, "bytes": byte_ctrs, "counters": counters}
+
+
+def summarize_metrics(raw: list, top: int = 10) -> None:
+    """Print top-N ops by total time and byte counters by volume from
+    the entries' "metrics" blocks; quiet note when absent (old files)."""
+    merged = _merge_metrics(raw)
+    if not merged["timers"] and not merged["bytes"]:
+        print("\nno metrics blocks (pre-observability BENCH file)")
+        return
+    if merged["timers"]:
+        print(f"\ntop {top} ops by total time:")
+        ranked = sorted(
+            merged["timers"].items(),
+            key=lambda kv: kv[1]["total_s"],
+            reverse=True,
+        )[:top]
+        for name, t in ranked:
+            print(
+                f"  {name:42} {t['total_s']:9.3f}s over "
+                f"{t['count']} calls"
+            )
+    if merged["bytes"]:
+        print(f"\ntop {top} byte counters:")
+        ranked = sorted(
+            merged["bytes"].items(), key=lambda kv: kv[1], reverse=True
+        )[:top]
+        for name, v in ranked:
+            print(f"  {name:42} {v / 1e6:12.2f} MB")
+    ops = sorted(
+        (k, v) for k, v in merged["counters"].items()
+        if k.startswith("op.") and k.endswith(".calls")
+    )
+    if ops:
+        print("\ndispatched ops:")
+        for name, v in ops:
+            print(f"  {name[3:-6]:42} {v} calls")
+
+
+def summarize_failures(raw: list) -> None:
+    """Print the structured failure records (diagnosable-from-JSON)."""
+    fails = [e for e in raw if isinstance(e.get("failure"), dict)]
+    if not fails:
+        return
+    print("\nfailures:")
+    for e in fails:
+        f = e["failure"]
+        extra = []
+        if f.get("elapsed_s") is not None:
+            extra.append(f"after {f['elapsed_s']}s")
+        if f.get("retries"):
+            extra.append(f"{f['retries']} retries")
+        tail = f" ({', '.join(extra)})" if extra else ""
+        print(
+            f"  {e.get('name', '?'):32} {f.get('type', 'Error')}: "
+            f"{f.get('message', '')[:80]}{tail}"
+        )
 
 
 def main() -> None:
     path = sys.argv[1] if len(sys.argv) > 1 else _STATE
-    entries = _load(path)
+    entries, raw = _load(path)
     if not entries:
         print("no measured entries")
+        summarize_metrics(raw)
+        summarize_failures(raw)
         return
     for label, arms in _GROUPS.items():
         got = [(a, entries[a]) for a in arms if a in entries]
@@ -108,6 +203,8 @@ def main() -> None:
     )
     if extra:
         print("\nother measured entries:", ", ".join(extra))
+    summarize_metrics(raw)
+    summarize_failures(raw)
 
 
 if __name__ == "__main__":
