@@ -39,13 +39,15 @@ def _progress(msg):
 
 
 def _failure_record(
-    name, error, exc_type=None, elapsed_s=None, retries=0
+    name, error, exc_type=None, elapsed_s=None, retries=0, skipped=False
 ):
     """Structured failure entry: exception type, message, elapsed time
     and retry count, so a killed ladder is diagnosable from the JSON
     alone (rounds 1-5 died with bare '"error": "device unreachable"'
     strings and no telemetry). The flat "error" string stays for old
-    readers; "failure" is the structured record."""
+    readers; "failure" is the structured record. ``skipped=True`` marks
+    a config that was never attempted (budget exhausted / fast-fail
+    after the tunnel went down) as opposed to one that ran and died."""
     msg = str(error)[:300]
     return {
         "name": name,
@@ -59,8 +61,30 @@ def _failure_record(
                 round(float(elapsed_s), 3) if elapsed_s is not None else None
             ),
             "retries": int(retries),
+            "skipped": bool(skipped),
         },
     }
+
+
+# markers of a dead/hung tunnel in a config failure: after the FIRST of
+# these, re-probe once and fast-fail the rest of the device ladder
+# instead of burning a per-config timeout on every remaining entry
+_UNREACHABLE_MARKERS = (
+    "unreachable", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+    "failed to connect", "Connection reset", "socket closed",
+)
+
+
+def _unreachable_failure(entry) -> bool:
+    """True when a failure entry smells like the device/tunnel died
+    (vs a genuine per-config crash)."""
+    f = entry.get("failure") or {}
+    if f.get("type") in ("DeviceUnreachable", "TimeoutExpired"):
+        return True
+    # casefold both sides: gRPC/absl capitalize freely ("Failed to
+    # connect", "Socket closed")
+    msg = f"{f.get('message', '')} {entry.get('error', '')}".lower()
+    return any(m.lower() in msg for m in _UNREACHABLE_MARKERS)
 
 
 def _metrics_enable():
@@ -648,6 +672,89 @@ def bench_join_batched_packed(platform, n=None):
     return e
 
 
+def bench_bucketed_stream(platform, n_batches=12):
+    """Shape-bucket dispatch bench: a ragged stream of ColumnarBatch-
+    shaped wire calls (filter -> sort -> groupby per batch, every batch
+    a different row count) with pad-to-bucket batching + the compiled-
+    executable cache ON vs OFF. COLD timings are the story: the exact
+    path compiles every op for every distinct size, the bucketed path
+    compiles once per (op, bucket) and then streams on cache hits."""
+    import time as _time
+
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import runtime_bridge as rb
+    from spark_rapids_jni_tpu.utils import buckets as buckets_mod
+    from spark_rapids_jni_tpu.utils import config as srt_config
+    from spark_rapids_jni_tpu.utils import metrics as srt_metrics
+
+    _metrics_enable()  # the cache/pad counters ARE this config's story
+    rng = np.random.default_rng(31)
+    sizes = sorted(
+        int(s) for s in rng.integers(50_000, 140_000, n_batches)
+    )
+    i64 = int(dt.TypeId.INT64)
+    b8 = int(dt.TypeId.BOOL8)
+    op_filter = json.dumps({"op": "filter", "mask": 2})
+    op_sort = json.dumps({"op": "sort_by", "keys": [{"column": 0}]})
+    op_group = json.dumps(
+        {"op": "groupby", "by": [0], "aggs": [{"column": 1, "agg": "sum"}]}
+    )
+    batches = []
+    for nn in sizes:
+        kk = rng.integers(0, 1000, nn, dtype=np.int64)
+        vv = rng.integers(-100, 100, nn, dtype=np.int64)
+        mm = (vv > 0).astype(np.uint8)
+        batches.append((nn, kk.tobytes(), vv.tobytes(), mm.tobytes()))
+
+    def stream():
+        t0 = _time.perf_counter()
+        total = 0
+        for nn, kb, vb, mb in batches:
+            t1 = rb.table_op_wire(
+                op_filter, [i64, i64, b8], [0, 0, 0], [kb, vb, mb],
+                [None, None, None], nn,
+            )
+            t2 = rb.table_op_wire(op_sort, t1[0], t1[1], t1[2], t1[3], t1[4])
+            t3 = rb.table_op_wire(op_group, t2[0], t2[1], t2[2], t2[3], t2[4])
+            total += t3[4]
+        return _time.perf_counter() - t0, total
+
+    try:
+        srt_config.set_flag("BUCKETS", "off")
+        exact_cold_s, exact_total = stream()
+        exact_warm_s, _ = stream()
+        srt_config.set_flag("BUCKETS", "")
+        buckets_mod.cache_clear()
+        srt_metrics.reset()  # the entry's metrics block = the ON arm
+        on_cold_s, on_total = stream()
+        on_warm_s, _ = stream()
+    finally:
+        srt_config.clear_flag("BUCKETS")
+    assert exact_total == on_total, "bucketed stream changed results"
+    snap = _metrics_snapshot() or {}
+    ctr = snap.get("counters", {})
+    hits = int(ctr.get("compile_cache.hit", 0))
+    misses = int(ctr.get("compile_cache.miss", 0))
+    rows = sum(s[0] for s in batches)
+    return {
+        "config": "dispatch",
+        "name": f"bucketed_dispatch_stream_{n_batches}x3op",
+        "rows": rows,
+        "distinct_batch_sizes": len(set(sizes)),
+        "exact_cold_seconds": round(exact_cold_s, 4),
+        "exact_warm_seconds": round(exact_warm_s, 4),
+        "bucketed_cold_seconds": round(on_cold_s, 4),
+        "bucketed_warm_seconds": round(on_warm_s, 4),
+        "cold_speedup": round(exact_cold_s / on_cold_s, 2),
+        "compile_cache_hits": hits,
+        "compile_cache_misses": misses,
+        "pad_waste_bytes": int(
+            snap.get("bytes", {}).get("bucket.pad_waste_bytes", 0)
+        ),
+        "platform": platform,
+    }
+
+
 def bench_resident_chain(platform, n=4_000_000):
     """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
     through device-RESIDENT table handles vs the bytes-wire path that
@@ -1162,6 +1269,7 @@ _SUBPROCESS_CONFIGS = {
     "chunk_sort_ab": bench_chunk_sort_ab,
     "strings": bench_strings,
     "resident": bench_resident_chain,
+    "bucketed_stream": bench_bucketed_stream,
     "parquet": bench_parquet_pipeline,
     "parquet_device": bench_parquet_device,
     "tpcds": bench_tpcds,
@@ -1181,8 +1289,8 @@ _LADDER = (
     # the Pallas engines (slow Mosaic compiles) right after
     "groupby16m_flat_gather", "groupby16m_flat_sort", "groupby16m_gather",
     "groupby16m_packed_pallas32", "chunk_sort_ab",
-    "strings", "transpose", "transpose_pallas", "resident", "parquet",
-    "parquet_device",
+    "strings", "transpose", "transpose_pallas", "resident",
+    "bucketed_stream", "parquet", "parquet_device",
     # 100M tier: likely winners first
     "groupby100m_flat_gather", "groupby100m_gather", "groupby100m",
     "groupby100m_packed_pallas32", "groupby100m_packed",
@@ -1442,6 +1550,32 @@ def _published_headline():
     return None
 
 
+# last headline line printed: the SIGTERM handler re-prints it so the
+# FINAL stdout line is parseable JSON even when the driver's timeout
+# fires mid-config (rounds ended rc=124, parsed=null twice because the
+# kill landed between a progress line and the next emit)
+_LAST_LINE = None
+
+
+def _install_exit_handlers():
+    """`timeout -k` sends SIGTERM before SIGKILL: use the grace window
+    to re-print the last headline JSON as the final stdout line."""
+    import signal
+
+    def _on_term(signum, frame):  # pragma: no cover - signal path
+        if _LAST_LINE:
+            # leading newline: the kill may land mid-write of a large
+            # emit, and appending to a torn partial line would make the
+            # final line unparseable
+            print("\n" + _LAST_LINE, flush=True)
+        os._exit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # pragma: no cover - non-main thread
+        pass
+
+
 def _emit(entries, platform, arrow_rows_per_s=None):
     """Print the ONE headline JSON line, complete with everything
     measured so far, and flush. Called once up front and again after
@@ -1485,40 +1619,49 @@ def _emit(entries, platform, arrow_rows_per_s=None):
         # which strict parsers (jq, JSON.parse) reject
         return round(x, nd) if x == x else None
 
-    print(
-        json.dumps(
-            {
-                "metric": "groupby_sum_100M_int64",
-                "value": _num(rows_per_s, 1),
-                "unit": "rows/s",
-                "vs_baseline": _num(vs, 3),
-                "platform": platform,
-                "headline_source": source,
-                "configs": entries,
-                "note": (
-                    "Line re-printed after every config (take the LAST "
-                    "parseable line): a timeout kill mid-ladder must not "
-                    "blank already-measured work. headline_source="
-                    "published_round{N} means no 100M groupby landed "
-                    "this run and value/vs_baseline echo BASELINE.json's "
-                    "published numbers. All device timings sync by host "
-                    "fetch (block_until_ready returns early on the "
-                    "tunneled platform); vs_baseline is CPU Arrow on "
-                    "the same 100M shape; configs[] carries the ladder "
-                    "with achieved GB/s vs HBM peak."
-                ),
-            }
-        ),
-        flush=True,
+    global _LAST_LINE
+    _LAST_LINE = json.dumps(
+        {
+            "metric": "groupby_sum_100M_int64",
+            "value": _num(rows_per_s, 1),
+            "unit": "rows/s",
+            "vs_baseline": _num(vs, 3),
+            "platform": platform,
+            "headline_source": source,
+            "configs": entries,
+            "note": (
+                "Line re-printed after every config (take the LAST "
+                "parseable line): a timeout kill mid-ladder must not "
+                "blank already-measured work. headline_source="
+                "published_round{N} means no 100M groupby landed "
+                "this run and value/vs_baseline echo BASELINE.json's "
+                "published numbers. All device timings sync by host "
+                "fetch (block_until_ready returns early on the "
+                "tunneled platform); vs_baseline is CPU Arrow on "
+                "the same 100M shape; configs[] carries the ladder "
+                "with achieved GB/s vs HBM peak."
+            ),
+        }
     )
+    print(_LAST_LINE, flush=True)
 
 
 def main():
-    deadline = time.time() + float(
-        os.environ.get("SRT_BENCH_DEADLINE_S", 3300)
+    # wall-clock budget (SRT_BENCH_BUDGET_S, default below the driver's
+    # kill timeout; SRT_BENCH_DEADLINE_S kept as the legacy alias):
+    # when exceeded, remaining configs are SKIPPED with structured
+    # records and the headline line is still the last thing printed
+    budget_s = float(
+        os.environ.get(
+            "SRT_BENCH_BUDGET_S",
+            os.environ.get("SRT_BENCH_DEADLINE_S", 3300),
+        )
     )
+    t_start = time.time()
+    deadline = t_start + budget_s
     entries = []
     platform = "unreachable"
+    _install_exit_handlers()  # SIGTERM re-prints the headline JSON
     _metrics_enable()  # every measured entry carries a "metrics" block
 
     # Stop the daemon BEFORE reading state: a merge landing between the
@@ -1546,9 +1689,22 @@ def main():
         alive = _probe_device()
     probe_elapsed = time.time() - t_probe
     if alive:
-        for key in _LADDER:
+        for i, key in enumerate(_LADDER):
             if time.time() > deadline:
-                _progress("bench deadline reached; stopping ladder")
+                # budget exhausted: skip the rest with structured
+                # records instead of letting each one eat its own
+                # timeout past the driver's kill deadline
+                _progress(
+                    f"bench budget ({budget_s:.0f}s) exhausted; "
+                    f"skipping {len(_LADDER) - i} remaining configs"
+                )
+                for later in _LADDER[i:]:
+                    if not _state_results(later):
+                        entries.append(_failure_record(
+                            later, f"skipped: budget {budget_s:.0f}s "
+                            "exhausted", exc_type="BudgetExceeded",
+                            elapsed_s=time.time() - t_start, skipped=True,
+                        ))
                 break
             # drop the daemon-captured entries for this CONFIG KEY (by
             # the state file's own names — a rename of the workload
@@ -1573,6 +1729,31 @@ def main():
                 platform = got[0].get("platform", platform)
             elif not _state_results(key):
                 entries.extend(fresh)  # the error entry
+                # fast-fail ladder: an unreachable-smelling failure +
+                # a failed re-probe means the tunnel is down — mark
+                # every remaining device config skipped-unreachable
+                # instead of timing each one out serially
+                if (
+                    fresh
+                    and _unreachable_failure(fresh[-1])
+                    and not _probe_device()
+                ):
+                    _progress(
+                        "device lost mid-ladder; fast-failing "
+                        f"{len(_LADDER) - i - 1} remaining configs"
+                    )
+                    for later in _LADDER[i + 1:]:
+                        if not _state_results(later):
+                            entries.append(_failure_record(
+                                later,
+                                "skipped: device unreachable "
+                                f"(fast-fail after {key})",
+                                exc_type="DeviceUnreachable",
+                                elapsed_s=time.time() - t_start,
+                                skipped=True,
+                            ))
+                    _emit(entries, platform)
+                    break
             _emit(entries, platform)
     else:
         for key in _LADDER:
@@ -1581,6 +1762,7 @@ def main():
                     key, "device unreachable",
                     exc_type="DeviceUnreachable",
                     elapsed_s=probe_elapsed, retries=probe_retries,
+                    skipped=True,
                 ))
         _emit(entries, platform)
 
@@ -1616,4 +1798,14 @@ if __name__ == "__main__":
         every = float(sys.argv[3]) if len(sys.argv) >= 4 else 300.0
         daemon(dl, every)
     else:
-        main()
+        try:
+            main()
+        except Exception:
+            # exit-clean guarantee: tracebacks go to stderr and the
+            # FINAL stdout line stays the last headline JSON
+            import traceback
+
+            traceback.print_exc()
+            if _LAST_LINE:
+                print(_LAST_LINE, flush=True)
+            sys.exit(1)

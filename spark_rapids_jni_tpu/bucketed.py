@@ -1,0 +1,431 @@
+"""Bucketed op runners: the dispatch plane's pad-to-bucket fast path.
+
+``runtime_bridge._dispatch`` routes every bucketable op through
+:func:`dispatch_bucketed` before falling back to the exact-shape
+``_dispatch_impl``. A runner:
+
+1. pads its input tables to their row-count buckets
+   (``utils/buckets.pad_table``; wire uploads arrive pre-padded on the
+   host side, so this is usually a no-op),
+2. fetches the op's compiled executable from the
+   ``(op, schema signature, bucket)`` cache (``utils/buckets.cached_jit``)
+   — a ragged stream of N batch sizes costs O(#buckets) compiles
+   instead of O(N),
+3. runs the op at the BUCKET shape with the logical row count passed as
+   a device scalar; padded rows are dead via validity-aware tail
+   masking: the ``row_valid`` occupancy machinery the capped two-phase
+   ops already grew for shuffle padding (ops/groupby.py
+   ``groupby_aggregate_capped(row_valid=...)``, ops/join.py
+   ``left_valid``/``right_valid``, ops/sort.py ``row_valid``,
+   ops/compaction.py ``_first_of_run_mask(row_valid=...)``),
+4. returns a PADDED result carrying ``Table.logical_rows`` — the wire
+   boundary slices host-side (zero extra compiles) and a downstream
+   bucketed op consumes the padding directly.
+
+Semantics contract: for the first ``logical_rows`` rows the result is
+bit-identical to the exact path (``tests/test_buckets.py`` pins this at
+bucket-boundary row counts). Any runner failure falls back to the exact
+path, which remains the semantic reference — bucketing can change
+performance, never results.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import dtype as dt
+from .column import Column, Table
+from .utils import buckets, log, metrics
+
+
+class _Decline(Exception):
+    """Internal: this op/shape opts out of bucketing (exact path runs)."""
+
+
+_WARNED_OPS = set()
+
+
+def dispatch_bucketed(
+    op: dict, table: Table, rest: Sequence[Table], name: str
+) -> Optional[Table]:
+    """Run one op through the bucket plane. Returns the (possibly
+    padded) result Table, or None when the op/shape isn't bucketable —
+    the caller then unpads the inputs and runs the exact path."""
+    runner = _RUNNERS.get(name)
+    if runner is None:
+        return None
+    try:
+        out = runner(op, table, tuple(rest))
+    except _Decline:
+        return None
+    except Exception as e:
+        # bucketing must never change semantics: any runner failure
+        # falls back to the exact path, which raises the real error if
+        # the op itself is at fault
+        metrics.counter_add("bucket.fallback_errors")
+        if name not in _WARNED_OPS:
+            _WARNED_OPS.add(name)
+            log.log(
+                "WARN", "buckets", "bucketed_runner_failed", op=name,
+                error=f"{type(e).__name__}: {str(e)[:200]}",
+            )
+        return None
+    metrics.counter_add("bucket.dispatched")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared plumbing
+# ---------------------------------------------------------------------------
+
+
+def _padded_input(t: Table) -> Table:
+    """The bucketed view of an input table: pre-padded tables pass
+    through (their physical size keys the cache), exact tables pad to
+    their bucket; shapes with no bucket decline."""
+    n = t.logical_row_count
+    if n <= 0:
+        raise _Decline
+    if t.logical_rows is not None:
+        return t
+    b = buckets.bucket_for(n)
+    if b is None:
+        raise _Decline
+    return buckets.pad_table(t, b)
+
+
+def _strip(t: Table) -> Table:
+    """Drop the logical-row metadata before a jit call: the count
+    travels as a device scalar instead, so every logical size within a
+    bucket shares ONE traced program (pytree aux must not vary)."""
+    return Table(t.columns, t.names)
+
+
+def _n_dev(t: Table):
+    return jnp.asarray(t.logical_row_count, jnp.int32)
+
+
+def _finish(padded_out: Table, logical) -> Table:
+    return Table(
+        padded_out.columns, padded_out.names, logical_rows=int(logical)
+    )
+
+
+def _key(kind: str, op: dict, *tables: Table, extra: tuple = ()) -> tuple:
+    return (
+        kind,
+        json.dumps(op, sort_keys=True),
+        tuple(buckets.table_signature(t) for t in tables),
+        tuple(t.row_count for t in tables),
+        extra,
+    )
+
+
+# ---------------------------------------------------------------------------
+# runners
+# ---------------------------------------------------------------------------
+
+
+def _r_cast(op: dict, table: Table, rest) -> Table:
+    pt = _padded_input(table)
+    ci = int(op["column"])
+    target = dt.DType(dt.TypeId(op["type_id"]), op.get("scale", 0))
+
+    def build():
+        def fn(t):
+            src = t.columns[ci]
+            if src.dtype.is_string or target.is_string:
+                from .ops import strings as strings_mod
+
+                out = strings_mod.cast(src, target)
+            else:
+                from .ops.cast import cast as cast_fn
+
+                out = cast_fn(src, target)
+            cols = list(t.columns)
+            cols[ci] = out
+            return Table(cols, t.names)
+
+        return fn
+
+    fn = buckets.cached_jit(_key("cast", op, pt), build, "srt_bucketed_cast")
+    return _finish(fn(_strip(pt)), pt.logical_row_count)
+
+
+def _r_filter(op: dict, table: Table, rest) -> Table:
+    pt = _padded_input(table)
+    mi = int(op["mask"])
+
+    def build():
+        def fn(t, n):
+            from .ops.filter import filter_table_capped
+
+            mask = t.columns[mi]
+            rv = buckets.tail_valid(t.row_count, n)
+            # padding tails of RE-padded tables can hold arbitrary
+            # garbage (e.g. a prior capped filter clones kept rows), so
+            # the occupancy mask must gate the selection explicitly
+            keep = Column(
+                jnp.logical_and(mask.data, rv), mask.dtype, mask.validity
+            )
+            kept = Table(
+                [c for i, c in enumerate(t.columns) if i != mi]
+            )  # names dropped exactly like the exact-path dispatch
+            return filter_table_capped(kept, keep, capacity=t.row_count)
+
+        return fn
+
+    fn = buckets.cached_jit(
+        _key("filter", op, pt), build, "srt_bucketed_filter"
+    )
+    out, count = fn(_strip(pt), _n_dev(pt))
+    return _finish(out, int(count))
+
+
+def _r_sort(op: dict, table: Table, rest) -> Table:
+    pt = _padded_input(table)
+
+    def build():
+        def fn(t, n):
+            from .ops.sort import SortKey, sort_table
+
+            ks = [
+                SortKey(k["column"], ascending=k.get("ascending", True))
+                for k in op["keys"]
+            ]
+            rv = buckets.tail_valid(t.row_count, n)
+            return sort_table(t, ks, row_valid=rv)
+
+        return fn
+
+    fn = buckets.cached_jit(
+        _key("sort_by", op, pt), build, "srt_bucketed_sort"
+    )
+    return _finish(fn(_strip(pt), _n_dev(pt)), pt.logical_row_count)
+
+
+def _r_groupby(op: dict, table: Table, rest) -> Table:
+    from .ops.groupby import (
+        _COLLECT_OPS,
+        GroupbyAgg,
+        groupby_aggregate_capped,
+    )
+
+    aggs = [GroupbyAgg(a["column"], a["agg"]) for a in op["aggs"]]
+    if any(a.op in _COLLECT_OPS for a in aggs):
+        # collect_* needs a data-dependent list capacity pre-pass —
+        # exact path owns that sizing
+        raise _Decline
+    pt = _padded_input(table)
+    by = list(op["by"])
+
+    def build():
+        def fn(t, n):
+            rv = buckets.tail_valid(t.row_count, n)
+            return groupby_aggregate_capped(
+                t, by, aggs, num_segments=t.row_count, row_valid=rv
+            )
+
+        return fn
+
+    fn = buckets.cached_jit(
+        _key("groupby", op, pt), build, "srt_bucketed_groupby"
+    )
+    out, num_groups = fn(_strip(pt), _n_dev(pt))
+    return _finish(out, int(num_groups))
+
+
+def _r_distinct(op: dict, table: Table, rest) -> Table:
+    pt = _padded_input(table)
+    keyspec = op.get("keys")
+
+    def build():
+        def fn(t, n):
+            from .ops.compaction import distinct_capped
+
+            rv = buckets.tail_valid(t.row_count, n)
+            return distinct_capped(
+                t, keyspec, capacity=t.row_count, row_valid=rv
+            )
+
+        return fn
+
+    fn = buckets.cached_jit(
+        _key("distinct", op, pt), build, "srt_bucketed_distinct"
+    )
+    out, count = fn(_strip(pt), _n_dev(pt))
+    return _finish(out, int(count))
+
+
+def _r_rlike(op: dict, table: Table, rest) -> Table:
+    pt = _padded_input(table)
+    ci = int(op["column"])
+    pattern = op["pattern"]
+
+    def build():
+        def fn(t, n):
+            from .ops import regex as regex_mod
+            from .ops.filter import filter_table_capped
+
+            rv = buckets.tail_valid(t.row_count, n)
+            mask = regex_mod.contains_re(t.columns[ci], pattern)
+            # padding rows are zero-length strings: a pattern matching
+            # the empty string would select them without the gate
+            keep = Column(
+                jnp.logical_and(mask.data, rv), mask.dtype, mask.validity
+            )
+            return filter_table_capped(t, keep, capacity=t.row_count)
+
+        return fn
+
+    fn = buckets.cached_jit(
+        _key("rlike", op, pt), build, "srt_bucketed_rlike"
+    )
+    out, count = fn(_strip(pt), _n_dev(pt))
+    return _finish(out, int(count))
+
+
+_BUCKETED_JOIN_HOWS = frozenset({"inner", "left", "semi", "anti"})
+
+
+def _r_join(op: dict, table: Table, rest) -> Table:
+    how = op.get("how", "inner")
+    if how not in _BUCKETED_JOIN_HOWS or not rest:
+        # right/full build on the exact outer machinery; argument
+        # errors surface from the exact path
+        raise _Decline
+    lt = _padded_input(table)
+    rt = _padded_input(rest[0])
+    on = list(op["on"])
+
+    if how in ("semi", "anti"):
+        anti = how == "anti"
+
+        def build_sa():
+            def fn(l, r, ln, rn):
+                from .ops.filter import filter_table_capped
+                from .ops.join import _match_ranges
+
+                lv = buckets.tail_valid(l.row_count, ln)
+                rv = buckets.tail_valid(r.row_count, rn)
+                _, _, counts, lvalid = _match_ranges(l, r, on, on, lv, rv)
+                has = jnp.logical_and(counts > 0, lvalid)
+                if anti:
+                    # null-key rows match nothing -> kept by ANTI;
+                    # padding rows (lv False) emit nothing
+                    keep = jnp.logical_and(jnp.logical_not(has), lv)
+                else:
+                    keep = has
+                return filter_table_capped(
+                    l, Column(keep, dt.BOOL8, None), capacity=l.row_count
+                )
+
+            return fn
+
+        fn = buckets.cached_jit(
+            _key("join." + how, op, lt, rt), build_sa,
+            "srt_bucketed_join_" + how,
+        )
+        out, count = fn(_strip(lt), _strip(rt), _n_dev(lt), _n_dev(rt))
+        return _finish(out, int(count))
+
+    # inner/left: two-phase sizing. Phase 1 (probe) compiles per input
+    # bucket pair; phase 2 (materialize) per OUTPUT capacity bucket —
+    # the output size is bucketed too, so both phases cost O(#buckets)
+    # executables across a ragged stream.
+    def build_probe():
+        def fn(l, r, ln, rn):
+            from .ops.join import _left_emit, _match_ranges
+
+            lv = buckets.tail_valid(l.row_count, ln)
+            rv = buckets.tail_valid(r.row_count, rn)
+            perm_r, lo, counts, _ = _match_ranges(l, r, on, on, lv, rv)
+            return (
+                perm_r, lo, counts,
+                jnp.sum(counts),
+                jnp.sum(_left_emit(counts, lv)),
+            )
+
+        return fn
+
+    p1 = buckets.cached_jit(
+        _key("join.ranges", {"on": on}, lt, rt), build_probe,
+        "srt_bucketed_join_probe",
+    )
+    perm_r, lo, counts, inner_total, left_total = p1(
+        _strip(lt), _strip(rt), _n_dev(lt), _n_dev(rt)
+    )
+    total = int(left_total if how == "left" else inner_total)
+    cap = buckets.bucket_for(total)
+    if cap is None:
+        # no output bucket (empty result, or a fan-out past the ladder
+        # cap): materializing at the exact total would compile one
+        # executable per distinct size AND build the oversized fused
+        # graphs the cap exists to avoid — the exact path (with its
+        # fenced batched-probe routing) owns those shapes
+        raise _Decline
+    left_outer = how == "left"
+
+    def build_mat():
+        def fn(l, r, perm_r, lo, counts, ln):
+            from .ops.join import _expand, _join_output, _left_emit
+
+            if left_outer:
+                lv = buckets.tail_valid(l.row_count, ln)
+                emit = _left_emit(counts, lv)
+                left_idx, right_idx, matched, _ = _expand(
+                    perm_r, lo, counts, cap, left_outer=True, emit=emit
+                )
+                return _join_output(
+                    l, r, on, left_idx, right_idx, matched, None
+                )
+            left_idx, right_idx, _, _ = _expand(
+                perm_r, lo, counts, cap, left_outer=False
+            )
+            # no matched/row_valid masks, matching the exact-path
+            # inner_join output schema; rows past ``total`` are garbage
+            # behind the logical row count
+            return _join_output(l, r, on, left_idx, right_idx, None, None)
+
+        return fn
+
+    p2 = buckets.cached_jit(
+        _key("join.mat." + how, {"on": on}, lt, rt, extra=(cap,)),
+        build_mat, "srt_bucketed_join_mat",
+    )
+    out = p2(_strip(lt), _strip(rt), perm_r, lo, counts, _n_dev(lt))
+    return _finish(out, total)
+
+
+_RUNNERS = {
+    "cast": _r_cast,
+    "filter": _r_filter,
+    "sort_by": _r_sort,
+    "groupby": _r_groupby,
+    "distinct": _r_distinct,
+    "rlike": _r_rlike,
+    "join": _r_join,
+}
+
+
+def is_bucketable(op: dict) -> bool:
+    """Cheap pre-check: could this op take the bucketed path at all?
+    The wire layer uses it to skip host-side padding (and the extra
+    upload bytes it costs) for ops that would immediately unpad."""
+    name = op.get("op")
+    if name not in _RUNNERS:
+        return False
+    if name == "join":
+        return op.get("how", "inner") in _BUCKETED_JOIN_HOWS
+    if name == "groupby":
+        from .ops.groupby import _COLLECT_OPS
+
+        # collect_* groupbys decline in the runner (data-dependent
+        # list capacity) — don't pay the padded upload for them
+        return not any(
+            a.get("agg") in _COLLECT_OPS for a in op.get("aggs", ())
+        )
+    return True

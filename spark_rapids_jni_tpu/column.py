@@ -371,12 +371,21 @@ class Table:
     The analog of ``cudf::table`` / ``ai.rapids.cudf.Table``
     (reference: RowConversion.java:104 takes a Table; the JNI side views it
     as a ``cudf::table_view`` at RowConversionJni.cpp:31).
+
+    ``logical_rows`` supports the shape-bucket plane (utils/buckets.py):
+    a table padded to a row-count bucket keeps its buffers at the bucket
+    size (``row_count``) while carrying the number of REAL rows here.
+    None means exact (every row is real). Rows past ``logical_rows`` are
+    garbage; only the bucketed dispatch layer may consume padded tables
+    (it masks them with ``row_valid`` occupancy), everything else goes
+    through ``buckets.unpad_table`` first.
     """
 
     def __init__(
         self,
         columns: Sequence[Column],
         names: Optional[Sequence[str]] = None,
+        logical_rows: Optional[int] = None,
     ):
         columns = tuple(columns)
         if columns:
@@ -388,18 +397,27 @@ class Table:
             names = tuple(names)
             if len(names) != len(columns):
                 raise ValueError("names/columns length mismatch")
+        if logical_rows is not None:
+            logical_rows = int(logical_rows)
+            physical = columns[0].row_count if columns else 0
+            if not 0 <= logical_rows <= physical:
+                raise ValueError(
+                    f"logical_rows {logical_rows} out of range for "
+                    f"{physical} physical rows"
+                )
         self.columns = columns
         self.names = names
+        self.logical_rows = logical_rows
 
     # --- pytree protocol -------------------------------------------------
     def tree_flatten(self):
-        return self.columns, self.names
+        return self.columns, (self.names, self.logical_rows)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         obj = cls.__new__(cls)
         obj.columns = tuple(children)
-        obj.names = aux
+        obj.names, obj.logical_rows = aux
         return obj
 
     # --- accessors ---------------------------------------------------------
@@ -410,6 +428,17 @@ class Table:
     @property
     def row_count(self) -> int:
         return self.columns[0].row_count if self.columns else 0
+
+    @property
+    def logical_row_count(self) -> int:
+        """Real rows: ``logical_rows`` when padded, else ``row_count``."""
+        if self.logical_rows is not None:
+            return self.logical_rows
+        return self.row_count
+
+    @property
+    def is_padded(self) -> bool:
+        return self.logical_rows is not None
 
     def column(self, key: Union[int, str]) -> Column:
         if isinstance(key, str):
@@ -496,4 +525,9 @@ class Table:
         for i, c in enumerate(self.columns):
             name = self.names[i] if self.names else f"c{i}"
             parts.append(f"{name}: {c.dtype!r}[{c.row_count}]")
-        return f"Table({', '.join(parts)})"
+        pad = (
+            f", logical_rows={self.logical_rows}"
+            if self.logical_rows is not None
+            else ""
+        )
+        return f"Table({', '.join(parts)}{pad})"

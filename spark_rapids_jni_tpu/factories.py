@@ -220,6 +220,38 @@ def copy_if_else(lhs: Column, rhs: Column, mask: Column) -> Column:
 
 
 # ---------------------------------------------------------------------------
+# shape buckets (utils/buckets.py applied at the Python level)
+#
+# The dispatch plane (runtime_bridge._dispatch) buckets automatically;
+# these are the Python-level entry points for callers that drive the op
+# library directly and want the same compiled-shape reuse: pad once,
+# run the *_capped ops with `row_valid`, unpad at the end.
+# ---------------------------------------------------------------------------
+
+
+def pad_to_bucket(table: Table, bucket: Optional[int] = None) -> Table:
+    """Pad ``table`` to its row-count bucket (or an explicit ``bucket``),
+    carrying the logical row count on the result (``Table.logical_rows``).
+    Returns the input unchanged when bucketing is disabled
+    (``SPARK_RAPIDS_TPU_BUCKETS=off``) or the size has no bucket."""
+    from .utils import buckets
+
+    if bucket is None:
+        bucket = buckets.bucket_for(table.logical_row_count)
+        if bucket is None:
+            return table
+    return buckets.pad_table(table, bucket)
+
+
+def unpad_table(table: Table) -> Table:
+    """Exact-shape view of a possibly bucket-padded table (inverse of
+    :func:`pad_to_bucket`; identity for exact tables)."""
+    from .utils import buckets
+
+    return buckets.unpad_table(table)
+
+
+# ---------------------------------------------------------------------------
 # validity bitmask packing (Arrow wire form <-> device bool vectors)
 # ---------------------------------------------------------------------------
 

@@ -22,9 +22,17 @@ from .filter import filter_table, filter_table_capped
 from .keys import column_order_keys
 
 
-def _first_of_run_mask(table: Table, keys: Optional[Sequence]) -> Column:
+def _first_of_run_mask(
+    table: Table,
+    keys: Optional[Sequence],
+    row_valid: Optional[jax.Array] = None,
+) -> Column:
     """BOOL8 mask keeping the first occurrence of each distinct key row
-    (order-preserving: the kept row is the earliest original row)."""
+    (order-preserving: the kept row is the earliest original row).
+
+    ``row_valid`` (shape-bucket occupancy, utils/buckets.py) excludes
+    padding rows entirely: they join no real row's run (an extra
+    occupancy word splits them off) and the mask is False for them."""
     cols = (
         [table.column(k) for k in keys] if keys is not None else list(table.columns)
     )
@@ -39,6 +47,8 @@ def _first_of_run_mask(table: Table, keys: Optional[Sequence]) -> Column:
             cwords = [jnp.where(c.validity, w, jnp.uint64(0)) for w in cwords]
             cwords.append(c.validity.astype(jnp.uint64))
         words.extend(cwords)
+    if row_valid is not None:
+        words.append(row_valid.astype(jnp.uint64))
     n = table.row_count
     perm = jnp.lexsort(tuple(reversed([*words, jnp.arange(n, dtype=jnp.uint64)])))
     sorted_words = [w[perm] for w in words]
@@ -51,6 +61,8 @@ def _first_of_run_mask(table: Table, keys: Optional[Sequence]) -> Column:
     # makes the head the smallest original index
     keep_sorted = neq_prev
     keep = jnp.zeros((n,), dtype=jnp.bool_).at[perm].set(keep_sorted)
+    if row_valid is not None:
+        keep = jnp.logical_and(keep, row_valid)
     return Column(keep, dt.BOOL8, None)
 
 
@@ -61,11 +73,17 @@ def distinct(table: Table, keys: Optional[Sequence] = None) -> Table:
 
 
 def distinct_capped(
-    table: Table, keys: Optional[Sequence] = None, capacity: Optional[int] = None
+    table: Table,
+    keys: Optional[Sequence] = None,
+    capacity: Optional[int] = None,
+    row_valid: Optional[jax.Array] = None,
 ) -> tuple[Table, jax.Array]:
-    """Jittable distinct: padded result + device count."""
+    """Jittable distinct: padded result + device count. ``row_valid``
+    excludes rows entirely (shape-bucket padding occupancy)."""
     cap = capacity if capacity is not None else table.row_count
-    return filter_table_capped(table, _first_of_run_mask(table, keys), cap)
+    return filter_table_capped(
+        table, _first_of_run_mask(table, keys, row_valid), cap
+    )
 
 
 def distinct_count(

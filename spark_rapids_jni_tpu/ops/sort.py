@@ -76,11 +76,24 @@ def _table_key_words(
     return words
 
 
+def _occupancy_word(row_valid: jax.Array) -> jax.Array:
+    """Leading sort word that sinks unoccupied rows (shape-bucket
+    padding, utils/buckets.py) to the END regardless of key direction
+    or null placement: real rows get 0, padding rows 1."""
+    return jnp.where(row_valid, jnp.uint64(0), jnp.uint64(1))
+
+
 def argsort_table(
-    table: Table, sort_keys: Sequence[Union[SortKey, str, int]]
+    table: Table,
+    sort_keys: Sequence[Union[SortKey, str, int]],
+    row_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Stable row permutation ordering ``table`` by ``sort_keys``."""
+    """Stable row permutation ordering ``table`` by ``sort_keys``.
+    ``row_valid`` rows sort first (in key order); padding rows sink to
+    the end."""
     words = _table_key_words(table, sort_keys)
+    if row_valid is not None:
+        words = [_occupancy_word(row_valid)] + words
     # lexsort: last key is primary -> reverse
     return jnp.lexsort(words[::-1])
 
@@ -89,6 +102,7 @@ def sort_table(
     table: Table,
     sort_keys: Sequence[Union[SortKey, str, int]],
     payload: Optional[Table] = None,
+    row_valid: Optional[jax.Array] = None,
 ) -> Table:
     """ORDER BY: returns the table (or ``payload``) reordered.
 
@@ -98,8 +112,14 @@ def sort_table(
     the gather formulation ran a 100M-row 2-column sort at 5.7s; random
     gathers dominate). Matrix-shaped buffers (strings, DECIMAL128,
     LIST), whose shape can't join the variadic sort, gather through the
-    permutation that rides along as an iota operand."""
+    permutation that rides along as an iota operand.
+
+    ``row_valid`` (shape-bucket occupancy) adds one leading key word so
+    padding rows land AFTER every real row; real rows keep the exact
+    order of the unpadded sort (stability included)."""
     words = _table_key_words(table, sort_keys)
+    if row_valid is not None:
+        words = [_occupancy_word(row_valid)] + words
     target = payload if payload is not None else table
     n = target.row_count
     iota = jnp.arange(n, dtype=jnp.int32)

@@ -38,7 +38,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 
 from . import dtype as dt
 from .column import Column, Table
-from .utils import log, metrics
+from .utils import buckets, log, metrics
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -93,9 +93,21 @@ def _wire_validity(valid: Optional[bytes], num_rows: int):
     return np.frombuffer(valid, np.uint8, num_rows).astype(np.bool_)
 
 
+def _pad_host(arr: np.ndarray, total: Optional[int]) -> np.ndarray:
+    """Zero-pad a host buffer's row dimension to ``total`` rows BEFORE
+    upload — padding to the shape bucket on the host side costs no XLA
+    compile and makes every upload within a bucket the same shape."""
+    if total is None or arr.shape[0] == total:
+        return arr
+    out = np.zeros((total,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
 def _column_from_wire(
     type_id: int, scale: int, data: Optional[bytes],
     valid: Optional[bytes], num_rows: int,
+    pad_to: Optional[int] = None,
 ) -> Column:
     if metrics.enabled():
         metrics.bytes_add(
@@ -114,6 +126,9 @@ def _column_from_wire(
             data, num_rows, np.dtype(child.storage_dtype), "LIST"
         )
         v = _wire_validity(valid, num_rows)
+        mat = _pad_host(mat, pad_to)
+        lens = _pad_host(lens, pad_to)
+        v = None if v is None else _pad_host(v, pad_to)
         dev = jnp.asarray(mat)
         if dev.dtype != mat.dtype:
             # x64 disabled: a silent int64->int32 downgrade would corrupt
@@ -135,6 +150,9 @@ def _column_from_wire(
             data, num_rows, np.dtype(np.uint8), "STRING"
         )
         v = _wire_validity(valid, num_rows)
+        mat = _pad_host(mat, pad_to)
+        lens = _pad_host(lens, pad_to)
+        v = None if v is None else _pad_host(v, pad_to)
         return Column(
             jnp.asarray(mat), dt.STRING,
             None if v is None else jnp.asarray(v), jnp.asarray(lens),
@@ -154,16 +172,22 @@ def _column_from_wire(
             np.bool_
         )
     )
+    arr = _pad_host(arr, pad_to)
+    v = None if v is None else _pad_host(v, pad_to)
     return Column.from_numpy(arr, validity=v, dtype=d)
 
 
-def _column_to_wire(c: Column):
+def _column_to_wire(c: Column, rows: Optional[int] = None):
     """(type_id, scale, data bytes, valid bytes | None).
 
     LIST columns use the convention documented in _column_from_wire:
     scale = child type id, data = int32 offsets then child values.
+
+    ``rows`` slices a shape-bucket-padded column back to its logical
+    row count on the HOST side (after the device fetch) — the padding
+    never reaches the wire and the slice costs no XLA compile.
     """
-    out = _column_to_wire_impl(c)
+    out = _column_to_wire_impl(c, rows)
     if metrics.enabled():
         metrics.bytes_add(
             "wire.bytes_out",
@@ -173,18 +197,24 @@ def _column_to_wire(c: Column):
     return out
 
 
-def _column_to_wire_impl(c: Column):
+def _host_rows(arr: np.ndarray, rows: Optional[int]) -> np.ndarray:
+    return arr if rows is None else arr[:rows]
+
+
+def _column_to_wire_impl(c: Column, rows: Optional[int] = None):
     if c.dtype.id == dt.TypeId.STRING:
         valid = (
             None
             if c.validity is None
-            else np.asarray(c.validity).astype(np.uint8).tobytes()
+            else _host_rows(np.asarray(c.validity), rows)
+            .astype(np.uint8).tobytes()
         )
         return (
             int(dt.TypeId.STRING),
             0,
             _padded_to_offsets(
-                np.asarray(c.data), np.asarray(c.lengths).astype(np.int32)
+                _host_rows(np.asarray(c.data), rows),
+                _host_rows(np.asarray(c.lengths), rows).astype(np.int32),
             ),
             valid,
         )
@@ -193,21 +223,24 @@ def _column_to_wire_impl(c: Column):
         valid = (
             None
             if c.validity is None
-            else np.asarray(c.validity).astype(np.uint8).tobytes()
+            else _host_rows(np.asarray(c.validity), rows)
+            .astype(np.uint8).tobytes()
         )
         return (
             int(dt.TypeId.LIST),
             int(child.id),
             _padded_to_offsets(
-                np.asarray(c.data), np.asarray(c.lengths).astype(np.int32)
+                _host_rows(np.asarray(c.data), rows),
+                _host_rows(np.asarray(c.lengths), rows).astype(np.int32),
             ),
             valid,
         )
-    host = np.ascontiguousarray(np.asarray(c.data))
+    host = np.ascontiguousarray(_host_rows(np.asarray(c.data), rows))
     valid = (
         None
         if c.validity is None
-        else np.asarray(c.validity).astype(np.uint8).tobytes()
+        else _host_rows(np.asarray(c.validity), rows)
+        .astype(np.uint8).tobytes()
     )
     return (
         int(c.dtype.id.value),
@@ -224,21 +257,43 @@ def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
     (``join`` takes the probe side as ``table`` and the build side as
     ``rest[0]``; ``concat`` appends every table in ``rest``).
 
+    With shape bucketing on (the default; ``SPARK_RAPIDS_TPU_BUCKETS``),
+    bucketable ops run through ``bucketed.dispatch_bucketed``: inputs
+    padded to row-count buckets, one compiled executable per
+    ``(op, schema, bucket)`` from the central cache, results padded with
+    ``Table.logical_rows`` carrying the real count. Non-bucketable ops
+    (and the ``=off`` debug mode) take the exact-shape path — padded
+    inputs are unpadded first so exact ops never see garbage tails.
+
     Every op runs inside a ``metrics.span`` and feeds the per-op
     call/row counters — the ``GpuMetric`` plane of the dispatch layer.
     The disabled path costs one string concat and the span's cheap
-    gate checks.
+    gate checks. Row counters count LOGICAL rows (padding is an
+    implementation detail; its cost shows up in ``bucket.*`` instead).
     """
     name = op["op"]
     with metrics.span("dispatch." + name):
-        out = _dispatch_impl(op, table, rest, name)
+        out = None
+        if buckets.enabled():
+            from . import bucketed
+
+            out = bucketed.dispatch_bucketed(op, table, rest, name)
+        if out is None:
+            out = _dispatch_impl(
+                op,
+                buckets.unpad_table(table),
+                [buckets.unpad_table(t) for t in rest],
+                name,
+            )
     if metrics.enabled():
-        rows_in = int(table.row_count) + sum(
-            int(t.row_count) for t in rest
+        rows_in = int(table.logical_row_count) + sum(
+            int(t.logical_row_count) for t in rest
         )
         metrics.counter_add("op." + name + ".calls")
         metrics.counter_add("op." + name + ".rows_in", rows_in)
-        metrics.counter_add("op." + name + ".rows_out", int(out.row_count))
+        metrics.counter_add(
+            "op." + name + ".rows_out", int(out.logical_row_count)
+        )
         metrics.hist_observe("dispatch.rows_in", rows_in)
     return out
 
@@ -367,21 +422,33 @@ def table_op_wire(
     Returns (out_type_ids, out_scales, out_datas, out_valids, out_rows).
     """
     op = json.loads(op_json)
+    pad_to = None
+    if buckets.enabled():
+        from . import bucketed
+
+        # pad only when the op can actually take the bucketed path —
+        # a non-bucketable op would pay the padded upload AND a device
+        # unpad slice for nothing
+        if bucketed.is_bucketable(op):
+            pad_to = buckets.bucket_for(num_rows)
     with metrics.span("wire.deserialize"):
         cols = [
-            _column_from_wire(t, s, d, v, num_rows)
+            _column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
             for t, s, d, v in zip(type_ids, scales, datas, valids)
         ]
-    result = _dispatch(op, Table(cols))
+    tbl = Table(cols, logical_rows=num_rows if pad_to is not None else None)
+    if pad_to is not None:
+        buckets.note_padded(tbl)
+    result = _dispatch(op, tbl)
     out_t, out_s, out_d, out_v = [], [], [], []
     with metrics.span("wire.serialize"):
         for c in result.columns:
-            t, s, d, v = _column_to_wire(c)
+            t, s, d, v = _column_to_wire(c, result.logical_rows)
             out_t.append(t)
             out_s.append(s)
             out_d.append(d)
             out_v.append(v)
-    return out_t, out_s, out_d, out_v, int(result.row_count)
+    return out_t, out_s, out_d, out_v, int(result.logical_row_count)
 
 
 def platform() -> str:
@@ -430,7 +497,7 @@ def _resident_put(t: Table) -> int:
         _RESIDENT[tid] = t
         live = len(_RESIDENT)
     log.log("DEBUG", "handles", "resident_put", table_id=tid,
-            rows=int(t.row_count), live=live)
+            rows=int(t.logical_row_count), live=live)
     # resident.live's high-water mark is the leak-report analog: a chain
     # that frees what it allocates returns to the pre-chain value while
     # high_water records the peak resident set
@@ -446,13 +513,21 @@ def table_upload_wire(
     valids: Sequence[Optional[bytes]],
     num_rows: int,
 ) -> int:
-    """Host bytes -> device-resident table; returns its id."""
+    """Host bytes -> device-resident table; returns its id. With shape
+    bucketing on, the resident buffers are padded to the row-count
+    bucket (host-side, before upload) and the table carries its logical
+    row count — a chain of bucketed ops then reuses one compiled
+    executable per bucket with no repadding."""
+    pad_to = buckets.bucket_for(num_rows) if buckets.enabled() else None
     with metrics.span("wire.deserialize"):
         cols = [
-            _column_from_wire(t, s, d, v, num_rows)
+            _column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
             for t, s, d, v in zip(type_ids, scales, datas, valids)
         ]
-    return _resident_put(Table(cols))
+    tbl = Table(cols, logical_rows=num_rows if pad_to is not None else None)
+    if pad_to is not None:
+        buckets.note_padded(tbl)
+    return _resident_put(tbl)
 
 
 def table_op_resident(op_json: str, table_ids: Sequence[int]) -> int:
@@ -470,21 +545,22 @@ def table_op_resident(op_json: str, table_ids: Sequence[int]) -> int:
 
 
 def table_download_wire(table_id: int):
-    """Resident table -> the wire 5-tuple of table_op_wire."""
+    """Resident table -> the wire 5-tuple of table_op_wire (shape-bucket
+    padding sliced away host-side; the wire never sees it)."""
     t = _resident_get(table_id)
     out_t, out_s, out_d, out_v = [], [], [], []
     with metrics.span("wire.serialize"):
         for c in t.columns:
-            ti, s, d, v = _column_to_wire(c)
+            ti, s, d, v = _column_to_wire(c, t.logical_rows)
             out_t.append(ti)
             out_s.append(s)
             out_d.append(d)
             out_v.append(v)
-    return out_t, out_s, out_d, out_v, int(t.row_count)
+    return out_t, out_s, out_d, out_v, int(t.logical_row_count)
 
 
 def table_num_rows(table_id: int) -> int:
-    return int(_resident_get(table_id).row_count)
+    return int(_resident_get(table_id).logical_row_count)
 
 
 def table_free(table_id: int) -> None:

@@ -105,6 +105,13 @@ _FLAGS = {
             "sort - the round-5 on-chip winner) | packed | chunked "
             "(the two-level designs, kept for A/B)",
         ),
+        Flag(
+            "BUCKETS", "", str,
+            "shape-bucket spec for the dispatch plane (utils/buckets.py):"
+            " '' = default geometric ladder (1024 x2 up to 8.4M rows), "
+            "'floor:growth[:cap]', an explicit 'a,b,c' size list, or "
+            "off|none|0 to disable pad-to-bucket batching",
+        ),
     ]
 }
 

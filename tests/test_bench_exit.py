@@ -1,0 +1,77 @@
+"""bench.py exit-clean + fast-fail guards (ISSUE 2 satellites).
+
+Two consecutive rounds ended ``rc=124, parsed=null``: the driver's
+timeout killed the ladder between a progress line and the next emit.
+These tests pin the repair surface: structured skip records, the
+unreachable-failure classifier behind the fast-fail ladder, and the
+last-emitted-line guarantee the SIGTERM handler re-prints.
+"""
+
+import json
+
+import bench
+
+
+class TestFailureRecords:
+    def test_skipped_flag(self):
+        e = bench._failure_record(
+            "groupby100m", "skipped: budget 3300s exhausted",
+            exc_type="BudgetExceeded", elapsed_s=3301.2, skipped=True,
+        )
+        assert e["failure"]["type"] == "BudgetExceeded"
+        assert e["failure"]["skipped"] is True
+        assert e["failure"]["elapsed_s"] == 3301.2
+        # old readers still see the flat error string
+        assert "budget" in e["error"]
+
+    def test_default_not_skipped(self):
+        e = bench._failure_record("join", ValueError("boom"))
+        assert e["failure"]["skipped"] is False
+        assert e["failure"]["type"] == "ValueError"
+
+
+class TestUnreachableClassifier:
+    def test_unreachable_markers(self):
+        for msg in (
+            "device unreachable",
+            "UNAVAILABLE: socket closed",
+            "DEADLINE_EXCEEDED while fetching",
+            "failed to connect to tunnel",
+            "Failed to connect to remote host",  # capitalized gRPC text
+            "Socket closed",
+        ):
+            e = bench._failure_record("cfg", msg, exc_type="SubprocessFailed")
+            assert bench._unreachable_failure(e), msg
+
+    def test_timeout_type_counts_as_unreachable(self):
+        e = bench._failure_record(
+            "cfg", "timeout 1800s", exc_type="TimeoutExpired"
+        )
+        assert bench._unreachable_failure(e)
+
+    def test_genuine_crash_is_not_unreachable(self):
+        e = bench._failure_record(
+            "cfg", "assertion failed: groupby-sum mismatch vs numpy",
+            exc_type="SubprocessFailed",
+        )
+        assert not bench._unreachable_failure(e)
+
+    def test_tolerates_old_records_without_failure_block(self):
+        assert not bench._unreachable_failure({"name": "x", "error": "boom"})
+        assert bench._unreachable_failure(
+            {"name": "x", "error": "device unreachable"}
+        )
+
+
+class TestEmitGuarantee:
+    def test_emit_stores_last_line_parseable(self, capsys):
+        bench._emit([{"name": "x", "error": "boom",
+                      "failure": {"type": "Error", "message": "boom",
+                                  "elapsed_s": None, "retries": 0,
+                                  "skipped": False}}], "cpu")
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        doc = json.loads(out)
+        assert doc["metric"] == "groupby_sum_100M_int64"
+        # the SIGTERM handler re-prints exactly this line
+        assert bench._LAST_LINE == out
+        assert json.loads(bench._LAST_LINE)["configs"][0]["name"] == "x"

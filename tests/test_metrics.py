@@ -419,6 +419,7 @@ class TestBenchFailureRecords:
             "message": "boom",
             "elapsed_s": 1.234,
             "retries": 2,
+            "skipped": False,
         }
         json.dumps(r)
 

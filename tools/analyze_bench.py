@@ -156,6 +156,54 @@ def summarize_metrics(raw: list, top: int = 10) -> None:
             print(f"  {name[3:-6]:42} {v} calls")
 
 
+def summarize_compile_cache(raw: list) -> None:
+    """Per config block: compiled-executable cache efficiency
+    (compile_cache.hit/miss) and shape-bucket pad waste
+    (bucket.pad_waste_bytes) from the entries' metrics. Old BENCH files
+    that predate the bucket plane simply have no such fields — silent
+    skip, like the other metrics summaries."""
+    rows = []
+    seen = set()
+    for e in raw:
+        m = e.get("metrics")
+        if not isinstance(m, dict):
+            continue
+        c = m.get("counters") or {}
+        b = m.get("bytes") or {}
+        hits = c.get("compile_cache.hit")
+        misses = c.get("compile_cache.miss")
+        waste = b.get("bucket.pad_waste_bytes", 0)
+        if hits is None and misses is None and not waste:
+            continue
+        # several entries of one config share ONE snapshot: fold by the
+        # full metrics block (the _merge_metrics discipline) — distinct
+        # configs whose cache counters merely coincide keep their rows
+        key = json.dumps(m, sort_keys=True)
+        if key in seen:
+            continue
+        seen.add(key)
+        rows.append((e.get("name", "?"), hits or 0, misses or 0, waste))
+    if not rows:
+        return
+    print("\ncompile cache (per config block):")
+    for name, h, mi, w in rows:
+        tot = h + mi
+        rate = (100.0 * h / tot) if tot else 0.0
+        print(
+            f"  {name:42} {h}/{tot} hits ({rate:.0f}%), "
+            f"pad waste {w / 1e6:.2f} MB"
+        )
+    th = sum(r[1] for r in rows)
+    tm = sum(r[2] for r in rows)
+    tw = sum(r[3] for r in rows)
+    if th + tm:
+        print(
+            f"  {'TOTAL':42} {th}/{th + tm} hits "
+            f"({100.0 * th / (th + tm):.0f}%), "
+            f"pad waste {tw / 1e6:.2f} MB"
+        )
+
+
 def summarize_failures(raw: list) -> None:
     """Print the structured failure records (diagnosable-from-JSON)."""
     fails = [e for e in raw if isinstance(e.get("failure"), dict)]
@@ -165,6 +213,8 @@ def summarize_failures(raw: list) -> None:
     for e in fails:
         f = e["failure"]
         extra = []
+        if f.get("skipped"):
+            extra.append("skipped")
         if f.get("elapsed_s") is not None:
             extra.append(f"after {f['elapsed_s']}s")
         if f.get("retries"):
@@ -182,6 +232,7 @@ def main() -> None:
     if not entries:
         print("no measured entries")
         summarize_metrics(raw)
+        summarize_compile_cache(raw)
         summarize_failures(raw)
         return
     for label, arms in _GROUPS.items():
@@ -204,6 +255,7 @@ def main() -> None:
     if extra:
         print("\nother measured entries:", ", ".join(extra))
     summarize_metrics(raw)
+    summarize_compile_cache(raw)
     summarize_failures(raw)
 
 
