@@ -38,6 +38,32 @@ def _progress(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _flight_tail(limit=40):
+    """Last N flight-recorder events, or None when the recorder is off
+    or the package is absent — the timeline of what the process was
+    doing in the seconds before a failure."""
+    try:
+        from spark_rapids_jni_tpu.utils import flight
+
+        if not flight.enabled():
+            return None
+        return flight.tail_records(limit) or None
+    except Exception:
+        return None
+
+
+def _flight_note(name, arg=None):
+    """One instant event on the flight recorder (lazy import, never
+    raises): probe retries and fast-fail decisions must appear in the
+    postmortem timeline next to the spans they interrupted."""
+    try:
+        from spark_rapids_jni_tpu.utils import flight
+
+        flight.record("I", name, arg)
+    except Exception:
+        pass
+
+
 def _failure_record(
     name, error, exc_type=None, elapsed_s=None, retries=0, skipped=False
 ):
@@ -47,23 +73,31 @@ def _failure_record(
     strings and no telemetry). The flat "error" string stays for old
     readers; "failure" is the structured record. ``skipped=True`` marks
     a config that was never attempted (budget exhausted / fast-fail
-    after the tunnel went down) as opposed to one that ran and died."""
+    after the tunnel went down) as opposed to one that ran and died.
+    When the flight recorder is on, a record for a config that actually
+    RAN and died also carries ``flight_tail`` — the last events before
+    the failure, the input of ``tools/trace2chrome.py`` — so "device
+    unreachable" is never again a bare string. Skip records
+    (``skipped=True``) stay lean: a fast-fail batch would otherwise
+    embed N byte-identical tails into the headline JSON; the config
+    that triggered the fast-fail carries the one that matters."""
     msg = str(error)[:300]
-    return {
-        "name": name,
-        "error": msg,
-        "failure": {
-            "type": exc_type
-            or (type(error).__name__ if isinstance(error, BaseException)
-                else "Error"),
-            "message": msg,
-            "elapsed_s": (
-                round(float(elapsed_s), 3) if elapsed_s is not None else None
-            ),
-            "retries": int(retries),
-            "skipped": bool(skipped),
-        },
+    failure = {
+        "type": exc_type
+        or (type(error).__name__ if isinstance(error, BaseException)
+            else "Error"),
+        "message": msg,
+        "elapsed_s": (
+            round(float(elapsed_s), 3) if elapsed_s is not None else None
+        ),
+        "retries": int(retries),
+        "skipped": bool(skipped),
     }
+    if not skipped:
+        tail = _flight_tail()
+        if tail:
+            failure["flight_tail"] = tail
+    return {"name": name, "error": msg, "failure": failure}
 
 
 # markers of a dead/hung tunnel in a config failure: after the FIRST of
@@ -88,12 +122,31 @@ def _unreachable_failure(entry) -> bool:
 
 
 def _metrics_enable():
-    """Turn the metrics plane on for this process (lazy import so the
-    bench stays runnable from a checkout without the package installed)."""
+    """Turn the metrics AND flight-recorder planes on for this process
+    (lazy import so the bench stays runnable from a checkout without
+    the package installed). The flight recorder is the crash telemetry:
+    its tail rides in every structured failure record and is flushed to
+    SPARK_RAPIDS_TPU_FLIGHT_DUMP from the SIGTERM handler."""
     try:
         from spark_rapids_jni_tpu.utils import config as _srt_config
 
         _srt_config.set_flag("METRICS", True)
+        _srt_config.set_flag("FLIGHT", True)
+    except Exception:
+        pass
+
+
+def _flush_telemetry():
+    """Write the metrics snapshot and flight-recorder tail to their
+    configured dump paths NOW. Called from the SIGTERM handler (which
+    os._exit's, skipping atexit) so an rc=124 run still leaves its
+    telemetry behind; cheap and exception-free by construction."""
+    try:
+        from spark_rapids_jni_tpu.utils import flight as _srt_flight
+        from spark_rapids_jni_tpu.utils import metrics as _srt_metrics
+
+        _srt_metrics.dump()
+        _srt_flight.dump()
     except Exception:
         pass
 
@@ -755,14 +808,21 @@ def bench_bucketed_stream(platform, n_batches=12):
     }
 
 
-def bench_resident_chain(platform, n=4_000_000):
+def bench_resident_chain(platform, n=None):
     """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
     through device-RESIDENT table handles vs the bytes-wire path that
-    round-trips every op's inputs/outputs through host memory."""
+    round-trips every op's inputs/outputs through host memory.
+    SRT_BENCH_RESIDENT_ROWS shrinks the shape for smoke runs
+    (ci/smoke-observability.sh drives this config to produce trace +
+    flight artifacts in seconds, not minutes)."""
+    import os as _os
     import time as _time
 
     from spark_rapids_jni_tpu import dtype as dt
     from spark_rapids_jni_tpu import runtime_bridge as rb
+
+    if n is None:
+        n = int(_os.environ.get("SRT_BENCH_RESIDENT_ROWS", 4_000_000))
 
     rng = np.random.default_rng(9)
     k = rng.integers(0, 1000, n, dtype=np.int64)
@@ -1521,9 +1581,14 @@ def _probe_device(timeout_s: int = 150) -> bool:
             "probe_up" if up else "probe_failed",
             rc=out.returncode,
         )
+        _flight_note(
+            "tunnel.probe_up" if up else "tunnel.probe_failed",
+            out.returncode,
+        )
         return up
     except subprocess.TimeoutExpired:
         _tunnel_log("WARN", "probe_timeout", timeout_s=timeout_s)
+        _flight_note("tunnel.probe_timeout", timeout_s)
         return False
 
 
@@ -1559,15 +1624,22 @@ _LAST_LINE = None
 
 def _install_exit_handlers():
     """`timeout -k` sends SIGTERM before SIGKILL: use the grace window
-    to re-print the last headline JSON as the final stdout line."""
+    to flush the telemetry dumps (METRICS_DUMP + FLIGHT_DUMP — atexit
+    never runs past os._exit) and re-print the last headline JSON as
+    the final stdout line."""
     import signal
 
     def _on_term(signum, frame):  # pragma: no cover - signal path
+        _flight_note("bench.sigterm", signum)
         if _LAST_LINE:
-            # leading newline: the kill may land mid-write of a large
-            # emit, and appending to a torn partial line would make the
-            # final line unparseable
+            # headline FIRST, telemetry second: the re-printed line is
+            # the one deliverable the driver parses, so nothing that
+            # could conceivably block (file IO, lock acquisition in the
+            # dump path) may run before it. Leading newline: the kill
+            # may land mid-write of a large emit, and appending to a
+            # torn partial line would make the final line unparseable.
             print("\n" + _LAST_LINE, flush=True)
+        _flush_telemetry()
         os._exit(0)
 
     try:
@@ -1685,6 +1757,7 @@ def main():
     alive = _probe_device()
     if not alive:
         _progress("device probe failed (tunnel down/hung): retrying once")
+        _flight_note("tunnel.probe_retry")
         probe_retries = 1
         alive = _probe_device()
     probe_elapsed = time.time() - t_probe
@@ -1742,6 +1815,7 @@ def main():
                         "device lost mid-ladder; fast-failing "
                         f"{len(_LADDER) - i - 1} remaining configs"
                     )
+                    _flight_note("device.unreachable", key)
                     for later in _LADDER[i + 1:]:
                         if not _state_results(later):
                             entries.append(_failure_record(

@@ -47,5 +47,10 @@ jax.block_until_ready(jax.jit(fn)(*args))
 print('entry OK')
 "
 
+# Observability smoke: a tiny bench config with tracing + the flight
+# recorder on must leave parseable telemetry artifacts that convert
+# into a Perfetto-loadable Chrome trace (the crash-postmortem contract).
+bash ci/smoke-observability.sh
+
 # Bench smoke on whatever device this node has.
 python3 bench.py

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Observability smoke gate: a tiny bench config with tracing + the
+# flight recorder enabled must leave BOTH telemetry artifacts behind
+# (metrics snapshot + flight dump), and the flight dump must convert
+# into a Perfetto-loadable Chrome trace with spans from the dispatch,
+# wire-serde and bucketed subsystems plus at least one counter track.
+#
+# This is the crash-postmortem contract of ISSUE 3: if this gate
+# passes, a SIGTERM'd production run leaves a timeline you can open at
+# https://ui.perfetto.dev instead of a bare "device unreachable".
+#
+# Runs on the CPU backend by default so it gates every premerge node;
+# set SPARK_RAPIDS_TPU_TEST_PLATFORM/JAX_PLATFORMS for an on-chip run.
+set -euxo pipefail
+
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo"
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export SRT_JAX_PLATFORMS="${SRT_JAX_PLATFORMS:-cpu}"
+export SPARK_RAPIDS_TPU_TRACE=1
+export SPARK_RAPIDS_TPU_METRICS_DUMP="$out/metrics.json"
+export SPARK_RAPIDS_TPU_FLIGHT_DUMP="$out/flight.json"
+# shrink the resident-chain config (filter -> sort -> groupby through
+# wire AND resident handles: dispatch + serde + bucketed spans,
+# resident.live counter samples) to smoke scale
+export SRT_BENCH_RESIDENT_ROWS=200000
+
+python3 bench.py --one resident
+
+# both artifacts exist and parse as JSON
+test -s "$out/metrics.json"
+test -s "$out/flight.json"
+python3 -m json.tool "$out/metrics.json" > /dev/null
+python3 -m json.tool "$out/flight.json" > /dev/null
+
+# the flight dump converts into a schema-valid Chrome trace covering
+# >= 3 subsystems + >= 1 counter track
+python3 tools/trace2chrome.py "$out/flight.json" -o "$out/trace.json"
+python3 - "$out/trace.json" <<'PY'
+import json
+import sys
+
+trace = json.load(open(sys.argv[1]))
+events = trace["traceEvents"]
+assert events, "empty trace"
+for e in events:
+    assert "ph" in e and "pid" in e and "tid" in e, e
+spans = [e for e in events if e["ph"] == "X"]
+cats = {e["cat"] for e in spans}
+assert "dispatch" in cats, cats
+assert "wire" in cats, cats
+assert "bucketed" in cats, cats
+counters = {e["name"] for e in events if e["ph"] == "C"}
+assert counters, "no counter tracks"
+print(
+    f"observability smoke OK: {len(spans)} spans, "
+    f"subsystems={sorted(cats)}, counters={sorted(counters)}"
+)
+PY

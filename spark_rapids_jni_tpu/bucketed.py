@@ -57,22 +57,28 @@ def dispatch_bucketed(
     runner = _RUNNERS.get(name)
     if runner is None:
         return None
-    try:
-        out = runner(op, table, tuple(rest))
-    except _Decline:
-        return None
-    except Exception as e:
-        # bucketing must never change semantics: any runner failure
-        # falls back to the exact path, which raises the real error if
-        # the op itself is at fault
-        metrics.counter_add("bucket.fallback_errors")
-        if name not in _WARNED_OPS:
-            _WARNED_OPS.add(name)
-            log.log(
-                "WARN", "buckets", "bucketed_runner_failed", op=name,
-                error=f"{type(e).__name__}: {str(e)[:200]}",
-            )
-        return None
+    # the span makes the bucket plane its own flight-recorder/trace
+    # track (nested inside dispatch.<op>); declines and fallbacks are
+    # handled INSIDE it so they exit the span cleanly instead of
+    # counting as span errors
+    with metrics.span("bucketed." + name):
+        try:
+            out = runner(op, table, tuple(rest))
+        except _Decline:
+            metrics.counter_add("bucket.declined")
+            return None
+        except Exception as e:
+            # bucketing must never change semantics: any runner failure
+            # falls back to the exact path, which raises the real error
+            # if the op itself is at fault
+            metrics.counter_add("bucket.fallback_errors")
+            if name not in _WARNED_OPS:
+                _WARNED_OPS.add(name)
+                log.log(
+                    "WARN", "buckets", "bucketed_runner_failed", op=name,
+                    error=f"{type(e).__name__}: {str(e)[:200]}",
+                )
+            return None
     metrics.counter_add("bucket.dispatched")
     return out
 

@@ -38,7 +38,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 
 from . import dtype as dt
 from .column import Column, Table
-from .utils import buckets, log, metrics
+from .utils import buckets, flight, log, metrics
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -431,6 +431,11 @@ def table_op_wire(
         # unpad slice for nothing
         if bucketed.is_bucketable(op):
             pad_to = buckets.bucket_for(num_rows)
+    if flight.enabled():
+        flight.record(
+            "I", "wire.in",
+            sum(len(d) for d in datas if d is not None),
+        )
     with metrics.span("wire.deserialize"):
         cols = [
             _column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
@@ -448,6 +453,10 @@ def table_op_wire(
             out_s.append(s)
             out_d.append(d)
             out_v.append(v)
+    if flight.enabled():
+        flight.record(
+            "I", "wire.out", sum(len(d) for d in out_d if d is not None)
+        )
     return out_t, out_s, out_d, out_v, int(result.logical_row_count)
 
 
@@ -470,16 +479,36 @@ def platform() -> str:
 # boundary at upload/download.
 # ---------------------------------------------------------------------------
 
+import atexit
 import itertools
 import threading
+import time as _time
 
 _RESIDENT: dict = {}
+# table id -> allocation provenance (span stack, rows, timestamp): what
+# the exit-time leak report prints for every handle still live — the
+# RMM leak report's "where was this allocated" role. Populated only
+# when a telemetry plane is on (metrics/flight/REFCOUNT_DEBUG), so the
+# shipped-disabled path stays two dict ops.
+_RESIDENT_META: dict = {}
 # Lock + atomic counter: Spark executors call through the JNI bridge
 # from many threads (the GilGuard path), and the GIL can switch between
 # a read-increment pair — an unsynchronized counter could hand two
-# threads the same table id.
-_RESIDENT_LOCK = threading.Lock()
+# threads the same table id. RLock because the SIGTERM-handler flush
+# path reaches leak_report() (a flight-dump exit section) on the main
+# thread and must not self-deadlock mid-_resident_put.
+_RESIDENT_LOCK = threading.RLock()
 _NEXT_TABLE_ID = itertools.count(1)
+
+
+def _provenance_on() -> bool:
+    from .utils import config
+
+    return (
+        metrics.enabled()
+        or flight.enabled()
+        or bool(config.get_flag("REFCOUNT_DEBUG"))
+    )
 
 
 def _resident_get(table_id: int) -> Table:
@@ -493,8 +522,18 @@ def _resident_get(table_id: int) -> Table:
 
 def _resident_put(t: Table) -> int:
     tid = next(_NEXT_TABLE_ID)
+    meta = None
+    if _provenance_on():
+        meta = {
+            "rows": int(t.logical_row_count),
+            "columns": len(t.columns),
+            "allocated_under": list(metrics.span_stack()),
+            "age_anchor_ns": _time.perf_counter_ns(),
+        }
     with _RESIDENT_LOCK:
         _RESIDENT[tid] = t
+        if meta is not None:
+            _RESIDENT_META[tid] = meta
         live = len(_RESIDENT)
     log.log("DEBUG", "handles", "resident_put", table_id=tid,
             rows=int(t.logical_row_count), live=live)
@@ -503,6 +542,8 @@ def _resident_put(t: Table) -> int:
     # high_water records the peak resident set
     metrics.counter_add("resident.put")
     metrics.gauge_set("resident.live", live)
+    if flight.enabled():
+        flight.record("C", "resident.live", live)
     return tid
 
 
@@ -566,6 +607,7 @@ def table_num_rows(table_id: int) -> int:
 def table_free(table_id: int) -> None:
     with _RESIDENT_LOCK:
         gone = _RESIDENT.pop(int(table_id), None) is None
+        _RESIDENT_META.pop(int(table_id), None)
         live = len(_RESIDENT)
     if gone:
         raise KeyError(f"unknown device table id {table_id}")
@@ -573,9 +615,75 @@ def table_free(table_id: int) -> None:
             live=live)
     metrics.counter_add("resident.free")
     metrics.gauge_set("resident.live", live)
+    if flight.enabled():
+        flight.record("C", "resident.live", live)
 
 
 def resident_table_count() -> int:
     """Live resident tables (leak-report analog for device tables)."""
     with _RESIDENT_LOCK:
         return len(_RESIDENT)
+
+
+def leak_report() -> list:
+    """Tables still resident, each with the span stack that allocated
+    it — the RMM leak report's role for device table handles. JSON-able;
+    embedded in the flight dump as the ``resident_leaks`` section and
+    printed at exit when non-empty and a telemetry plane is on."""
+    with _RESIDENT_LOCK:
+        items = [
+            (tid, _RESIDENT[tid], dict(_RESIDENT_META.get(tid) or {}))
+            for tid in sorted(_RESIDENT)
+        ]
+    now = _time.perf_counter_ns()
+    out = []
+    for tid, t, meta in items:
+        rec = {
+            "table_id": tid,
+            "rows": int(t.logical_row_count),
+            "columns": len(t.columns),
+            "allocated_under": meta.get("allocated_under", []),
+        }
+        anchor = meta.get("age_anchor_ns")
+        if anchor is not None:
+            rec["age_s"] = round((now - anchor) / 1e9, 3)
+        try:
+            from .utils import hbm
+
+            rec["approx_bytes"] = int(hbm.table_bytes(t))
+        except Exception:
+            pass
+        out.append(rec)
+    return out
+
+
+def _leak_report_at_exit() -> None:  # pragma: no cover - atexit path
+    """The RMM-leak-report-at-shutdown analog: WARN (ungated when a
+    telemetry plane is on — a leak with no trace wasted a round-5
+    debugging session) for every table a dead process left resident."""
+    if not _RESIDENT or not _provenance_on():
+        return
+    import sys as _sys
+
+    leaks = leak_report()
+    print(
+        f"[srt][leak][WARN] {len(leaks)} device table(s) still resident "
+        "at exit:",
+        file=_sys.stderr,
+        flush=True,
+    )
+    for rec in leaks:
+        under = "/".join(rec["allocated_under"]) or "<no span>"
+        print(
+            f"[srt][leak][WARN]   table_id={rec['table_id']} "
+            f"rows={rec['rows']} cols={rec['columns']} "
+            f"bytes~{rec.get('approx_bytes', '?')} "
+            f"allocated_under={under}",
+            file=_sys.stderr,
+            flush=True,
+        )
+
+
+atexit.register(_leak_report_at_exit)
+# the flight dump carries the same record, so a postmortem reads one file
+flight.register_exit_section("resident_leaks", leak_report)

@@ -40,6 +40,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
 from . import config
+from . import flight
 from . import log
 from . import metrics
 
@@ -211,10 +212,18 @@ def pad_column(col, target: int):
     return Column(data, col.dtype, validity, lengths)
 
 
+# running pad-waste total for the flight counter track: kept locally so
+# the track survives flight-only mode (metrics off => bytes_add no-ops)
+# and isn't zeroed by the bench's per-config metrics.reset()
+_PAD_WASTE_LOCK = threading.Lock()
+_PAD_WASTE_TOTAL = 0
+
+
 def _record_pad_metrics(table, target: int, logical: int) -> None:
     """Pad-waste accounting shared by the device-side ``pad_table`` and
     the host-side wire upload padding (runtime_bridge)."""
-    if not metrics.enabled():
+    global _PAD_WASTE_TOTAL
+    if not (metrics.enabled() or flight.enabled()):
         return
     from . import hbm
 
@@ -223,7 +232,15 @@ def _record_pad_metrics(table, target: int, logical: int) -> None:
         # per-row bytes from the logical region (the padded buffers
         # would skew the denominator)
         per_row = -(-hbm.table_bytes(table) // max(table.row_count, 1))
-        metrics.bytes_add("bucket.pad_waste_bytes", extra * per_row)
+        waste = extra * per_row
+        metrics.bytes_add("bucket.pad_waste_bytes", waste)
+        if flight.enabled():
+            # cumulative waste as a flight counter track: the Chrome
+            # trace shows WHEN padding cost spiked, not just how much
+            with _PAD_WASTE_LOCK:
+                _PAD_WASTE_TOTAL += waste
+                total = _PAD_WASTE_TOTAL
+            flight.record("C", "bucket.pad_waste_bytes", total)
     metrics.counter_add("bucket.pad_tables")
     metrics.hist_observe("bucket.size", target)
     metrics.hist_observe("bucket.pad_rows", max(extra, 0))
@@ -342,6 +359,10 @@ def cached_jit(key: tuple, build: Callable[[], Callable], name: str):
     if won:
         metrics.counter_add("compile_cache.miss")
         metrics.gauge_set("compile_cache.size", size)
+        if flight.enabled():
+            # a miss on the hot path means an XLA compile is coming —
+            # the timeline explains the latency spike right after it
+            flight.record("I", "compile_cache.miss", name)
         if log.enabled("DEBUG", "buckets"):
             log.log("DEBUG", "buckets", "compile_cache_miss", name=name,
                     size=size)

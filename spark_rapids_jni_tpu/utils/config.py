@@ -106,6 +106,19 @@ _FLAGS = {
             "(the two-level designs, kept for A/B)",
         ),
         Flag(
+            "FLIGHT", "", str,
+            "flight recorder (utils/flight.py): off (default) | on = "
+            "ring of 8192 events | an integer ring capacity. Records "
+            "span begin/end, dispatch/wire/cache/retry events with "
+            "monotonic timestamps + thread ids; ~100ns/event",
+        ),
+        Flag(
+            "FLIGHT_DUMP", "", str,
+            "path to write the flight-recorder tail JSON at process "
+            "exit (atexit) and from the bench SIGTERM handler; a "
+            "non-empty path implies FLIGHT",
+        ),
+        Flag(
             "BUCKETS", "", str,
             "shape-bucket spec for the dispatch plane (utils/buckets.py):"
             " '' = default geometric ladder (1024 x2 up to 8.4M rows), "

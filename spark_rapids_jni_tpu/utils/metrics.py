@@ -38,16 +38,22 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from . import config
+from . import flight
 from . import log
 from . import tracing
 
 # ---------------------------------------------------------------------------
 # registry state — one lock guards every table; mutations are a few dict
 # ops so contention stays negligible even under the concurrent-dispatch
-# test tier (tests/test_metrics.py hammers it from many threads)
+# test tier (tests/test_metrics.py hammers it from many threads).
+# RLock, not Lock: the bench SIGTERM handler runs on the MAIN thread and
+# calls snapshot()/dump() — if the signal lands while that same thread
+# is inside a mutator's critical section, a non-reentrant lock would
+# self-deadlock the handler (and the process would hang to SIGKILL
+# without re-printing the headline line).
 # ---------------------------------------------------------------------------
 
-_LOCK = threading.Lock()
+_LOCK = threading.RLock()
 _COUNTERS: Dict[str, int] = {}
 _BYTES: Dict[str, int] = {}
 # name -> [count, total_s, min_s, max_s]
@@ -56,6 +62,11 @@ _TIMERS: Dict[str, List[float]] = {}
 _GAUGES: Dict[str, List[float]] = {}
 # name -> {"bounds": tuple, "counts": list, "count": int, "sum": float}
 _HISTS: Dict[str, dict] = {}
+# name -> [count, total_s] of span SELF time (duration minus enclosed
+# child spans on the same thread) — what analyze_bench's
+# top-ops-by-self-time table ranks; total time alone buries the hot
+# leaf under its wrappers
+_SELF: Dict[str, List[float]] = {}
 
 # bounded histogram default: powers of 4 from 1 to ~10^9 (17 buckets
 # incl. overflow) — sized for row counts and byte volumes
@@ -73,15 +84,18 @@ _TLS = threading.local()
 _GATE_GEN = -1
 _GATE_ENABLED = False
 _GATE_SPAN = False
+_GATE_FLIGHT = False
 
 
 def _refresh_gate() -> None:
-    global _GATE_GEN, _GATE_ENABLED, _GATE_SPAN
+    global _GATE_GEN, _GATE_ENABLED, _GATE_SPAN, _GATE_FLIGHT
     _GATE_ENABLED = bool(config.get_flag("METRICS")) or bool(
         config.get_flag("METRICS_DUMP")
     )
+    _GATE_FLIGHT = flight.enabled()
     _GATE_SPAN = (
         _GATE_ENABLED
+        or _GATE_FLIGHT
         or tracing.tracing_enabled()
         or log.enabled("TRACE", "span")
     )
@@ -153,6 +167,21 @@ def gauge_set(name: str, value) -> None:
                 g[1] = v
 
 
+def self_time_record(name: str, seconds: float) -> None:
+    """Fold one span SELF-time observation (duration minus child spans)
+    into the ``span_self`` table."""
+    if not enabled():
+        return
+    s = max(float(seconds), 0.0)
+    with _LOCK:
+        t = _SELF.get(name)
+        if t is None:
+            _SELF[name] = [1, s]
+        else:
+            t[0] += 1
+            t[1] += s
+
+
 def hist_observe(
     name: str, value, bounds: Optional[Sequence[float]] = None
 ) -> None:
@@ -197,8 +226,18 @@ class _NullSpan:
 NULL_SPAN = _NullSpan()
 
 
+# span-duration histogram edges in MILLISECONDS: ~x3 rungs from 10us to
+# 30s + overflow — wide enough for a tunnel round-trip, fine enough that
+# analyze_bench's p50/p95 estimates are meaningful
+_SPAN_MS_BOUNDS = (
+    0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0, 300.0,
+    1000.0, 3000.0, 10000.0, 30000.0,
+)
+
+
 class _Span:
-    __slots__ = ("name", "attrs", "qualname", "_t0", "_trace_cm")
+    __slots__ = ("name", "attrs", "qualname", "_t0", "_trace_cm",
+                 "_child_s")
 
     def __init__(self, name: str, attrs: dict):
         self.name = name
@@ -206,6 +245,7 @@ class _Span:
         self.qualname = name
         self._t0 = 0.0
         self._trace_cm = None
+        self._child_s = 0.0
 
     def __enter__(self):
         stack = getattr(_TLS, "stack", None)
@@ -222,6 +262,8 @@ class _Span:
         if tracing.tracing_enabled():
             self._trace_cm = tracing.trace_range(self.qualname)
             self._trace_cm.__enter__()
+        if _GATE_FLIGHT:
+            flight.record("B", self.qualname)
         self._t0 = time.perf_counter()
         return self
 
@@ -229,6 +271,11 @@ class _Span:
         # duration is recorded on the exception path too: a span that
         # dies mid-op is exactly the one the telemetry must explain
         dur = time.perf_counter() - self._t0
+        if _GATE_FLIGHT:
+            flight.record(
+                "E", self.qualname,
+                None if exc_type is None else exc_type.__name__,
+            )
         stack = getattr(_TLS, "stack", None)
         if stack and stack[-1] is self:
             stack.pop()
@@ -236,6 +283,16 @@ class _Span:
             self._trace_cm.__exit__(exc_type, exc, tb)
             self._trace_cm = None
         timer_record(self.name, dur)
+        if _GATE_ENABLED:
+            # self time: what THIS span spent outside its children —
+            # the parent (still on the stack, same thread) absorbs our
+            # whole duration into its child accumulator
+            if stack:
+                stack[-1]._child_s += dur
+            self_time_record(self.name, dur - self._child_s)
+            hist_observe(
+                "span_ms." + self.name, dur * 1e3, bounds=_SPAN_MS_BOUNDS
+            )
         if exc_type is not None:
             counter_add("span." + self.name + ".errors")
         if log.enabled("TRACE", "span"):
@@ -252,11 +309,14 @@ def span(name: str, **attrs):
     """Context manager: a named, nestable timed region.
 
     Records duration into the timer registry under ``name`` (exception
-    path included), opens a profiler ``trace_range`` when
-    ``SPARK_RAPIDS_TPU_TRACE`` is on, and emits one ``[srt][span][TRACE]``
-    stderr line when the log level admits it. Returns a shared no-op
-    object when every plane is off — the hot-path cost of a disabled
-    span is one generation compare on the cached gate.
+    path included) plus self-time and a ``span_ms.*`` duration
+    histogram, emits begin/end events into the flight recorder when
+    ``SPARK_RAPIDS_TPU_FLIGHT`` is on, opens a profiler ``trace_range``
+    when ``SPARK_RAPIDS_TPU_TRACE`` is on, and emits one
+    ``[srt][span][TRACE]`` stderr line when the log level admits it.
+    Returns a shared no-op object when every plane is off — the
+    hot-path cost of a disabled span is one generation compare on the
+    cached gate.
     """
     if _GATE_GEN != config.generation():
         _refresh_gate()
@@ -286,6 +346,14 @@ def span_depth() -> int:
     """Current nesting depth on this thread (test/introspection aid)."""
     stack = getattr(_TLS, "stack", None)
     return len(stack) if stack else 0
+
+
+def span_stack() -> tuple:
+    """Qualified names of the spans open on THIS thread, outermost
+    first — the allocation provenance the resident-table leak report
+    attaches to each handle."""
+    stack = getattr(_TLS, "stack", None)
+    return tuple(s.qualname for s in stack) if stack else ()
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +389,10 @@ def snapshot() -> dict:
                 }
                 for k, h in _HISTS.items()
             },
+            "span_self": {
+                k: {"count": int(t[0]), "self_s": float(t[1])}
+                for k, t in _SELF.items()
+            },
         }
 
 
@@ -332,6 +404,7 @@ def reset() -> None:
         _TIMERS.clear()
         _GAUGES.clear()
         _HISTS.clear()
+        _SELF.clear()
 
 
 def dump(path: Optional[str] = None) -> Optional[str]:

@@ -55,6 +55,160 @@ def annotate(name: Optional[str] = None):
     return wrap
 
 
+# ---------------------------------------------------------------------------
+# Chrome-trace / Perfetto export of flight-recorder events
+#
+# The flight recorder (utils/flight.py) captures span begin/end,
+# instants and counter samples with perf_counter_ns timestamps + thread
+# ids; this converter turns that tail into the Chrome Trace Event JSON
+# that chrome://tracing and https://ui.perfetto.dev load directly —
+# the Nsight-timeline role for a postmortem that has no live profiler
+# attached. Pure stdlib: usable from tools/trace2chrome.py on a dump
+# file long after the process that wrote it died.
+# ---------------------------------------------------------------------------
+
+
+def _chrome_cat(name: str) -> str:
+    """Category = the subsystem prefix of the LEAF span (dispatch,
+    wire, bucketed, shuffle, distributed, resident, ...) so Perfetto
+    can filter by plane. Span names are qualified paths
+    ('dispatch.sort_by/bucketed.sort_by'): the leaf segment names the
+    subsystem that actually ran, not the outermost wrapper."""
+    leaf = name.rsplit("/", 1)[-1]
+    return leaf.split(".", 1)[0] if "." in leaf else leaf
+
+
+def to_chrome_trace(events, pid: int = 0) -> dict:
+    """Flight-recorder event dicts -> a Chrome Trace Event JSON object.
+
+    ``events`` is the ``tail_records()`` / flight-dump ``"events"``
+    list. Span begin/end pairs are matched per thread into complete
+    ``"X"`` events (ts/dur in microseconds), which keeps the file valid
+    even when the ring's wraparound or a mid-span crash broke the
+    pairing:
+
+    * an ``E`` whose ``B`` fell off the ring becomes an ``X`` starting
+      at the timeline origin with ``args.truncated_begin`` — the span
+      was already running when the recorder's window opened;
+    * a ``B`` that never saw its ``E`` (the SIGTERM/abort case — the
+      exact spans the flight recorder exists to explain) becomes an
+      ``X`` running to the end of the timeline with
+      ``args.unterminated``.
+
+    ``I`` events become instants (``ph:"i"``), ``C`` events become
+    counter tracks (``ph:"C"``, one series per name). Thread-name
+    metadata rows give each tid a stable label.
+    """
+    evs = sorted(events, key=lambda e: e.get("seq", 0))
+    if not evs:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(e["t_ns"] for e in evs)
+    t_end = max(e["t_ns"] for e in evs)
+
+    def us(t_ns: int) -> float:
+        return round((t_ns - t0) / 1e3, 3)
+
+    out = []
+    tids: list = []
+    open_spans: dict = {}  # tid -> stack of B events
+    for e in evs:
+        tid = e["tid"]
+        if tid not in open_spans:
+            open_spans[tid] = []
+            tids.append(tid)
+        ph, name = e["ph"], e["name"]
+        if ph == "B":
+            open_spans[tid].append(e)
+        elif ph == "E":
+            stack = open_spans[tid]
+            begin = None
+            # match from the top down: a same-thread E always closes
+            # the innermost open span with its name; mismatches (lost
+            # B's) leave deeper frames alone
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i]["name"] == name:
+                    begin = stack.pop(i)
+                    break
+            x = {
+                "name": name,
+                "cat": _chrome_cat(name),
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+            }
+            args = {}
+            if e.get("arg") is not None:
+                args["error"] = e["arg"]
+            if begin is None:
+                x["ts"] = us(t0)
+                x["dur"] = us(e["t_ns"])
+                args["truncated_begin"] = True
+            else:
+                x["ts"] = us(begin["t_ns"])
+                x["dur"] = round((e["t_ns"] - begin["t_ns"]) / 1e3, 3)
+            if args:
+                x["args"] = args
+            out.append(x)
+        elif ph == "C":
+            out.append({
+                "name": name,
+                "ph": "C",
+                "pid": pid,
+                "tid": tid,
+                "ts": us(e["t_ns"]),
+                "args": {"value": e.get("arg", 0)},
+            })
+        else:  # "I" and anything future-shaped degrades to an instant
+            ev = {
+                "name": name,
+                "cat": _chrome_cat(name),
+                "ph": "i",
+                "s": "t",
+                "pid": pid,
+                "tid": tid,
+                "ts": us(e["t_ns"]),
+            }
+            if e.get("arg") is not None:
+                ev["args"] = {"arg": e["arg"]}
+            out.append(ev)
+    # crash case: spans still open at the end of the tail run to t_end
+    for tid, stack in open_spans.items():
+        for begin in stack:
+            out.append({
+                "name": begin["name"],
+                "cat": _chrome_cat(begin["name"]),
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "ts": us(begin["t_ns"]),
+                "dur": round((t_end - begin["t_ns"]) / 1e3, 3),
+                "args": {"unterminated": True},
+            })
+    meta = [{
+        "name": "process_name",
+        "ph": "M",
+        "pid": pid,
+        "tid": 0,
+        "args": {"name": "spark-rapids-tpu"},
+    }]
+    for i, tid in enumerate(tids):
+        meta.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": f"thread-{i} ({tid})"},
+        })
+        meta.append({
+            "name": "thread_sort_index",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"sort_index": i},
+        })
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
 @contextlib.contextmanager
 def capture_trace(log_dir: str) -> Iterator[None]:
     """Capture a full profiler trace (Perfetto) into ``log_dir``.

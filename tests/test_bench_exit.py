@@ -1,15 +1,25 @@
-"""bench.py exit-clean + fast-fail guards (ISSUE 2 satellites).
+"""bench.py exit-clean + fast-fail guards (ISSUE 2 satellites) and the
+SIGTERM telemetry-flush integration (ISSUE 3 satellite).
 
 Two consecutive rounds ended ``rc=124, parsed=null``: the driver's
 timeout killed the ladder between a progress line and the next emit.
 These tests pin the repair surface: structured skip records, the
-unreachable-failure classifier behind the fast-fail ladder, and the
-last-emitted-line guarantee the SIGTERM handler re-prints.
+unreachable-failure classifier behind the fast-fail ladder, the
+last-emitted-line guarantee the SIGTERM handler re-prints — and, since
+the flight-recorder PR, that the same handler flushes the METRICS_DUMP
+and FLIGHT_DUMP artifacts before ``os._exit`` (atexit never runs past
+it), so an rc=124 run still leaves its telemetry behind.
 """
 
 import json
+import os
+import subprocess
+import sys
+import textwrap
 
 import bench
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 class TestFailureRecords:
@@ -60,6 +70,64 @@ class TestUnreachableClassifier:
         assert not bench._unreachable_failure({"name": "x", "error": "boom"})
         assert bench._unreachable_failure(
             {"name": "x", "error": "device unreachable"}
+        )
+
+
+class TestSigtermTelemetryFlush:
+    def test_sigterm_flushes_metrics_and_flight_dumps(self, tmp_path):
+        """A SIGTERM'd bench process must leave BOTH dump files behind
+        and still print the headline JSON as its final stdout line —
+        the rc=124 postmortem contract. The span is deliberately left
+        open when the signal lands: that is exactly the state a killed
+        run dies in, and the flight tail must show it."""
+        mdump = tmp_path / "metrics.json"
+        fdump = tmp_path / "flight.json"
+        script = textwrap.dedent(
+            f"""
+            import os, signal, sys, time
+            sys.path.insert(0, {_ROOT!r})
+            import bench
+            bench._install_exit_handlers()
+            bench._metrics_enable()
+            from spark_rapids_jni_tpu.utils import flight, metrics
+            bench._LAST_LINE = '{{"metric": "sigterm-test"}}'
+            with metrics.span("cfg.doomed"):
+                flight.record("I", "tunnel.probe_retry")
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(30)
+                sys.exit(3)  # handler never fired
+            """
+        )
+        env = dict(os.environ)
+        env.update({
+            "SPARK_RAPIDS_TPU_METRICS_DUMP": str(mdump),
+            "SPARK_RAPIDS_TPU_FLIGHT_DUMP": str(fdump),
+            "JAX_PLATFORMS": "cpu",
+            "SRT_JAX_PLATFORMS": "cpu",
+        })
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True,
+            text=True, timeout=300, env=env, cwd=_ROOT,
+        )
+        assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+        # the final stdout line is the re-printed headline JSON
+        last = proc.stdout.strip().splitlines()[-1]
+        assert json.loads(last)["metric"] == "sigterm-test"
+        # metrics snapshot flushed by the handler (atexit never ran)
+        snap = json.loads(mdump.read_text())
+        assert "counters" in snap
+        # flight tail flushed too: the open span's B, the instant, and
+        # the handler's own sigterm marker
+        doc = json.loads(fdump.read_text())
+        names = [e["name"] for e in doc["events"]]
+        assert "cfg.doomed" in names
+        assert "tunnel.probe_retry" in names
+        assert names[-1] == "bench.sigterm"
+        # the span never closed — no E event for it (the crash shape
+        # tools/trace2chrome.py renders as an unterminated X)
+        assert not any(
+            e["ph"] == "E" and e["name"] == "cfg.doomed"
+            for e in doc["events"]
         )
 
 

@@ -24,7 +24,7 @@ import pytest
 from spark_rapids_jni_tpu import dtype as dt
 from spark_rapids_jni_tpu import runtime_bridge as rb
 from spark_rapids_jni_tpu.column import Column, Table
-from spark_rapids_jni_tpu.utils import config, log, metrics, tracing
+from spark_rapids_jni_tpu.utils import config, flight, log, metrics, tracing
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -34,11 +34,16 @@ def _metrics_isolated(monkeypatch):
     monkeypatch.delenv("SPARK_RAPIDS_TPU_METRICS", raising=False)
     monkeypatch.delenv("SPARK_RAPIDS_TPU_METRICS_DUMP", raising=False)
     monkeypatch.delenv("SPARK_RAPIDS_TPU_LOG_LEVEL", raising=False)
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_FLIGHT", raising=False)
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_FLIGHT_DUMP", raising=False)
     metrics.reset()
+    flight.reset()
     yield
-    for f in ("METRICS", "METRICS_DUMP", "LOG_LEVEL", "TRACE"):
+    for f in ("METRICS", "METRICS_DUMP", "LOG_LEVEL", "TRACE",
+              "FLIGHT", "FLIGHT_DUMP"):
         config.clear_flag(f)
     metrics.reset()
+    flight.reset()
     log._WARNED_INVALID.clear()
 
 
@@ -148,6 +153,24 @@ class TestSpans:
         assert snap["timers"]["doomed"]["count"] == 1
         assert snap["counters"]["span.doomed.errors"] == 1
         assert metrics.span_depth() == 0  # stack unwound
+
+    def test_span_self_time_excludes_children(self):
+        import time as _time
+
+        _on()
+        with metrics.span("outer"):
+            with metrics.span("inner"):
+                _time.sleep(0.02)
+        snap = metrics.snapshot()
+        # inner has no children: self time == its duration
+        assert snap["span_self"]["inner"]["self_s"] >= 0.015
+        # outer's self time excludes inner — near zero, far below its
+        # total (which contains the sleep)
+        assert snap["timers"]["outer"]["total_s"] >= 0.015
+        assert snap["span_self"]["outer"]["self_s"] < 0.015
+        # and every span feeds its duration histogram
+        assert snap["histograms"]["span_ms.inner"]["count"] == 1
+        assert snap["histograms"]["span_ms.outer"]["count"] == 1
 
     def test_traced_decorator(self):
         _on()
@@ -484,6 +507,54 @@ class TestAnalyzeBench:
         mod = _analyze_mod()
         mod.summarize_metrics([{"name": "x", "seconds_median": 1.0}])
         assert "no metrics blocks" in capsys.readouterr().out
+
+    def test_hist_percentile_upper_edges(self):
+        mod = _analyze_mod()
+        # 3 observations, one per bucket: p50 lands on the 2nd edge
+        assert mod._hist_percentile([1, 10, 100], [1, 1, 1, 0], 0.5) == 10.0
+        # all mass in the overflow bucket: percentile is ">max"
+        assert mod._hist_percentile([1, 10], [0, 0, 5], 0.95) == float("inf")
+        assert mod._hist_percentile([1], [0, 0], 0.5) is None
+
+    def test_summarize_spans_percentiles_and_self_time(self, capsys):
+        mod = _analyze_mod()
+        block = {
+            "timers": {
+                "dispatch.sort_by": {
+                    "count": 3, "total_s": 1.0, "min_s": 0.1, "max_s": 0.7,
+                },
+            },
+            "histograms": {
+                "span_ms.dispatch.sort_by": {
+                    "bounds": [1, 10, 100], "counts": [1, 1, 1, 0],
+                    "count": 3, "sum": 60.0,
+                },
+                # non-span histogram must not rank as a span
+                "dispatch.rows_in": {
+                    "bounds": [1], "counts": [1, 0], "count": 1, "sum": 1.0,
+                },
+            },
+            "span_self": {
+                "dispatch.sort_by": {"count": 3, "self_s": 0.4},
+            },
+        }
+        mod.summarize_spans([{"name": "a", "metrics": block}])
+        out = capsys.readouterr().out
+        assert "span durations" in out
+        assert "dispatch.sort_by" in out
+        assert "rows_in" not in out
+        assert "top 5 ops by self time" in out
+        assert "40% of span" in out
+
+    def test_summarize_spans_tolerates_old_files(self, capsys):
+        mod = _analyze_mod()
+        # pre-flight-recorder metrics blocks and metric-less entries
+        # produce NO span section (quiet skip, not a crash)
+        mod.summarize_spans([
+            {"name": "x", "seconds_median": 1.0},
+            {"name": "y", "metrics": {"timers": {}, "bytes": {}}},
+        ])
+        assert capsys.readouterr().out == ""
 
     def test_load_bench_file_with_failures(self, tmp_path, capsys):
         mod = _analyze_mod()

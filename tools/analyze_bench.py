@@ -7,9 +7,10 @@ formulation becomes each op's default) generated from data instead of
 eyeballs.
 
 Also summarizes the per-config "metrics" blocks bench entries carry
-since the observability PR (top ops by time and by bytes moved, plus
-structured failure records), tolerating old BENCH files that predate
-them.
+since the observability PR (top ops by time and by bytes moved,
+span-duration p50/p95/max from the ``span_ms.*`` histograms, a top-5
+ops-by-self-time table, plus structured failure records), tolerating
+old BENCH files that predate any of these fields.
 
 Usage: python tools/analyze_bench.py [path-to-state-or-bench-json]
 """
@@ -95,11 +96,14 @@ def _load(path: str) -> tuple:
 
 def _merge_metrics(raw: list) -> dict:
     """Fold every entry's "metrics" block into one {timers, bytes,
-    counters} aggregate. Identical blocks (several entries of one
-    config share a snapshot) are folded once."""
+    counters, histograms, span_self} aggregate. Identical blocks
+    (several entries of one config share a snapshot) are folded once.
+    Old BENCH files simply lack the newer sections — quiet tolerance."""
     timers: dict = {}
     byte_ctrs: dict = {}
     counters: dict = {}
+    hists: dict = {}
+    span_self: dict = {}
     seen = set()
     for e in raw:
         m = e.get("metrics")
@@ -110,20 +114,132 @@ def _merge_metrics(raw: list) -> dict:
             continue
         seen.add(key)
         for name, t in (m.get("timers") or {}).items():
-            agg = timers.setdefault(name, {"count": 0, "total_s": 0.0})
+            # max_s stays None until a block actually carries one:
+            # PR-1-era timer rows lack it, and folding them in as 0.0
+            # would print a false 0.00ms max for real spans
+            agg = timers.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": None}
+            )
             agg["count"] += int(t.get("count", 0))
             agg["total_s"] += float(t.get("total_s", 0.0))
+            mx = t.get("max_s")
+            if mx is not None:
+                mx = float(mx)
+                agg["max_s"] = (
+                    mx if agg["max_s"] is None else max(agg["max_s"], mx)
+                )
         for name, v in (m.get("bytes") or {}).items():
             byte_ctrs[name] = byte_ctrs.get(name, 0) + int(v)
         for name, v in (m.get("counters") or {}).items():
             counters[name] = counters.get(name, 0) + int(v)
-    return {"timers": timers, "bytes": byte_ctrs, "counters": counters}
+        for name, h in (m.get("histograms") or {}).items():
+            agg = hists.get(name)
+            if agg is None:
+                hists[name] = {
+                    "bounds": list(h.get("bounds", [])),
+                    "counts": list(h.get("counts", [])),
+                }
+            elif agg["bounds"] == list(h.get("bounds", [])):
+                agg["counts"] = [
+                    a + b for a, b in zip(agg["counts"], h.get("counts", []))
+                ]
+            # mismatched bounds across files: keep the first block (a
+            # partial sum would misestimate every percentile)
+        for name, t in (m.get("span_self") or {}).items():
+            agg = span_self.setdefault(name, {"count": 0, "self_s": 0.0})
+            agg["count"] += int(t.get("count", 0))
+            agg["self_s"] += float(t.get("self_s", 0.0))
+    return {
+        "timers": timers,
+        "bytes": byte_ctrs,
+        "counters": counters,
+        "histograms": hists,
+        "span_self": span_self,
+    }
 
 
-def summarize_metrics(raw: list, top: int = 10) -> None:
+def _hist_percentile(bounds: list, counts: list, q: float):
+    """Upper-edge percentile estimate from a bounded histogram: the
+    smallest bucket edge at or below which >= q of the mass sits.
+    Returns None on an empty histogram; the overflow bucket reports as
+    ">last edge" via float('inf')."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return float(bounds[i]) if i < len(bounds) else float("inf")
+    return float("inf")
+
+
+def _fmt_ms(v) -> str:
+    if v is None:
+        return "      ?"
+    if v == float("inf"):
+        return "   >max"
+    return f"{v:7.2f}"
+
+
+def summarize_spans(raw: list, top: int = 10, merged=None) -> None:
+    """Span-duration distribution (p50/p95 estimated from the
+    ``span_ms.*`` bounded histograms, exact max from the timer table)
+    plus the top-5 ops by SELF time — the table that surfaces the hot
+    leaf instead of the wrapper that encloses it. Old BENCH files that
+    predate these sections are silently skipped. Pass a precomputed
+    ``_merge_metrics(raw)`` to avoid re-folding."""
+    if merged is None:
+        merged = _merge_metrics(raw)
+    span_hists = {
+        name[len("span_ms."):]: h
+        for name, h in merged["histograms"].items()
+        if name.startswith("span_ms.")
+    }
+    if span_hists:
+        ranked = sorted(
+            span_hists.items(),
+            key=lambda kv: sum(kv[1]["counts"]),
+            reverse=True,
+        )[:top]
+        print("\nspan durations (ms; p50/p95 are histogram upper edges):")
+        print(f"  {'span':42} {'count':>8} {'p50':>7} {'p95':>7} {'max':>9}")
+        for name, h in ranked:
+            p50 = _hist_percentile(h["bounds"], h["counts"], 0.50)
+            p95 = _hist_percentile(h["bounds"], h["counts"], 0.95)
+            t = merged["timers"].get(name) or {}
+            mx = t.get("max_s")
+            mx_ms = f"{mx * 1e3:9.2f}" if mx is not None else "        ?"
+            print(
+                f"  {name:42} {sum(h['counts']):8d} {_fmt_ms(p50)} "
+                f"{_fmt_ms(p95)} {mx_ms}"
+            )
+    if merged["span_self"]:
+        ranked = sorted(
+            merged["span_self"].items(),
+            key=lambda kv: kv[1]["self_s"],
+            reverse=True,
+        )[:5]
+        print("\ntop 5 ops by self time (excl. enclosed spans):")
+        for name, t in ranked:
+            tot = merged["timers"].get(name, {}).get("total_s")
+            frac = (
+                f" ({100.0 * t['self_s'] / tot:.0f}% of span)"
+                if tot else ""
+            )
+            print(
+                f"  {name:42} {t['self_s']:9.3f}s over "
+                f"{t['count']} calls{frac}"
+            )
+
+
+def summarize_metrics(raw: list, top: int = 10, merged=None) -> None:
     """Print top-N ops by total time and byte counters by volume from
-    the entries' "metrics" blocks; quiet note when absent (old files)."""
-    merged = _merge_metrics(raw)
+    the entries' "metrics" blocks; quiet note when absent (old files).
+    Pass a precomputed ``_merge_metrics(raw)`` to avoid re-folding."""
+    if merged is None:
+        merged = _merge_metrics(raw)
     if not merged["timers"] and not merged["bytes"]:
         print("\nno metrics blocks (pre-observability BENCH file)")
         return
@@ -231,7 +347,9 @@ def main() -> None:
     entries, raw = _load(path)
     if not entries:
         print("no measured entries")
-        summarize_metrics(raw)
+        merged = _merge_metrics(raw)
+        summarize_metrics(raw, merged=merged)
+        summarize_spans(raw, merged=merged)
         summarize_compile_cache(raw)
         summarize_failures(raw)
         return
@@ -254,7 +372,9 @@ def main() -> None:
     )
     if extra:
         print("\nother measured entries:", ", ".join(extra))
-    summarize_metrics(raw)
+    merged = _merge_metrics(raw)
+    summarize_metrics(raw, merged=merged)
+    summarize_spans(raw, merged=merged)
     summarize_compile_cache(raw)
     summarize_failures(raw)
 

@@ -1,0 +1,122 @@
+"""Convert a flight-recorder dump into a chrome://tracing / Perfetto JSON.
+
+Input is either:
+
+* a ``SPARK_RAPIDS_TPU_FLIGHT_DUMP`` file (``{"events": [...], ...}``,
+  written at exit / SIGTERM by utils/flight.py), or
+* a bench output file (``BENCH_r*.json`` or the raw bench stdout): the
+  last parseable JSON line is scanned and every structured failure
+  record's ``flight_tail`` is concatenated into one timeline — the
+  postmortem view of a run that died with ``"device unreachable"``.
+
+Usage:
+    python tools/trace2chrome.py flight.json [-o trace.json]
+
+Open the output at https://ui.perfetto.dev ("Open trace file") or
+chrome://tracing ("Load"). Spans appear as per-thread tracks grouped by
+subsystem category (dispatch, wire, bucketed, shuffle, ...); counter
+samples (``resident.live``, ``bucket.pad_waste_bytes``) appear as
+counter tracks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+# the converter itself is pure stdlib, but importing the package pulls
+# jax in — keep a converter-only import off the accelerator plugin
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spark_rapids_jni_tpu.utils.tracing import to_chrome_trace  # noqa: E402
+
+
+def _events_from(doc) -> list:
+    """Flight events from a flight dump or a bench summary document."""
+    if isinstance(doc, dict) and isinstance(doc.get("events"), list):
+        return doc["events"]
+    events = []
+    if isinstance(doc, dict):
+        # bench headline line: collect every failure record's tail
+        summary = doc.get("parsed") or doc
+        for e in summary.get("configs", []) or []:
+            f = e.get("failure")
+            if isinstance(f, dict) and isinstance(
+                f.get("flight_tail"), list
+            ):
+                events.extend(f["flight_tail"])
+    # several configs may carry the same parent-process tail: dedup by
+    # (seq, t_ns) so the timeline doesn't stack identical spans
+    seen = set()
+    out = []
+    for e in events:
+        key = (e.get("seq"), e.get("t_ns"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(e)
+    return out
+
+
+def load_events(path: str) -> list:
+    """Parse ``path`` as one JSON doc, or line-wise (bench stdout /
+    BENCH_r*.json: take the LAST parseable line, the analyze_bench
+    discipline)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return _events_from(json.loads(text))
+    except json.JSONDecodeError:
+        doc = None
+        for line in text.splitlines():
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        if doc is None:
+            raise
+        return _events_from(doc)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="flight-recorder dump -> Chrome-trace/Perfetto JSON"
+    )
+    ap.add_argument("input", help="flight dump or bench JSON file")
+    ap.add_argument(
+        "-o", "--output",
+        help="output path (default: <input>.trace.json)",
+    )
+    args = ap.parse_args(argv)
+    events = load_events(args.input)
+    if not events:
+        print(
+            f"trace2chrome: no flight events in {args.input!r} "
+            "(was SPARK_RAPIDS_TPU_FLIGHT_DUMP / FLIGHT enabled?)",
+            file=sys.stderr,
+        )
+        return 1
+    trace = to_chrome_trace(events)
+    out_path = args.output or args.input + ".trace.json"
+    with open(out_path, "w") as f:
+        json.dump(trace, f, indent=1, sort_keys=True)
+        f.write("\n")
+    spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    counters = {
+        e["name"] for e in trace["traceEvents"] if e.get("ph") == "C"
+    }
+    print(
+        f"wrote {out_path}: {len(trace['traceEvents'])} trace events "
+        f"({spans} spans, {len(counters)} counter tracks) — open at "
+        "https://ui.perfetto.dev"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
