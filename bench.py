@@ -808,6 +808,141 @@ def bench_bucketed_stream(platform, n_batches=12):
     }
 
 
+def bench_fused_plan(platform, n_batches=16):
+    """Plan-fusion bench (ISSUE 4 tentpole): the SAME 4-op chain
+    (filter -> cast -> sort_by -> groupby) over a ragged stream of
+    device-resident tables, dispatched per-op (four executable launches
+    + three materialized intermediate tables per batch) vs through
+    ``table_plan_resident`` (ONE fused executable launch per batch once
+    the cache is warm). Launch counts come from the compile cache's
+    hit+miss counters — every cached_jit call is one executable launch
+    — and the ``plan.*`` counters ride along in a structured ``fusion``
+    block. SRT_BENCH_PLAN_ROWS shrinks the shape for smoke runs
+    (ci/smoke-observability.sh drives this config)."""
+    import os as _os
+    import time as _time
+
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import runtime_bridge as rb
+    from spark_rapids_jni_tpu.utils import buckets as buckets_mod
+    from spark_rapids_jni_tpu.utils import metrics as srt_metrics
+
+    _metrics_enable()  # the launch/fusion counters ARE this config's story
+    # default shape sits in the launch-overhead-sensitive regime (the
+    # regime fusion targets — many small ragged ColumnarBatches);
+    # SRT_BENCH_PLAN_ROWS scales it up/down
+    base = int(_os.environ.get("SRT_BENCH_PLAN_ROWS", 8_000))
+    rng = np.random.default_rng(37)
+    sizes = sorted(
+        int(s)
+        for s in rng.integers(base // 2, base * 3 // 2 + 2, n_batches)
+    )
+    i64 = int(dt.TypeId.INT64)
+    b8 = int(dt.TypeId.BOOL8)
+    chain = [
+        {"op": "filter", "mask": 2},
+        {"op": "cast", "column": 1, "type_id": int(dt.TypeId.FLOAT64)},
+        {"op": "sort_by", "keys": [{"column": 0}]},
+        {"op": "groupby", "by": [0],
+         "aggs": [{"column": 1, "agg": "sum"},
+                  {"column": 1, "agg": "count"}]},
+    ]
+    batches = []
+    for nn in sizes:
+        kk = rng.integers(0, 1000, nn, dtype=np.int64)
+        vv = rng.integers(-100, 100, nn, dtype=np.int64)
+        mm = (vv > 0).astype(np.uint8)
+        batches.append((nn, kk.tobytes(), vv.tobytes(), mm.tobytes()))
+
+    def upload(nn, kb, vb, mb):
+        return rb.table_upload_wire(
+            [i64, i64, b8], [0, 0, 0], [kb, vb, mb],
+            [None, None, None], nn,
+        )
+
+    def per_op_stream():
+        t0 = _time.perf_counter()
+        total = 0
+        for nn, kb, vb, mb in batches:
+            cur = upload(nn, kb, vb, mb)
+            for op in chain:
+                nxt = rb.table_op_resident(json.dumps(op), [cur])
+                rb.table_free(cur)
+                cur = nxt
+            out = rb.table_download_wire(cur)
+            rb.table_free(cur)
+            total += out[4]
+        return _time.perf_counter() - t0, total
+
+    def fused_stream():
+        t0 = _time.perf_counter()
+        total = 0
+        for nn, kb, vb, mb in batches:
+            tid = upload(nn, kb, vb, mb)
+            res = rb.table_plan_resident(json.dumps(chain), [tid])
+            rb.table_free(tid)
+            out = rb.table_download_wire(res)
+            rb.table_free(res)
+            total += out[4]
+        return _time.perf_counter() - t0, total
+
+    def launches(snap):
+        c = (snap or {}).get("counters", {})
+        return int(c.get("compile_cache.hit", 0)) + int(
+            c.get("compile_cache.miss", 0)
+        )
+
+    warm_reps = 3  # best-of: one warm pass is scheduler-noise-bound
+
+    buckets_mod.cache_clear()
+    srt_metrics.reset()
+    per_cold_s, per_total = per_op_stream()
+    srt_metrics.reset()
+    per_warm_s, _ = per_op_stream()
+    per_launches = launches(_metrics_snapshot())
+    for _ in range(warm_reps - 1):
+        per_warm_s = min(per_warm_s, per_op_stream()[0])
+    buckets_mod.cache_clear()
+    srt_metrics.reset()
+    fused_cold_s, fused_total = fused_stream()
+    # reset so the launch count and the entry's metrics block cover
+    # only WARM fused passes (no compile-phase noise)
+    srt_metrics.reset()
+    fused_warm_s, _ = fused_stream()
+    snap = _metrics_snapshot() or {}
+    fused_launches = launches(snap)
+    for _ in range(warm_reps - 1):
+        fused_warm_s = min(fused_warm_s, fused_stream()[0])
+    ctr = snap.get("counters", {})
+    assert per_total == fused_total, "fused plan changed results"
+    return {
+        "config": "dispatch",
+        "name": f"fused_plan_{n_batches}x{len(chain)}op",
+        "rows": sum(s[0] for s in batches),
+        "distinct_batch_sizes": len(set(sizes)),
+        "per_op_cold_seconds": round(per_cold_s, 4),
+        "per_op_warm_seconds": round(per_warm_s, 4),
+        "fused_cold_seconds": round(fused_cold_s, 4),
+        "fused_warm_seconds": round(fused_warm_s, 4),
+        "cold_speedup": round(per_cold_s / fused_cold_s, 2),
+        "warm_speedup": round(per_warm_s / fused_warm_s, 2),
+        "fusion": {
+            "chain_ops": len(chain),
+            "batches": n_batches,
+            "plan_calls": int(ctr.get("plan.calls", 0)),
+            "segments": int(ctr.get("plan.segments", 0)),
+            "fused_segments": int(ctr.get("plan.fused_segments", 0)),
+            "fused_ops": int(ctr.get("plan.fused_ops", 0)),
+            "exact_ops": int(ctr.get("plan.exact_ops", 0)),
+            "fallbacks": int(ctr.get("plan.fallbacks", 0)),
+            "fused_launches": fused_launches,
+            "per_op_launches": per_launches,
+            "launches_saved": per_launches - fused_launches,
+        },
+        "platform": platform,
+    }
+
+
 def bench_resident_chain(platform, n=None):
     """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
     through device-RESIDENT table handles vs the bytes-wire path that
@@ -1330,6 +1465,7 @@ _SUBPROCESS_CONFIGS = {
     "strings": bench_strings,
     "resident": bench_resident_chain,
     "bucketed_stream": bench_bucketed_stream,
+    "fused_plan": bench_fused_plan,
     "parquet": bench_parquet_pipeline,
     "parquet_device": bench_parquet_device,
     "tpcds": bench_tpcds,
@@ -1350,7 +1486,7 @@ _LADDER = (
     "groupby16m_flat_gather", "groupby16m_flat_sort", "groupby16m_gather",
     "groupby16m_packed_pallas32", "chunk_sort_ab",
     "strings", "transpose", "transpose_pallas", "resident",
-    "bucketed_stream", "parquet", "parquet_device",
+    "bucketed_stream", "fused_plan", "parquet", "parquet_device",
     # 100M tier: likely winners first
     "groupby100m_flat_gather", "groupby100m_gather", "groupby100m",
     "groupby100m_packed_pallas32", "groupby100m_packed",

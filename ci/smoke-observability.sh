@@ -61,3 +61,38 @@ print(
     f"subsystems={sorted(cats)}, counters={sorted(counters)}"
 )
 PY
+
+# plan-fusion observability (ISSUE 4): a fused plan run under
+# METRICS+FLIGHT must land the plan.* counters in the metrics dump and
+# its per-segment spans must convert into the Chrome trace
+export SPARK_RAPIDS_TPU_METRICS_DUMP="$out/metrics_plan.json"
+export SPARK_RAPIDS_TPU_FLIGHT_DUMP="$out/flight_plan.json"
+export SRT_BENCH_PLAN_ROWS=4000
+
+python3 bench.py --one fused_plan
+
+test -s "$out/metrics_plan.json"
+test -s "$out/flight_plan.json"
+python3 -m json.tool "$out/metrics_plan.json" > /dev/null
+python3 tools/trace2chrome.py "$out/flight_plan.json" -o "$out/trace_plan.json"
+python3 - "$out/metrics_plan.json" "$out/trace_plan.json" <<'PY'
+import json
+import sys
+
+m = json.load(open(sys.argv[1]))
+c = m.get("counters", {})
+assert c.get("plan.segments", 0) > 0, c
+assert c.get("plan.fused_ops", 0) > 0, c
+trace = json.load(open(sys.argv[2]))
+events = trace["traceEvents"]
+assert events, "empty plan trace"
+spans = [e for e in events if e["ph"] == "X"]
+seg = [e for e in spans if e["name"].split("/")[-1] == "plan.segment"]
+assert seg, sorted({e["name"] for e in spans})
+assert "plan" in {e["cat"] for e in spans}
+print(
+    "plan fusion smoke OK:",
+    {k: v for k, v in sorted(c.items()) if k.startswith("plan.")},
+    f"+ {len(seg)} plan.segment spans in trace",
+)
+PY

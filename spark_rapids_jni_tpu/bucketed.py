@@ -31,7 +31,6 @@ performance, never results.
 
 from __future__ import annotations
 
-import json
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
@@ -121,13 +120,7 @@ def _finish(padded_out: Table, logical) -> Table:
 
 
 def _key(kind: str, op: dict, *tables: Table, extra: tuple = ()) -> tuple:
-    return (
-        kind,
-        json.dumps(op, sort_keys=True),
-        tuple(buckets.table_signature(t) for t in tables),
-        tuple(t.row_count for t in tables),
-        extra,
-    )
+    return buckets.cache_key(kind, op, tables, extra)
 
 
 # ---------------------------------------------------------------------------

@@ -251,6 +251,27 @@ def unpad_table(table: Table) -> Table:
     return buckets.unpad_table(table)
 
 
+def run_plan(
+    ops: Sequence[dict],
+    table: Table,
+    rest: Sequence[Table] = (),
+    unpad: bool = True,
+) -> Table:
+    """Python-level plan entry: execute a JSON-able op LIST (the
+    ``table_plan_wire``/``table_plan_resident`` format) over
+    device-resident Tables. Maximal runs of fusable ops compile into
+    single cached executables (plan.py) — one launch per segment —
+    and boundary ops dispatch per-op. ``unpad=True`` (default) returns
+    an exact-shape result; pass ``unpad=False`` to keep the
+    bucket-padded table (``Table.logical_rows`` carries the real
+    count) when feeding another plan or bucketed op."""
+    from . import plan as plan_mod
+    from .utils import buckets
+
+    out = plan_mod.run_plan(list(ops), table, tuple(rest))
+    return buckets.unpad_table(out) if unpad else out
+
+
 # ---------------------------------------------------------------------------
 # validity bitmask packing (Arrow wire form <-> device bool vectors)
 # ---------------------------------------------------------------------------

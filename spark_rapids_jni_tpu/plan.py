@@ -1,0 +1,351 @@
+"""Plan compiler: whole op chains fused into single cached executables.
+
+``runtime_bridge.table_plan_wire``/``table_plan_resident`` accept a JSON
+*list* of ops instead of a single op. This module segments the list into
+maximal runs of fusable single-table bucketable ops and compiles each
+run into ONE jitted callable cached under a ``(plan signature, schema
+signature, bucket)`` key via the same ``utils/buckets.cached_jit`` the
+per-op bucketed runners use. Intermediates inside a segment stay traced
+values: they never materialize as resident tables, never re-enter
+Python, and the whole segment costs one executable launch — the
+Weld/Photon-style lazy-fusion step layered on PR 2's shape buckets.
+
+Fusable ops (single-table, bucketable, ``row_valid``-maskable):
+``cast``, ``filter``, ``rlike``, ``distinct``, ``sort_by``, ``slice``
+(non-negative bounds), and a non-collect ``groupby`` TAIL — a groupby
+may close a fused run but not continue it: its output is a fresh
+keys+aggregates table and the following ops re-enter the compiler on
+the padded result. Everything else (join, concat, explode,
+to_rows/from_rows, ...) is a segment boundary dispatched through the
+existing per-op ``_dispatch`` path — bucketed runner or exact fallback
+— with ``Table.logical_rows`` carried through unchanged so padding
+semantics survive the boundary.
+
+Semantics contract: byte-identical to the per-op path (which is itself
+byte-identical to the exact path — tests/test_buckets.py). ANY failure
+inside a fused segment falls back to per-op replay of that segment, so
+op errors surface from the exact path with their real messages —
+fusion can change launch counts, never results
+(tests/test_plan.py pins both).
+
+Telemetry (``plan.*``, through the metrics registry + flight recorder):
+``plan.calls``/``plan.segments``/``plan.fused_segments``/
+``plan.fused_ops``/``plan.exact_ops``/``plan.fallbacks``/
+``plan.declined`` counters, a ``plan`` span wrapping each run with one
+``plan.segment`` span per segment, ``plan.fallback`` flight instants,
+and the ``compile_cache.miss`` instants ``cached_jit`` already emits
+(fused executables are named ``srt_fused_plan`` so ``jax.log_compiles``
+lines are attributable).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from . import dtype as dt
+from .column import Column, Table
+from .utils import buckets, flight, log, metrics
+
+# single-table ops a fused segment can carry anywhere in its run
+_SIMPLE_FUSABLE = frozenset(
+    {"cast", "filter", "rlike", "distinct", "sort_by", "slice"}
+)
+
+# fused-segment failures are replayed per-op; warn once per op-chain
+# shape (the bucketed._WARNED_OPS discipline), not per call
+_WARNED_SIGS = set()
+
+
+def op_fusable(op: dict) -> bool:
+    """Could this op ride inside a fused segment? (groupby: tail-only,
+    see segment_plan). Mirrors ``bucketed.is_bucketable`` plus ``slice``,
+    minus multi-table ops."""
+    if not isinstance(op, dict):
+        return False  # malformed entries fail loudly in run_plan
+    name = op.get("op")
+    if name in _SIMPLE_FUSABLE:
+        if name == "slice":
+            # negative bounds raise in the exact path; keep that error
+            # surfacing there, not from inside a traced segment
+            try:
+                start = int(op.get("start", 0))
+                stop = op.get("stop")
+                return start >= 0 and (stop is None or int(stop) >= 0)
+            except (TypeError, ValueError):
+                return False
+        return True
+    if name == "groupby":
+        from .ops.groupby import _COLLECT_OPS
+
+        # collect_* needs a data-dependent list-capacity pre-pass the
+        # exact path owns (the bucketed-runner decline, applied early)
+        return not any(
+            a.get("agg") in _COLLECT_OPS for a in op.get("aggs", ())
+        )
+    return False
+
+
+def segment_plan(ops: Sequence[dict]) -> List[Tuple[str, list]]:
+    """Split a plan into ``[(kind, ops)]`` segments: ``"fused"`` (a run
+    of >= 2 fusable ops compiled as one executable) or ``"exact"`` (a
+    single op through the per-op dispatch — non-fusable ops, and
+    1-op runs, which the per-op bucketed runners already cache under
+    their own keys). A groupby is tail-only: it closes the run it ends."""
+    segs: List[Tuple[str, list]] = []
+    cur: list = []
+
+    def flush():
+        nonlocal cur
+        if not cur:
+            return
+        if len(cur) >= 2:
+            segs.append(("fused", cur))
+        else:
+            segs.extend(("exact", [o]) for o in cur)
+        cur = []
+
+    for op in ops:
+        if op_fusable(op):
+            cur.append(op)
+            if op.get("op") == "groupby":
+                flush()
+        else:
+            flush()
+            segs.append(("exact", [op]))
+    flush()
+    return segs
+
+
+# ---------------------------------------------------------------------------
+# fused per-op appliers — each runs INSIDE the traced segment, taking
+# (op, padded table, device logical count, row_valid occupancy) and
+# returning (table at the same physical shape, new device count). The
+# occupancy mask is recomputed per step from the flowing count, so a
+# filter's clone-padded tail is dead for everything downstream.
+# ---------------------------------------------------------------------------
+
+
+def _fused_cast(op, t, n, rv):
+    ci = int(op["column"])
+    target = dt.DType(dt.TypeId(op["type_id"]), op.get("scale", 0))
+    src = t.columns[ci]
+    if src.dtype.is_string or target.is_string:
+        from .ops import strings as strings_mod
+
+        out = strings_mod.cast(src, target)
+    else:
+        from .ops.cast import cast as cast_fn
+
+        out = cast_fn(src, target)
+    cols = list(t.columns)
+    cols[ci] = out
+    return Table(cols, t.names), n
+
+
+def _fused_filter(op, t, n, rv):
+    from .ops.filter import filter_table_capped
+
+    mi = int(op["mask"])
+    mask = t.columns[mi]
+    # the occupancy gate: padding tails can hold arbitrary garbage
+    # (e.g. an upstream capped filter clones kept rows)
+    keep = Column(
+        jnp.logical_and(mask.data, rv), mask.dtype, mask.validity
+    )
+    kept = Table(
+        [c for i, c in enumerate(t.columns) if i != mi]
+    )  # names dropped exactly like the exact-path dispatch
+    return filter_table_capped(kept, keep, capacity=t.row_count)
+
+
+def _fused_rlike(op, t, n, rv):
+    from .ops import regex as regex_mod
+    from .ops.filter import filter_table_capped
+
+    mask = regex_mod.contains_re(
+        t.columns[int(op["column"])], op["pattern"]
+    )
+    # padding rows are zero-length strings: a pattern matching the
+    # empty string would select them without the gate
+    keep = Column(
+        jnp.logical_and(mask.data, rv), mask.dtype, mask.validity
+    )
+    return filter_table_capped(t, keep, capacity=t.row_count)
+
+
+def _fused_distinct(op, t, n, rv):
+    from .ops.compaction import distinct_capped
+
+    return distinct_capped(
+        t, op.get("keys"), capacity=t.row_count, row_valid=rv
+    )
+
+
+def _fused_sort(op, t, n, rv):
+    from .ops.sort import SortKey, sort_table
+
+    ks = [
+        SortKey(k["column"], ascending=k.get("ascending", True))
+        for k in op["keys"]
+    ]
+    return sort_table(t, ks, row_valid=rv), n
+
+
+def _fused_slice(op, t, n, rv):
+    from .ops.filter import filter_table_capped
+
+    # exact-path semantics (start/stop clamped to the LOGICAL count)
+    # expressed against the device scalar: keep rows [s, e) of the
+    # first n, compacted to the front at the same physical shape.
+    # Host-side clamp to the physical row count first: n <= row_count,
+    # so the clamp is semantics-free and keeps a giant (>= 2^31) but
+    # valid bound from overflowing the int32 conversion
+    cap = t.row_count
+    s = jnp.minimum(jnp.int32(min(int(op.get("start", 0)), cap)), n)
+    stop = op.get("stop")
+    e = (
+        n
+        if stop is None
+        else jnp.minimum(jnp.int32(min(int(stop), cap)), n)
+    )
+    e = jnp.maximum(s, e)
+    iota = jnp.arange(t.row_count, dtype=jnp.int32)
+    keep = jnp.logical_and(iota >= s, iota < e)
+    return filter_table_capped(
+        t, Column(keep, dt.BOOL8, None), capacity=t.row_count
+    )
+
+
+def _fused_groupby(op, t, n, rv):
+    from .ops.groupby import GroupbyAgg, groupby_aggregate_capped
+
+    aggs = [GroupbyAgg(a["column"], a["agg"]) for a in op["aggs"]]
+    return groupby_aggregate_capped(
+        t, list(op["by"]), aggs, num_segments=t.row_count, row_valid=rv
+    )
+
+
+_FUSED = {
+    "cast": _fused_cast,
+    "filter": _fused_filter,
+    "rlike": _fused_rlike,
+    "distinct": _fused_distinct,
+    "sort_by": _fused_sort,
+    "slice": _fused_slice,
+    "groupby": _fused_groupby,
+}
+
+
+def _run_segment_traced(seg_ops: Sequence[dict], t: Table, n):
+    """The traced body of one fused segment: thread (table, count)
+    through every op at the segment's one physical shape."""
+    for op in seg_ops:
+        rv = buckets.tail_valid(t.row_count, n)
+        t, n = _FUSED[op["op"]](op, t, n, rv)
+        if hasattr(n, "astype"):
+            n = n.astype(jnp.int32)
+    return t, n
+
+
+def _run_fused(seg_ops: Sequence[dict], table: Table) -> Table:
+    """One fused segment -> one cached executable -> one launch."""
+    from . import bucketed
+
+    pt = bucketed._padded_input(table)  # _Decline when unbucketable
+    key = buckets.cache_key("plan", list(seg_ops), (pt,))
+
+    def build():
+        def fn(t, n):
+            return _run_segment_traced(seg_ops, t, n)
+
+        return fn
+
+    fn = buckets.cached_jit(key, build, "srt_fused_plan")
+    out, count = fn(bucketed._strip(pt), bucketed._n_dev(pt))
+    return bucketed._finish(out, int(count))
+
+
+def _take_rest(op: dict, orig_rest: tuple, queue: list) -> list:
+    """Extra input tables for a multi-table fallback op: an explicit
+    ``"rest"`` field names indices into the plan call's extra-table
+    list; otherwise join/cross_join consume the next unconsumed extra
+    table and concat consumes everything left."""
+    idxs = op.get("rest")
+    if idxs is not None:
+        return [orig_rest[int(i)] for i in idxs]
+    name = op.get("op")
+    if name in ("join", "cross_join"):
+        return [queue.pop(0)] if queue else []
+    if name == "concat":
+        out = list(queue)
+        queue.clear()
+        return out
+    return []
+
+
+def run_plan(
+    ops: Sequence[dict], table: Table, rest: Sequence[Table] = ()
+) -> Table:
+    """Execute a plan (a list of op dicts) over ``table``; returns the
+    final (possibly padded) Table. The chain's flowing table is always
+    the FIRST input of every op; ``rest`` supplies extra tables for
+    multi-table segment-boundary ops (see ``_take_rest``)."""
+    from . import bucketed, runtime_bridge
+
+    if not isinstance(ops, (list, tuple)):
+        raise TypeError("plan must be a JSON list of op objects")
+    if not ops:
+        return table
+    for op in ops:
+        if not isinstance(op, dict) or "op" not in op:
+            raise ValueError(f"plan entries must be op objects, got {op!r}")
+    orig_rest = tuple(rest)
+    queue = list(orig_rest)
+    if buckets.enabled():
+        segs = segment_plan(ops)
+    else:
+        # debugging mode: the whole plan runs per-op on the exact path
+        segs = [("exact", [op]) for op in ops]
+    metrics.counter_add("plan.calls")
+    metrics.counter_add("plan.segments", len(segs))
+    with metrics.span("plan", segments=len(segs), ops=len(ops)):
+        for i, (kind, seg_ops) in enumerate(segs):
+            with metrics.span(
+                "plan.segment", index=i, kind=kind, ops=len(seg_ops)
+            ):
+                replay = seg_ops
+                if kind == "fused":
+                    try:
+                        table = _run_fused(seg_ops, table)
+                        metrics.counter_add("plan.fused_segments")
+                        metrics.counter_add("plan.fused_ops", len(seg_ops))
+                        replay = ()
+                    except bucketed._Decline:
+                        # not a failure: no bucket for this shape —
+                        # the per-op path owns it
+                        metrics.counter_add("plan.declined")
+                    except Exception as e:
+                        # fusion must never change semantics: replay
+                        # per-op; the exact path raises the real error
+                        # if an op itself is at fault
+                        metrics.counter_add("plan.fallbacks")
+                        names = ",".join(
+                            str(o.get("op", "?")) for o in seg_ops
+                        )
+                        if flight.enabled():
+                            flight.record("I", "plan.fallback", names)
+                        if names not in _WARNED_SIGS:
+                            _WARNED_SIGS.add(names)
+                            log.log(
+                                "WARN", "plan", "fused_segment_failed",
+                                ops=names,
+                                error=f"{type(e).__name__}: {str(e)[:200]}",
+                            )
+                for op in replay:
+                    table = runtime_bridge._dispatch(
+                        op, table, _take_rest(op, orig_rest, queue)
+                    )
+                    metrics.counter_add("plan.exact_ops")
+    return table

@@ -59,9 +59,24 @@ def _padded_from_offsets(
 
     Shared by the STRING and LIST branches: int32 offsets[num_rows+1]
     followed by the concatenated payload values, decoded into the
-    padded-matrix device layout."""
+    padded-matrix device layout. Offsets are untrusted wire input and
+    validated up front: a corrupt buffer with negative or non-monotonic
+    offsets would otherwise yield negative lengths and a silently wrong
+    row mask (``arange < lens`` is all-False for a negative length, so
+    payload bytes would land in the WRONG rows without any error)."""
+    if len(data) < 4 * (num_rows + 1):
+        raise ValueError(
+            f"{label} wire buffer holds {len(data)} bytes, "
+            f"{4 * (num_rows + 1)} needed for {num_rows + 1} offsets"
+        )
     offs = np.frombuffer(data, np.int32, num_rows + 1)
     lens = np.diff(offs).astype(np.int32)
+    if int(offs[0]) != 0 or (num_rows and bool((lens < 0).any())):
+        raise ValueError(
+            f"{label} wire offsets corrupt: must start at 0 and be "
+            f"non-decreasing (first={int(offs[0])}, "
+            f"min diff={int(lens.min()) if num_rows else 0})"
+        )
     need = 4 * (num_rows + 1) + child_np.itemsize * int(offs[-1])
     if len(data) < need:
         raise ValueError(
@@ -78,12 +93,54 @@ def _padded_from_offsets(
     return mat, lens
 
 
-def _padded_to_offsets(mat: np.ndarray, lens: np.ndarray) -> bytes:
+class _SerializePass:
+    """Scratch state for ONE wire-serialize pass over a table.
+
+    The STRING/LIST branch needs an ``(n, pad)`` boolean row mask per
+    column; a multi-column table re-derives byte-identical ``arange``
+    rows and re-allocates the mask buffer for every column of the same
+    shape. One pass object caches the ``arange`` per pad width and
+    reuses ONE mask buffer per ``(n, pad)`` shape (refilled in place —
+    each column's mask is consumed before the next is built). Saved
+    allocations are counted in ``wire.serialize.saved_bytes``."""
+
+    __slots__ = ("_aranges", "_masks")
+
+    def __init__(self):
+        self._aranges = {}
+        self._masks = {}
+
+    def arange(self, pad: int) -> np.ndarray:
+        a = self._aranges.get(pad)
+        if a is None:
+            a = self._aranges[pad] = np.arange(pad)
+        return a
+
+    def row_mask(self, lens: np.ndarray, pad: int) -> np.ndarray:
+        buf = self._masks.get((lens.shape[0], pad))
+        if buf is None:
+            buf = self._masks[(lens.shape[0], pad)] = np.empty(
+                (lens.shape[0], pad), np.bool_
+            )
+        else:
+            metrics.bytes_add("wire.serialize.saved_bytes", buf.nbytes)
+        np.less(self.arange(pad)[None, :], lens[:, None], out=buf)
+        return buf
+
+
+def _padded_to_offsets(
+    mat: np.ndarray, lens: np.ndarray, ctx: Optional[_SerializePass] = None
+) -> bytes:
     """(n, pad) matrix + lengths -> offsets+payload wire bytes."""
     offs = np.zeros((lens.shape[0] + 1,), np.int32)
     np.cumsum(lens, out=offs[1:])
-    mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
-    flat = np.ascontiguousarray(mat[mask])
+    if ctx is not None:
+        mask = ctx.row_mask(lens, mat.shape[1])
+    else:
+        mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
+    # fancy indexing already yields a fresh contiguous array — no
+    # ascontiguousarray copy on top
+    flat = mat[mask]
     return offs.tobytes() + flat.tobytes()
 
 
@@ -177,7 +234,10 @@ def _column_from_wire(
     return Column.from_numpy(arr, validity=v, dtype=d)
 
 
-def _column_to_wire(c: Column, rows: Optional[int] = None):
+def _column_to_wire(
+    c: Column, rows: Optional[int] = None,
+    ctx: Optional[_SerializePass] = None,
+):
     """(type_id, scale, data bytes, valid bytes | None).
 
     LIST columns use the convention documented in _column_from_wire:
@@ -186,8 +246,9 @@ def _column_to_wire(c: Column, rows: Optional[int] = None):
     ``rows`` slices a shape-bucket-padded column back to its logical
     row count on the HOST side (after the device fetch) — the padding
     never reaches the wire and the slice costs no XLA compile.
+    ``ctx`` is the per-serialize-pass scratch (mask-buffer reuse).
     """
-    out = _column_to_wire_impl(c, rows)
+    out = _column_to_wire_impl(c, rows, ctx)
     if metrics.enabled():
         metrics.bytes_add(
             "wire.bytes_out",
@@ -201,7 +262,10 @@ def _host_rows(arr: np.ndarray, rows: Optional[int]) -> np.ndarray:
     return arr if rows is None else arr[:rows]
 
 
-def _column_to_wire_impl(c: Column, rows: Optional[int] = None):
+def _column_to_wire_impl(
+    c: Column, rows: Optional[int] = None,
+    ctx: Optional[_SerializePass] = None,
+):
     if c.dtype.id == dt.TypeId.STRING:
         valid = (
             None
@@ -215,6 +279,7 @@ def _column_to_wire_impl(c: Column, rows: Optional[int] = None):
             _padded_to_offsets(
                 _host_rows(np.asarray(c.data), rows),
                 _host_rows(np.asarray(c.lengths), rows).astype(np.int32),
+                ctx,
             ),
             valid,
         )
@@ -232,10 +297,14 @@ def _column_to_wire_impl(c: Column, rows: Optional[int] = None):
             _padded_to_offsets(
                 _host_rows(np.asarray(c.data), rows),
                 _host_rows(np.asarray(c.lengths), rows).astype(np.int32),
+                ctx,
             ),
             valid,
         )
-    host = np.ascontiguousarray(_host_rows(np.asarray(c.data), rows))
+    # tobytes() emits C-order bytes from any layout in one copy — an
+    # ascontiguousarray on top would only add a second copy for
+    # non-contiguous slices
+    host = _host_rows(np.asarray(c.data), rows)
     valid = (
         None
         if c.validity is None
@@ -409,6 +478,51 @@ def _dispatch_impl(
     raise ValueError(f"unknown table op {name!r}")
 
 
+def _table_from_wire(
+    type_ids: Sequence[int],
+    scales: Sequence[int],
+    datas: Sequence[Optional[bytes]],
+    valids: Sequence[Optional[bytes]],
+    num_rows: int,
+    pad_to: Optional[int],
+) -> Table:
+    """One wire-deserialize pass -> a (possibly host-padded) Table."""
+    if flight.enabled():
+        flight.record(
+            "I", "wire.in",
+            sum(len(d) for d in datas if d is not None),
+        )
+    with metrics.span("wire.deserialize"):
+        cols = [
+            _column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
+            for t, s, d, v in zip(type_ids, scales, datas, valids)
+        ]
+    tbl = Table(cols, logical_rows=num_rows if pad_to is not None else None)
+    if pad_to is not None:
+        buckets.note_padded(tbl)
+    return tbl
+
+
+def _table_to_wire(t: Table):
+    """One wire-serialize pass -> the 5-tuple every wire entry returns
+    (shape-bucket padding sliced away host-side; one shared
+    ``_SerializePass`` scratch across the table's columns)."""
+    out_t, out_s, out_d, out_v = [], [], [], []
+    ctx = _SerializePass()
+    with metrics.span("wire.serialize"):
+        for c in t.columns:
+            ti, s, d, v = _column_to_wire(c, t.logical_rows, ctx)
+            out_t.append(ti)
+            out_s.append(s)
+            out_d.append(d)
+            out_v.append(v)
+    if flight.enabled():
+        flight.record(
+            "I", "wire.out", sum(len(d) for d in out_d if d is not None)
+        )
+    return out_t, out_s, out_d, out_v, int(t.logical_row_count)
+
+
 def table_op_wire(
     op_json: str,
     type_ids: Sequence[int],
@@ -431,33 +545,48 @@ def table_op_wire(
         # unpad slice for nothing
         if bucketed.is_bucketable(op):
             pad_to = buckets.bucket_for(num_rows)
-    if flight.enabled():
-        flight.record(
-            "I", "wire.in",
-            sum(len(d) for d in datas if d is not None),
-        )
-    with metrics.span("wire.deserialize"):
-        cols = [
-            _column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
-            for t, s, d, v in zip(type_ids, scales, datas, valids)
-        ]
-    tbl = Table(cols, logical_rows=num_rows if pad_to is not None else None)
-    if pad_to is not None:
-        buckets.note_padded(tbl)
+    tbl = _table_from_wire(
+        type_ids, scales, datas, valids, num_rows, pad_to
+    )
     result = _dispatch(op, tbl)
-    out_t, out_s, out_d, out_v = [], [], [], []
-    with metrics.span("wire.serialize"):
-        for c in result.columns:
-            t, s, d, v = _column_to_wire(c, result.logical_rows)
-            out_t.append(t)
-            out_s.append(s)
-            out_d.append(d)
-            out_v.append(v)
-    if flight.enabled():
-        flight.record(
-            "I", "wire.out", sum(len(d) for d in out_d if d is not None)
-        )
-    return out_t, out_s, out_d, out_v, int(result.logical_row_count)
+    return _table_to_wire(result)
+
+
+def table_plan_wire(
+    plan_json: str,
+    type_ids: Sequence[int],
+    scales: Sequence[int],
+    datas: Sequence[Optional[bytes]],
+    valids: Sequence[Optional[bytes]],
+    num_rows: int,
+):
+    """C-ABI plan entry: ``plan_json`` is a JSON LIST of ops executed
+    as a fused plan (plan.py) over ONE wire table — upload once, every
+    fusable run costs one executable launch, download once. Returns the
+    same 5-tuple as ``table_op_wire``."""
+    from . import bucketed, plan as plan_mod
+
+    ops = json.loads(plan_json)
+    if not isinstance(ops, list):
+        raise TypeError("table_plan_wire: plan must be a JSON list of ops")
+    pad_to = None
+    if buckets.enabled() and ops and isinstance(ops[0], dict):
+        # pad only when the FIRST segment can consume the padding (a
+        # fused segment, or a 1-op segment with a bucketed runner) —
+        # the table_op_wire gate applied at segment granularity, so a
+        # plan opening with e.g. a lone slice doesn't pay a padded
+        # upload just to unpad on the exact path; malformed entries
+        # fall through to run_plan's loud validation
+        segs = plan_mod.segment_plan(ops)
+        if segs and (
+            segs[0][0] == "fused" or bucketed.is_bucketable(segs[0][1][0])
+        ):
+            pad_to = buckets.bucket_for(num_rows)
+    tbl = _table_from_wire(
+        type_ids, scales, datas, valids, num_rows, pad_to
+    )
+    result = plan_mod.run_plan(ops, tbl)
+    return _table_to_wire(result)
 
 
 def platform() -> str:
@@ -560,15 +689,9 @@ def table_upload_wire(
     row count — a chain of bucketed ops then reuses one compiled
     executable per bucket with no repadding."""
     pad_to = buckets.bucket_for(num_rows) if buckets.enabled() else None
-    with metrics.span("wire.deserialize"):
-        cols = [
-            _column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
-            for t, s, d, v in zip(type_ids, scales, datas, valids)
-        ]
-    tbl = Table(cols, logical_rows=num_rows if pad_to is not None else None)
-    if pad_to is not None:
-        buckets.note_padded(tbl)
-    return _resident_put(tbl)
+    return _resident_put(
+        _table_from_wire(type_ids, scales, datas, valids, num_rows, pad_to)
+    )
 
 
 def table_op_resident(op_json: str, table_ids: Sequence[int]) -> int:
@@ -585,19 +708,30 @@ def table_op_resident(op_json: str, table_ids: Sequence[int]) -> int:
     return _resident_put(out)
 
 
+def table_plan_resident(
+    plan_json: str, table_ids: Sequence[int]
+) -> int:
+    """Run a whole PLAN (a JSON list of ops) over resident tables; the
+    result stays resident. ``table_ids[0]`` is the chain input; the
+    remaining ids feed multi-table segment-boundary ops (join/concat —
+    explicit ``"rest"`` indices into this list, or sequential
+    consumption; see plan._take_rest). Fusable runs execute as ONE
+    cached executable each (plan.py), so an N-op chain costs one
+    launch per segment instead of N dispatches."""
+    if not table_ids:
+        raise ValueError("table_plan_resident needs at least one input")
+    from . import plan as plan_mod
+
+    ops = json.loads(plan_json)
+    tables = [_resident_get(t) for t in table_ids]
+    out = plan_mod.run_plan(ops, tables[0], tables[1:])
+    return _resident_put(out)
+
+
 def table_download_wire(table_id: int):
     """Resident table -> the wire 5-tuple of table_op_wire (shape-bucket
     padding sliced away host-side; the wire never sees it)."""
-    t = _resident_get(table_id)
-    out_t, out_s, out_d, out_v = [], [], [], []
-    with metrics.span("wire.serialize"):
-        for c in t.columns:
-            ti, s, d, v = _column_to_wire(c, t.logical_rows)
-            out_t.append(ti)
-            out_s.append(s)
-            out_d.append(d)
-            out_v.append(v)
-    return out_t, out_s, out_d, out_v, int(t.logical_row_count)
+    return _table_to_wire(_resident_get(table_id))
 
 
 def table_num_rows(table_id: int) -> int:

@@ -317,6 +317,24 @@ def table_signature(table) -> tuple:
     return (cols, table.names)
 
 
+def cache_key(kind: str, payload, tables, extra: tuple = ()) -> tuple:
+    """Canonical compiled-executable cache key: ``(kind, canonical
+    payload JSON, per-table schema signatures, per-table physical row
+    counts, extra)``. Shared by the per-op bucketed runners (payload =
+    one op dict) and the plan compiler (payload = a fused segment's op
+    LIST — the plan signature), so every cached executable is keyed the
+    same way and each key sees exactly one input shape signature."""
+    import json
+
+    return (
+        kind,
+        json.dumps(payload, sort_keys=True),
+        tuple(table_signature(t) for t in tables),
+        tuple(t.row_count for t in tables),
+        extra,
+    )
+
+
 # ---------------------------------------------------------------------------
 # compiled-executable cache
 # ---------------------------------------------------------------------------

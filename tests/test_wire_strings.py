@@ -142,3 +142,52 @@ def test_slice_negative_bounds_raise():
             json.dumps({"op": "slice", "start": -2}),
             [I64], [0], [k.tobytes()], [None], 4,
         )
+
+
+# ---------------------------------------------------------------------------
+# corrupt wire offsets: validated loudly, never a silently wrong mask
+# ---------------------------------------------------------------------------
+
+
+def _sort_op():
+    return json.dumps({"op": "sort_by", "keys": [{"column": 0}]})
+
+
+def test_non_monotonic_offsets_raise_with_label():
+    # offsets [0, 3, 1, 4]: row 1 would get length -2 — before the
+    # validation this produced an all-False mask row and shifted every
+    # following row's payload into the wrong slot without any error
+    offs = np.array([0, 3, 1, 4], np.int32)
+    data = offs.tobytes() + b"abcd"
+    with pytest.raises(ValueError, match="STRING wire offsets corrupt"):
+        rb.table_op_wire(_sort_op(), [S], [0], [data], [None], 3)
+
+
+def test_negative_first_offset_raises():
+    offs = np.array([-4, 0, 2], np.int32)
+    data = offs.tobytes() + b"ab"
+    with pytest.raises(ValueError, match="STRING wire offsets corrupt"):
+        rb.table_op_wire(_sort_op(), [S], [0], [data], [None], 2)
+
+
+def test_list_offsets_carry_list_label():
+    offs = np.array([0, 2, 1], np.int32)
+    payload = np.arange(2, dtype=np.int64).tobytes()
+    with pytest.raises(ValueError, match="LIST wire offsets corrupt"):
+        rb.table_op_wire(
+            _sort_op(), [int(dt.TypeId.LIST)], [I64],
+            [offs.tobytes() + payload], [None], 2,
+        )
+
+
+def test_truncated_offsets_block_raises():
+    # buffer shorter than the offsets array itself
+    data = np.array([0, 1], np.int32).tobytes()[:-2]
+    with pytest.raises(ValueError, match="STRING wire buffer holds"):
+        rb.table_op_wire(_sort_op(), [S], [0], [data], [None], 1)
+
+
+def test_valid_offsets_still_pass():
+    data, valid = _string_wire(["ab", "", "xyz"])
+    out = rb.table_op_wire(_sort_op(), [S], [0], [data], [valid], 3)
+    assert out[4] == 3

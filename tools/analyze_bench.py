@@ -9,8 +9,9 @@ eyeballs.
 Also summarizes the per-config "metrics" blocks bench entries carry
 since the observability PR (top ops by time and by bytes moved,
 span-duration p50/p95/max from the ``span_ms.*`` histograms, a top-5
-ops-by-self-time table, plus structured failure records), tolerating
-old BENCH files that predate any of these fields.
+ops-by-self-time table, a plan-fusion summary from the ``plan.*``
+counters and ``fusion`` blocks, plus structured failure records),
+tolerating old BENCH files that predate any of these fields.
 
 Usage: python tools/analyze_bench.py [path-to-state-or-bench-json]
 """
@@ -320,6 +321,50 @@ def summarize_compile_cache(raw: list) -> None:
         )
 
 
+def summarize_plan_fusion(raw: list, merged=None) -> None:
+    """Plan-fusion summary: fused-op fraction and launch savings from
+    the ``plan.*`` counters in the metrics blocks, plus the structured
+    ``fusion`` block the bench ``fused_plan`` config emits (per-op vs
+    fused launch counts). Old BENCH files have neither — silent skip,
+    like the other metrics summaries. Pass a precomputed
+    ``_merge_metrics(raw)`` to avoid re-folding."""
+    if merged is None:
+        merged = _merge_metrics(raw)
+    c = merged["counters"]
+    fused_ops = int(c.get("plan.fused_ops", 0))
+    exact_ops = int(c.get("plan.exact_ops", 0))
+    segments = int(c.get("plan.segments", 0))
+    blocks = [e for e in raw if isinstance(e.get("fusion"), dict)]
+    if not (fused_ops or exact_ops or segments or blocks):
+        return
+    print("\nplan fusion:")
+    if fused_ops or exact_ops or segments:
+        total = fused_ops + exact_ops
+        frac = (100.0 * fused_ops / total) if total else 0.0
+        fused_segs = int(c.get("plan.fused_segments", 0))
+        print(
+            f"  plans={int(c.get('plan.calls', 0))} segments={segments} "
+            f"fused_segments={fused_segs} "
+            f"fallbacks={int(c.get('plan.fallbacks', 0))} "
+            f"declined={int(c.get('plan.declined', 0))}"
+        )
+        print(
+            f"  fused ops {fused_ops}/{total} ({frac:.0f}%), "
+            f"launches saved {fused_ops - fused_segs} "
+            "(vs one launch per fused op)"
+        )
+    for e in blocks:
+        f = e["fusion"]
+        print(
+            f"  {e.get('name', '?'):42} "
+            f"{f.get('fused_launches', '?')} fused vs "
+            f"{f.get('per_op_launches', '?')} per-op launches "
+            f"(saved {f.get('launches_saved', '?')}); "
+            f"warm {e.get('warm_speedup', '?')}x "
+            f"cold {e.get('cold_speedup', '?')}x"
+        )
+
+
 def summarize_failures(raw: list) -> None:
     """Print the structured failure records (diagnosable-from-JSON)."""
     fails = [e for e in raw if isinstance(e.get("failure"), dict)]
@@ -351,6 +396,7 @@ def main() -> None:
         summarize_metrics(raw, merged=merged)
         summarize_spans(raw, merged=merged)
         summarize_compile_cache(raw)
+        summarize_plan_fusion(raw, merged=merged)
         summarize_failures(raw)
         return
     for label, arms in _GROUPS.items():
@@ -376,6 +422,7 @@ def main() -> None:
     summarize_metrics(raw, merged=merged)
     summarize_spans(raw, merged=merged)
     summarize_compile_cache(raw)
+    summarize_plan_fusion(raw, merged=merged)
     summarize_failures(raw)
 
 
