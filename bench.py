@@ -943,6 +943,187 @@ def bench_fused_plan(platform, n_batches=16):
     }
 
 
+def bench_pipelined_stream(platform, n_batches=12, depth=None):
+    """Pipelined-dispatch bench (ISSUE 5 tentpole): the SAME fusable
+    3-op chain (filter -> cast -> cast, one fused segment, donation
+    eligible) over a ragged stream of wire batches, three ways:
+
+      sync per-op   the repo's SYNCHRONOUS resident-stream idiom
+                    (bench_resident_chain / the fused_plan bench's
+                    per-op arm): upload -> one ``table_op_resident``
+                    per op, each blocking, registry round-trips
+                    between ops -> download. The baseline the
+                    ``warm_speedup`` headline is measured against.
+      sync plan     the PR-4 fused flavor of the same synchronous
+                    stream (upload -> ``table_plan_resident`` ->
+                    download), reported as ``sync_plan_warm_seconds``
+                    / ``vs_plan_sync`` so the fusion and pipelining
+                    contributions stay separable.
+      pipelined     one ``table_stream_wire`` call with the pipeline
+                    on: batch N+1's wire decode and batch N-1's wire
+                    encode on background workers while batch N's fused
+                    executable (input donated) runs on the caller.
+
+    WARM throughput is the story (every arm reuses cached
+    executables); byte parity across all three arms is asserted. The
+    structured ``pipeline`` block carries the overlap fraction, stall
+    totals and donated bytes. A wide STRING payload column gives the
+    serde stages the weight they have on real ColumnarBatches (the
+    chain deliberately has no multi-operand sort: serde and compute
+    are then comparable, the regime pipelining targets — a
+    compute-bound stream pins its ceiling at the compute time either
+    way). NOTE on single-core hosts the pipelined margin over the
+    PLAN-sync arm is bounded by the amortized per-batch overhead, not
+    by overlap — there is no second core to overlap onto; the
+    ``host_cpus`` field records what the numbers mean.
+    SRT_BENCH_STREAM_ROWS / SRT_BENCH_PIPELINE_DEPTH shrink/tune it
+    for smoke runs (ci/smoke-observability.sh drives this config)."""
+    import os as _os
+    import time as _time
+
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import pipeline as pipeline_mod
+    from spark_rapids_jni_tpu import runtime_bridge as rb
+    from spark_rapids_jni_tpu.utils import config as srt_config
+    from spark_rapids_jni_tpu.utils import metrics as srt_metrics
+
+    _metrics_enable()  # the overlap/stall/donation counters ARE the story
+    if depth is None:
+        depth = int(_os.environ.get("SRT_BENCH_PIPELINE_DEPTH", 2))
+    base = int(_os.environ.get("SRT_BENCH_STREAM_ROWS", 120_000))
+    rng = np.random.default_rng(41)
+    sizes = sorted(
+        int(s)
+        for s in rng.integers(base // 2, base * 3 // 2 + 2, n_batches)
+    )
+    i64 = int(dt.TypeId.INT64)
+    b8 = int(dt.TypeId.BOOL8)
+    s_t = int(dt.TypeId.STRING)
+    chain = [
+        {"op": "filter", "mask": 2},
+        {"op": "cast", "column": 1, "type_id": int(dt.TypeId.FLOAT64)},
+        {"op": "cast", "column": 0, "type_id": int(dt.TypeId.INT32)},
+    ]
+    plan_json = json.dumps(chain)
+    op_jsons = [json.dumps(op) for op in chain]
+    str_width = 24
+
+    def string_wire(ids):
+        # constant-width payload rows, vectorized (python-str loops
+        # would dominate setup at bench scale)
+        mat = np.full((ids.size, str_width), ord("x"), np.uint8)
+        mat[:, 1] = ord("0") + (ids % 8)
+        offs = np.arange(ids.size + 1, dtype=np.int32) * str_width
+        return offs.tobytes() + mat.tobytes()
+
+    batches = []
+    for nn in sizes:
+        kk = rng.integers(0, 1000, nn, dtype=np.int64)
+        vv = rng.integers(-100, 100, nn, dtype=np.int64)
+        mm = (vv > 0).astype(np.uint8)
+        batches.append((
+            [i64, i64, b8, s_t], [0, 0, 0, 0],
+            [kk.tobytes(), vv.tobytes(), mm.tobytes(), string_wire(kk)],
+            [None, None, None, None], nn,
+        ))
+
+    def per_op_stream():
+        t0 = _time.perf_counter()
+        outs = []
+        for b in batches:
+            cur = rb.table_upload_wire(*b)
+            for oj in op_jsons:
+                nxt = rb.table_op_resident(oj, [cur])
+                rb.table_free(cur)
+                cur = nxt
+            outs.append(rb.table_download_wire(cur))
+            rb.table_free(cur)
+        return _time.perf_counter() - t0, outs
+
+    def plan_stream():
+        t0 = _time.perf_counter()
+        outs = []
+        for b in batches:
+            tid = rb.table_upload_wire(*b)
+            res = rb.table_plan_resident(plan_json, [tid])
+            rb.table_free(tid)
+            outs.append(rb.table_download_wire(res))
+            rb.table_free(res)
+        return _time.perf_counter() - t0, outs
+
+    def piped_stream():
+        t0 = _time.perf_counter()
+        outs = rb.table_stream_wire(plan_json, batches)
+        return _time.perf_counter() - t0, outs
+
+    warm_reps = 3  # best-of: one warm pass is scheduler-noise-bound
+    try:
+        srt_config.set_flag("PIPELINE", "off")
+        sync_cold_s, sync_outs = per_op_stream()
+        sync_warm_s = min(per_op_stream()[0] for _ in range(warm_reps))
+        plan_stream()
+        plan_warm_s = min(plan_stream()[0] for _ in range(warm_reps))
+        off_outs = piped_stream()[1]  # PIPELINE=off == today's sync path
+        srt_config.set_flag("PIPELINE", str(depth))
+        piped_cold_s, piped_outs = piped_stream()
+        # reset so the entry's metrics block and the pipeline numbers
+        # cover only WARM pipelined passes (no compile-phase noise);
+        # the snapshot is taken AFTER all warm reps so overlap_ms and
+        # the wall clock it is divided by cover the same passes
+        srt_metrics.reset()
+        warm_times = [piped_stream()[0] for _ in range(warm_reps)]
+        pipeline_mod.drain()
+        snap = _metrics_snapshot() or {}
+        piped_warm_s = min(warm_times)
+        piped_total_s = sum(warm_times)
+    finally:
+        srt_config.clear_flag("PIPELINE")
+    assert off_outs == sync_outs, "stream entry changed sync results"
+    assert piped_outs == sync_outs, "pipelined stream changed results"
+    ctr = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    overlap_ms = float(hists.get("pipeline.overlap_ms", {}).get("sum", 0))
+    stall_ms = float(hists.get("pipeline.stall_ms", {}).get("sum", 0))
+    rows = sum(b[4] for b in batches)
+    return {
+        "config": "dispatch",
+        "name": f"pipelined_stream_{n_batches}x{len(chain)}op_d{depth}",
+        "string_width": str_width,
+        "rows": rows,
+        "distinct_batch_sizes": len(set(sizes)),
+        "host_cpus": _os.cpu_count(),
+        "sync_cold_seconds": round(sync_cold_s, 4),
+        "sync_warm_seconds": round(sync_warm_s, 4),
+        "sync_plan_warm_seconds": round(plan_warm_s, 4),
+        "pipelined_cold_seconds": round(piped_cold_s, 4),
+        "pipelined_warm_seconds": round(piped_warm_s, 4),
+        "warm_speedup": round(sync_warm_s / piped_warm_s, 2),
+        "vs_plan_sync": round(plan_warm_s / piped_warm_s, 2),
+        "rows_per_s": round(rows / piped_warm_s, 1),
+        "pipeline": {
+            "depth": depth,
+            "batches": n_batches,
+            "overlap_ms": round(overlap_ms, 2),
+            # overlap and wall cover the SAME warm passes (all of them)
+            "overlap_fraction": round(
+                overlap_ms / max(piped_total_s * 1e3, 1e-9), 3
+            ),
+            "stall_ms": round(stall_ms, 2),
+            "stalls": int(ctr.get("pipeline.stalls", 0)),
+            "replays": int(ctr.get("pipeline.replays", 0)),
+            "enqueued": int(ctr.get("pipeline.enqueued", 0)),
+            "donated_bytes": int(
+                snap.get("bytes", {}).get("hbm.donated_bytes", 0)
+            ),
+            "donations": int(ctr.get("hbm.donations", 0)),
+            "uploads_batched": int(
+                ctr.get("wire.upload.batched", 0)
+            ),
+        },
+        "platform": platform,
+    }
+
+
 def bench_resident_chain(platform, n=None):
     """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
     through device-RESIDENT table handles vs the bytes-wire path that
@@ -1466,6 +1647,7 @@ _SUBPROCESS_CONFIGS = {
     "resident": bench_resident_chain,
     "bucketed_stream": bench_bucketed_stream,
     "fused_plan": bench_fused_plan,
+    "pipelined_stream": bench_pipelined_stream,
     "parquet": bench_parquet_pipeline,
     "parquet_device": bench_parquet_device,
     "tpcds": bench_tpcds,
@@ -1486,7 +1668,8 @@ _LADDER = (
     "groupby16m_flat_gather", "groupby16m_flat_sort", "groupby16m_gather",
     "groupby16m_packed_pallas32", "chunk_sort_ab",
     "strings", "transpose", "transpose_pallas", "resident",
-    "bucketed_stream", "fused_plan", "parquet", "parquet_device",
+    "bucketed_stream", "fused_plan", "pipelined_stream",
+    "parquet", "parquet_device",
     # 100M tier: likely winners first
     "groupby100m_flat_gather", "groupby100m_gather", "groupby100m",
     "groupby100m_packed_pallas32", "groupby100m_packed",

@@ -82,6 +82,40 @@ def dispatch_bucketed(
     return out
 
 
+def dispatch_bucketed_donated(
+    op: dict, table: Table, name: str
+) -> Optional[Table]:
+    """Run ONE op whose input table is CONSUMED (the caller released
+    its resident id) with the padded input donated to the executable —
+    the single-op flavor of plan-segment donation, built on the same
+    fused-applier machinery so the donated executable shares
+    ``plan._run_fused``'s cache keying. Returns None when the op/shape
+    can't take the donated path (the caller then runs the normal
+    dispatch on the still-intact input); raises only when the donated
+    launch failed AFTER consuming its buffers."""
+    from . import plan as plan_mod
+
+    if not buckets.enabled() or not plan_mod.op_fusable(op):
+        return None
+    with metrics.span("bucketed.donated." + name):
+        try:
+            return plan_mod._run_fused([op], table, donate=True)
+        except _Decline:
+            metrics.counter_add("bucket.declined")
+            return None
+        except Exception as e:
+            if plan_mod._input_consumed(table):
+                raise
+            metrics.counter_add("bucket.fallback_errors")
+            if name not in _WARNED_OPS:
+                _WARNED_OPS.add(name)
+                log.log(
+                    "WARN", "buckets", "donated_runner_failed", op=name,
+                    error=f"{type(e).__name__}: {str(e)[:200]}",
+                )
+            return None
+
+
 # ---------------------------------------------------------------------------
 # shared plumbing
 # ---------------------------------------------------------------------------

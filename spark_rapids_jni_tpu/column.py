@@ -39,24 +39,43 @@ import numpy as np
 from . import dtype as dt
 
 
+def storage_host_view(arr: np.ndarray, dtype: dt.DType) -> np.ndarray:
+    """Host-side half of the storage-encoding rule: the FLOAT64
+    bit-view (device storage is the uint64 bit pattern — see
+    DType.storage_dtype). Shared by encode_storage and the wire
+    layer's batched-upload staging (runtime_bridge)."""
+    if dtype.id == dt.TypeId.FLOAT64:
+        return np.ascontiguousarray(arr, dtype=np.float64).view(np.uint64)
+    return arr
+
+
+def x64_downgrade_error(got, want, what: str = "types") -> TypeError:
+    """The x64-downgrade guard's error, one wording per upload site:
+    jax_enable_x64 off (SPARK_RAPIDS_TPU_DISABLE_X64=1) makes jnp
+    silently downgrade 64-bit dtypes, which would corrupt data while
+    the DType metadata still claims 64 bits."""
+    suffix = {
+        "types": (
+            "64-bit types require jax_enable_x64 (unset "
+            "SPARK_RAPIDS_TPU_DISABLE_X64)"
+        ),
+        "LIST children": "64-bit LIST children require jax_enable_x64",
+        "children": "64-bit children require jax_enable_x64",
+    }[what]
+    return TypeError(f"device buffer dtype {got} != {want}; {suffix}")
+
+
 def encode_storage(arr: np.ndarray, dtype: dt.DType) -> jax.Array:
     """Upload a host array as a column storage buffer.
 
-    Single place for the FLOAT64 bit-view rule (DType.storage_dtype) and
-    the x64-downgrade guard, shared by Column.from_numpy and interop.
+    Single place for the FLOAT64 bit-view rule (storage_host_view) and
+    the x64-downgrade guard (x64_downgrade_error), shared by
+    Column.from_numpy, interop, and the wire layer.
     """
-    if dtype.id == dt.TypeId.FLOAT64:
-        arr = np.ascontiguousarray(arr, dtype=np.float64).view(np.uint64)
+    arr = storage_host_view(arr, dtype)
     dev = jnp.asarray(arr, dtype=dtype.storage_dtype)
     if dev.dtype != np.dtype(dtype.storage_dtype):
-        # jax_enable_x64 is off (SPARK_RAPIDS_TPU_DISABLE_X64=1): jnp
-        # silently downgrades 64-bit dtypes, which would corrupt data
-        # while the DType metadata still claims 64 bits.
-        raise TypeError(
-            f"device buffer dtype {dev.dtype} != {dtype.storage_dtype}; "
-            "64-bit types require jax_enable_x64 (unset "
-            "SPARK_RAPIDS_TPU_DISABLE_X64)"
-        )
+        raise x64_downgrade_error(dev.dtype, dtype.storage_dtype)
     return dev
 
 
@@ -212,10 +231,7 @@ class Column:
             lens[i] = len(arr)
         dev = jnp.asarray(mat)
         if dev.dtype != npdt:
-            raise TypeError(
-                f"device buffer dtype {dev.dtype} != {npdt}; 64-bit "
-                "children require jax_enable_x64"
-            )
+            raise x64_downgrade_error(dev.dtype, npdt, "children")
         return Column(
             data=dev,
             dtype=dt.DType(dt.TypeId.LIST),

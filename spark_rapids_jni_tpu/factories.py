@@ -256,6 +256,7 @@ def run_plan(
     table: Table,
     rest: Sequence[Table] = (),
     unpad: bool = True,
+    donate_input: bool = False,
 ) -> Table:
     """Python-level plan entry: execute a JSON-able op LIST (the
     ``table_plan_wire``/``table_plan_resident`` format) over
@@ -264,11 +265,18 @@ def run_plan(
     and boundary ops dispatch per-op. ``unpad=True`` (default) returns
     an exact-shape result; pass ``unpad=False`` to keep the
     bucket-padded table (``Table.logical_rows`` carries the real
-    count) when feeding another plan or bucketed op."""
+    count) when feeding another plan or bucketed op.
+
+    ``donate_input=True`` declares ``table`` consumed by this plan:
+    nothing else references its buffers, so the first fused segment may
+    donate them and update HBM in place (``hbm.donated_bytes``). The
+    caller must not touch ``table`` afterwards."""
     from . import plan as plan_mod
     from .utils import buckets
 
-    out = plan_mod.run_plan(list(ops), table, tuple(rest))
+    out = plan_mod.run_plan(
+        list(ops), table, tuple(rest), donate_input=donate_input
+    )
     return buckets.unpad_table(out) if unpad else out
 
 

@@ -235,7 +235,10 @@ def column_to_arrow(col: Column):
                     f"unscaled value {v} exceeds Arrow "
                     f"decimal128({precision}) precision"
                 )
-        with _dec.localcontext(prec=50):
+        # localcontext(prec=...) kwargs need Python 3.11+; set the
+        # precision on the entered context so 3.10 works too
+        with _dec.localcontext() as ctx:
+            ctx.prec = 50
             py = [
                 None if v is None else _dec.Decimal(v).scaleb(-scale)
                 for v in vals

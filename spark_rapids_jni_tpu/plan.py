@@ -57,6 +57,30 @@ _SIMPLE_FUSABLE = frozenset(
 # shape (the bucketed._WARNED_OPS discipline), not per call
 _WARNED_SIGS = set()
 
+# Donated segments EXPECT partial aliasing: a filter drops its mask
+# column and a cast changes a dtype, so some input buffers have no
+# same-shaped output to alias and XLA warns per compile. The donation
+# of the (dominant) same-schema buffers still lands; the warning is
+# noise for this plane and is filtered narrowly. Re-armed per donated
+# launch (idempotent: skipped when an equivalent filter is already
+# live) because the process filter list is freely reset by embedders
+# and per-test by pytest — a one-shot module flag would leak the
+# warning everywhere after the first such reset.
+_DONATE_WARNING_MSG = "Some donated buffers were not usable"
+
+
+def _filter_partial_donation_warning() -> None:
+    import warnings
+
+    for f in warnings.filters:
+        if (
+            f[0] == "ignore"
+            and f[1] is not None
+            and f[1].pattern == _DONATE_WARNING_MSG
+        ):
+            return
+    warnings.filterwarnings("ignore", message=_DONATE_WARNING_MSG)
+
 
 def op_fusable(op: dict) -> bool:
     """Could this op ride inside a fused segment? (groupby: tail-only,
@@ -249,9 +273,23 @@ def _run_segment_traced(seg_ops: Sequence[dict], t: Table, n):
     return t, n
 
 
-def _run_fused(seg_ops: Sequence[dict], table: Table) -> Table:
-    """One fused segment -> one cached executable -> one launch."""
+def _run_fused(
+    seg_ops: Sequence[dict], table: Table, donate: bool = False
+) -> Table:
+    """One fused segment -> one cached executable -> one launch.
+
+    ``donate=True`` marks the segment's input table as CONSUMED: its
+    padded buffers are donated to the executable
+    (``buckets.cached_jit(donate_args=(0,))``) so XLA updates HBM in
+    place instead of holding input + output simultaneously — the
+    resident-chain peak-halving of ISSUE 5. The caller guarantees
+    nothing else references the input's buffers (plan-owned
+    intermediates, consumed resident ids, freshly decoded wire
+    tables). After the call the input arrays are deleted; the
+    ``run_plan`` fallback checks for that before attempting a per-op
+    replay."""
     from . import bucketed
+    from .utils import hbm
 
     pt = bucketed._padded_input(table)  # _Decline when unbucketable
     key = buckets.cache_key("plan", list(seg_ops), (pt,))
@@ -262,8 +300,18 @@ def _run_fused(seg_ops: Sequence[dict], table: Table) -> Table:
 
         return fn
 
-    fn = buckets.cached_jit(key, build, "srt_fused_plan")
+    donate_args = (0,) if donate else ()
+    if donate:
+        _filter_partial_donation_warning()
+    fn = buckets.cached_jit(
+        key, build, "srt_fused_plan", donate_args=donate_args
+    )
+    donated = hbm.table_bytes(pt) if donate else 0
     out, count = fn(bucketed._strip(pt), bucketed._n_dev(pt))
+    if donated:
+        # counted AFTER the launch: a trace/compile failure falls back
+        # to per-op replay with the input intact — nothing was donated
+        hbm.note_donation(donated)
     return bucketed._finish(out, int(count))
 
 
@@ -286,12 +334,25 @@ def _take_rest(op: dict, orig_rest: tuple, queue: list) -> list:
 
 
 def run_plan(
-    ops: Sequence[dict], table: Table, rest: Sequence[Table] = ()
+    ops: Sequence[dict],
+    table: Table,
+    rest: Sequence[Table] = (),
+    donate_input: bool = False,
 ) -> Table:
     """Execute a plan (a list of op dicts) over ``table``; returns the
     final (possibly padded) Table. The chain's flowing table is always
     the FIRST input of every op; ``rest`` supplies extra tables for
-    multi-table segment-boundary ops (see ``_take_rest``)."""
+    multi-table segment-boundary ops (see ``_take_rest``).
+
+    ``donate_input=True`` declares ``table`` consumed by this plan —
+    nothing else holds its buffers (a wire upload, a resident id the
+    caller released) — allowing the FIRST fused segment to donate it.
+    Later segments may donate too: the flowing table between segments
+    is plan-owned. Because an exact boundary segment's output CAN
+    alias its input buffers (a single-table concat returns them
+    outright), every donation is additionally gated on the flowing
+    table's buffers being disjoint from everything the caller can
+    still observe (the undonated input and every ``rest`` table)."""
     from . import bucketed, runtime_bridge
 
     if not isinstance(ops, (list, tuple)):
@@ -310,6 +371,19 @@ def run_plan(
         segs = [("exact", [op]) for op in ops]
     metrics.counter_add("plan.calls")
     metrics.counter_add("plan.segments", len(segs))
+    owned = bool(donate_input)
+    # buffers the CALLER can still observe: a donated segment must
+    # never consume these. Ownership flips True after the first
+    # segment, but an exact segment's output can ALIAS its input
+    # (a single-table concat returns the input buffers outright;
+    # unpad_table at the exact row count keeps the same columns), so
+    # every donation is additionally gated on buffer disjointness
+    # against this set.
+    protected: set = set()
+    if not donate_input:
+        protected.update(_buffer_ids(table))
+    for t in orig_rest:
+        protected.update(_buffer_ids(t))
     with metrics.span("plan", segments=len(segs), ops=len(ops)):
         for i, (kind, seg_ops) in enumerate(segs):
             with metrics.span(
@@ -317,8 +391,11 @@ def run_plan(
             ):
                 replay = seg_ops
                 if kind == "fused":
+                    donate = owned and protected.isdisjoint(
+                        _buffer_ids(table)
+                    )
                     try:
-                        table = _run_fused(seg_ops, table)
+                        table = _run_fused(seg_ops, table, donate=donate)
                         metrics.counter_add("plan.fused_segments")
                         metrics.counter_add("plan.fused_ops", len(seg_ops))
                         replay = ()
@@ -327,6 +404,12 @@ def run_plan(
                         # the per-op path owns it
                         metrics.counter_add("plan.declined")
                     except Exception as e:
+                        if _input_consumed(table):
+                            # the donated executable failed AFTER
+                            # consuming its input: a per-op replay
+                            # would dereference deleted buffers —
+                            # surface the real error instead
+                            raise
                         # fusion must never change semantics: replay
                         # per-op; the exact path raises the real error
                         # if an op itself is at fault
@@ -348,4 +431,29 @@ def run_plan(
                         op, table, _take_rest(op, orig_rest, queue)
                     )
                     metrics.counter_add("plan.exact_ops")
+            # every segment output is a fresh plan-owned intermediate:
+            # the NEXT fused segment may donate it
+            owned = True
     return table
+
+
+def _buffer_ids(table: Table) -> set:
+    """Identities of every device buffer a table holds (aliasing
+    check for donation safety)."""
+    out = set()
+    for c in table.columns:
+        out.add(id(c.data))
+        if c.validity is not None:
+            out.add(id(c.validity))
+        if c.lengths is not None:
+            out.add(id(c.lengths))
+    return out
+
+
+def _input_consumed(table: Table) -> bool:
+    """True when a donated executable already deleted this table's
+    buffers (replaying it is impossible)."""
+    try:
+        return bool(table.columns) and table.columns[0].data.is_deleted()
+    except Exception:  # backends without is_deleted: assume replayable
+        return False

@@ -37,6 +37,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["SRT_JAX_PLATFORMS"])
 
 from . import dtype as dt
+from . import pipeline
 from .column import Column, Table
 from .utils import buckets, flight, log, metrics
 
@@ -53,7 +54,8 @@ def _wire_np(d: dt.DType) -> np.dtype:
 
 
 def _padded_from_offsets(
-    data: bytes, num_rows: int, child_np: np.dtype, label: str
+    data: bytes, num_rows: int, child_np: np.dtype, label: str,
+    pad_rows: Optional[int] = None,
 ):
     """Arrow offsets+payload wire buffer -> ((n, pad) matrix, lengths).
 
@@ -63,7 +65,14 @@ def _padded_from_offsets(
     validated up front: a corrupt buffer with negative or non-monotonic
     offsets would otherwise yield negative lengths and a silently wrong
     row mask (``arange < lens`` is all-False for a negative length, so
-    payload bytes would land in the WRONG rows without any error)."""
+    payload bytes would land in the WRONG rows without any error).
+
+    ``pad_rows`` sizes the matrix's ROW dimension directly at the shape
+    bucket: the old decode built an (n, pad) matrix and then re-padded
+    it to the bucket — a second multi-MB alloc + copy per column on the
+    wire hot path. Constant-width payloads (every length == pad, the
+    dictionary-code/fixed-id shape) take a bulk-reshape fast path that
+    skips the row mask entirely."""
     if len(data) < 4 * (num_rows + 1):
         raise ValueError(
             f"{label} wire buffer holds {len(data)} bytes, "
@@ -87,9 +96,15 @@ def _padded_from_offsets(
         data, child_np, count=int(offs[-1]), offset=4 * (num_rows + 1)
     )
     pad = max(int(lens.max()) if num_rows else 1, 1)
-    mat = np.zeros((num_rows, pad), child_np)
-    mask = np.arange(pad)[None, :] < lens[:, None]
-    mat[mask] = flat
+    rows = max(num_rows, pad_rows or 0)
+    mat = np.zeros((rows, pad), child_np)
+    if num_rows and int(offs[-1]) == num_rows * pad:
+        # constant-width payload: the flat buffer IS the row-major
+        # matrix — one bulk copy instead of mask build + fancy index
+        mat[:num_rows] = flat.reshape(num_rows, pad)
+    else:
+        mask = np.arange(pad)[None, :] < lens[:, None]
+        mat[:num_rows][mask] = flat
     return mat, lens
 
 
@@ -134,6 +149,16 @@ def _padded_to_offsets(
     """(n, pad) matrix + lengths -> offsets+payload wire bytes."""
     offs = np.zeros((lens.shape[0] + 1,), np.int32)
     np.cumsum(lens, out=offs[1:])
+    if lens.shape[0] and int(offs[-1]) == lens.shape[0] * mat.shape[1]:
+        # constant-width rows (every length == pad): the matrix IS the
+        # payload — skip the row mask + fancy gather outright. Counted
+        # as saved serialize bytes: the mask buffer was never built.
+        if ctx is not None:
+            metrics.bytes_add(
+                "wire.serialize.saved_bytes",
+                lens.shape[0] * mat.shape[1],
+            )
+        return offs.tobytes() + mat.tobytes()
     if ctx is not None:
         mask = ctx.row_mask(lens, mat.shape[1])
     else:
@@ -161,11 +186,28 @@ def _pad_host(arr: np.ndarray, total: Optional[int]) -> np.ndarray:
     return out
 
 
-def _column_from_wire(
+class _HostCol:
+    """One wire column decoded to HOST storage buffers, not yet
+    uploaded — the staging unit of the per-table batched transfer
+    (``_upload_host_columns``). ``data`` is already in the DEVICE
+    storage dtype (FLOAT64 carried as its uint64 bit pattern, the
+    encode_storage rule) so the upload is a pure copy."""
+
+    __slots__ = ("dtype", "data", "validity", "lengths")
+
+    def __init__(self, dtype, data, validity=None, lengths=None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.lengths = lengths
+
+
+def _host_column_from_wire(
     type_id: int, scale: int, data: Optional[bytes],
     valid: Optional[bytes], num_rows: int,
     pad_to: Optional[int] = None,
-) -> Column:
+) -> _HostCol:
+    """Decode one wire column to host numpy buffers (no device touch)."""
     if metrics.enabled():
         metrics.bytes_add(
             "wire.bytes_in",
@@ -176,43 +218,30 @@ def _column_from_wire(
     if dt.TypeId(type_id) == dt.TypeId.LIST:
         # LIST wire convention: the scale slot carries the CHILD type id
         # (scale is meaningless for LIST); payload per _padded_from_offsets.
-        import jax.numpy as jnp
-
         child = dt.DType(dt.TypeId(scale))
         mat, lens = _padded_from_offsets(
-            data, num_rows, np.dtype(child.storage_dtype), "LIST"
+            data, num_rows, np.dtype(child.storage_dtype), "LIST",
+            pad_rows=pad_to,
         )
         v = _wire_validity(valid, num_rows)
-        mat = _pad_host(mat, pad_to)
-        lens = _pad_host(lens, pad_to)
-        v = None if v is None else _pad_host(v, pad_to)
-        dev = jnp.asarray(mat)
-        if dev.dtype != mat.dtype:
-            # x64 disabled: a silent int64->int32 downgrade would corrupt
-            # values AND misreport the child type id on download
-            raise TypeError(
-                f"device buffer dtype {dev.dtype} != {mat.dtype}; 64-bit "
-                "LIST children require jax_enable_x64"
-            )
-        return Column(
-            dev, dt.DType(dt.TypeId.LIST),
-            None if v is None else jnp.asarray(v), jnp.asarray(lens),
+        return _HostCol(
+            dt.DType(dt.TypeId.LIST),
+            mat,
+            None if v is None else _pad_host(v, pad_to),
+            _pad_host(lens, pad_to),
         )
     if dt.TypeId(type_id) == dt.TypeId.STRING:
         # STRING wire convention (the Arrow string layout cudf's JNI
         # marshals): offsets + concatenated UTF-8 bytes.
-        import jax.numpy as jnp
-
         mat, lens = _padded_from_offsets(
-            data, num_rows, np.dtype(np.uint8), "STRING"
+            data, num_rows, np.dtype(np.uint8), "STRING", pad_rows=pad_to,
         )
         v = _wire_validity(valid, num_rows)
-        mat = _pad_host(mat, pad_to)
-        lens = _pad_host(lens, pad_to)
-        v = None if v is None else _pad_host(v, pad_to)
-        return Column(
-            jnp.asarray(mat), dt.STRING,
-            None if v is None else jnp.asarray(v), jnp.asarray(lens),
+        return _HostCol(
+            dt.STRING,
+            mat,
+            None if v is None else _pad_host(v, pad_to),
+            _pad_host(lens, pad_to),
         )
     d = dt.DType(dt.TypeId(type_id), scale)
     if d.id == dt.TypeId.DECIMAL128:
@@ -230,8 +259,63 @@ def _column_from_wire(
         )
     )
     arr = _pad_host(arr, pad_to)
-    v = None if v is None else _pad_host(v, pad_to)
-    return Column.from_numpy(arr, validity=v, dtype=d)
+    # the one FLOAT64 bit-view rule, shared with encode_storage
+    from .column import storage_host_view
+
+    arr = storage_host_view(arr, d)
+    return _HostCol(d, arr, None if v is None else _pad_host(v, pad_to))
+
+
+def _upload_host_columns(hcols: Sequence[_HostCol]) -> list:
+    """Upload a whole table's host buffers in ONE batched transfer.
+
+    ``jax.device_put`` on the flat leaf list dispatches every buffer
+    together (the reference uploads a ColumnarBatch as one contiguous
+    HtoD copy, not one cudaMemcpy per column); the per-column path cost
+    one transfer per data/validity/lengths buffer. Transfers saved by
+    batching are counted in ``wire.upload.batched``."""
+    import jax
+
+    leaves = []
+    for h in hcols:
+        leaves.append(h.data)
+        if h.validity is not None:
+            leaves.append(h.validity)
+        if h.lengths is not None:
+            leaves.append(h.lengths)
+    dev = jax.device_put(leaves) if leaves else []
+    if metrics.enabled() and len(leaves) > 1:
+        metrics.counter_add("wire.upload.batched", len(leaves) - 1)
+    it = iter(dev)
+    cols = []
+    for h in hcols:
+        d = next(it)
+        if d.dtype != h.data.dtype:
+            # x64 disabled: a silent int64->int32 downgrade would
+            # corrupt values AND misreport the type id on download
+            # (the shared encode_storage guard, batched-upload flavor)
+            from .column import x64_downgrade_error
+
+            raise x64_downgrade_error(
+                d.dtype, h.data.dtype,
+                "LIST children" if h.dtype.id == dt.TypeId.LIST
+                else "types",
+            )
+        v = next(it) if h.validity is not None else None
+        lens = next(it) if h.lengths is not None else None
+        cols.append(Column(d, h.dtype, v, lens))
+    return cols
+
+
+def _column_from_wire(
+    type_id: int, scale: int, data: Optional[bytes],
+    valid: Optional[bytes], num_rows: int,
+    pad_to: Optional[int] = None,
+) -> Column:
+    return _upload_host_columns(
+        [_host_column_from_wire(type_id, scale, data, valid, num_rows,
+                                pad_to)]
+    )[0]
 
 
 def _column_to_wire(
@@ -486,17 +570,19 @@ def _table_from_wire(
     num_rows: int,
     pad_to: Optional[int],
 ) -> Table:
-    """One wire-deserialize pass -> a (possibly host-padded) Table."""
+    """One wire-deserialize pass -> a (possibly host-padded) Table.
+    Host decode per column, then the whole table's buffers cross to the
+    device as ONE batched ``jax.device_put`` pytree transfer."""
     if flight.enabled():
         flight.record(
             "I", "wire.in",
             sum(len(d) for d in datas if d is not None),
         )
     with metrics.span("wire.deserialize"):
-        cols = [
-            _column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
+        cols = _upload_host_columns([
+            _host_column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
             for t, s, d, v in zip(type_ids, scales, datas, valids)
-        ]
+        ])
     tbl = Table(cols, logical_rows=num_rows if pad_to is not None else None)
     if pad_to is not None:
         buckets.note_padded(tbl)
@@ -552,6 +638,25 @@ def table_op_wire(
     return _table_to_wire(result)
 
 
+def _plan_pad_to(ops, num_rows: int) -> Optional[int]:
+    """Host-side pad target for a plan's wire upload: pad only when the
+    FIRST segment can consume the padding (a fused segment, or a 1-op
+    segment with a bucketed runner) — the table_op_wire gate applied at
+    segment granularity, so a plan opening with e.g. a lone slice
+    doesn't pay a padded upload just to unpad on the exact path;
+    malformed entries fall through to run_plan's loud validation."""
+    from . import bucketed, plan as plan_mod
+
+    if not (buckets.enabled() and ops and isinstance(ops[0], dict)):
+        return None
+    segs = plan_mod.segment_plan(ops)
+    if segs and (
+        segs[0][0] == "fused" or bucketed.is_bucketable(segs[0][1][0])
+    ):
+        return buckets.bucket_for(num_rows)
+    return None
+
+
 def table_plan_wire(
     plan_json: str,
     type_ids: Sequence[int],
@@ -563,30 +668,61 @@ def table_plan_wire(
     """C-ABI plan entry: ``plan_json`` is a JSON LIST of ops executed
     as a fused plan (plan.py) over ONE wire table — upload once, every
     fusable run costs one executable launch, download once. Returns the
-    same 5-tuple as ``table_op_wire``."""
-    from . import bucketed, plan as plan_mod
+    same 5-tuple as ``table_op_wire``. The uploaded table is consumed
+    by construction (nothing else holds a wire table), so the first
+    fused segment donates its buffers — the chain updates HBM in place
+    instead of doubling peak (``hbm.donated_bytes``)."""
+    from . import plan as plan_mod
 
     ops = json.loads(plan_json)
     if not isinstance(ops, list):
         raise TypeError("table_plan_wire: plan must be a JSON list of ops")
-    pad_to = None
-    if buckets.enabled() and ops and isinstance(ops[0], dict):
-        # pad only when the FIRST segment can consume the padding (a
-        # fused segment, or a 1-op segment with a bucketed runner) —
-        # the table_op_wire gate applied at segment granularity, so a
-        # plan opening with e.g. a lone slice doesn't pay a padded
-        # upload just to unpad on the exact path; malformed entries
-        # fall through to run_plan's loud validation
-        segs = plan_mod.segment_plan(ops)
-        if segs and (
-            segs[0][0] == "fused" or bucketed.is_bucketable(segs[0][1][0])
-        ):
-            pad_to = buckets.bucket_for(num_rows)
     tbl = _table_from_wire(
-        type_ids, scales, datas, valids, num_rows, pad_to
+        type_ids, scales, datas, valids, num_rows,
+        _plan_pad_to(ops, num_rows),
     )
-    result = plan_mod.run_plan(ops, tbl)
+    result = plan_mod.run_plan(ops, tbl, donate_input=True)
     return _table_to_wire(result)
+
+
+def table_stream_wire(plan_json: str, batches: Sequence) -> list:
+    """Streaming C-ABI entry: drive a whole plan-per-batch stream
+    through the pipelined dispatch plane from ONE call.
+
+    ``batches`` is a sequence of ``(type_ids, scales, datas, valids,
+    num_rows)`` wire tuples; each runs the same ``plan_json`` op list
+    and the returned list carries one ``table_op_wire``-shaped 5-tuple
+    per batch, in input order. With ``SPARK_RAPIDS_TPU_PIPELINE`` on,
+    batch N+1's wire decode and batch N-1's wire encode run on
+    background workers while batch N's fused-plan executable runs on
+    the calling thread (pipeline.run_stream); with the pipeline off
+    this is exactly a loop of ``table_plan_wire`` — byte-identical
+    results and error surfacing either way. Each batch's decoded table
+    is consumed by its plan run, so fused chains donate
+    (``hbm.donated_bytes``)."""
+    from . import plan as plan_mod
+
+    ops = json.loads(plan_json)
+    if not isinstance(ops, list):
+        raise TypeError(
+            "table_stream_wire: plan must be a JSON list of ops"
+        )
+
+    def decode(batch):
+        type_ids, scales, datas, valids, num_rows = batch
+        return _table_from_wire(
+            type_ids, scales, datas, valids, num_rows,
+            _plan_pad_to(ops, num_rows),
+        )
+
+    def compute(tbl):
+        return plan_mod.run_plan(ops, tbl, donate_input=True)
+
+    batches = list(batches)
+    with metrics.span(
+        "stream", batches=len(batches), depth=pipeline.depth()
+    ):
+        return pipeline.run_stream(batches, decode, compute, _table_to_wire)
 
 
 def platform() -> str:
@@ -640,32 +776,70 @@ def _provenance_on() -> bool:
     )
 
 
-def _resident_get(table_id: int) -> Table:
+def _unknown_id_error(table_id, live: int) -> KeyError:
+    """The labeled miss every resident entry raises: names the id AND
+    the live count so a use-after-free reads as one (a bare dict miss
+    cost a round-6 debugging session distinguishing "never uploaded"
+    from "double freed")."""
+    return KeyError(
+        f"unknown or already-freed device table id {int(table_id)} "
+        f"({live} table(s) live)"
+    )
+
+
+def _resident_peek(table_id: int):
+    """Registry entry for ``table_id`` WITHOUT resolving: a Table, or a
+    ``pipeline.Pending`` still computing. Raises the labeled KeyError
+    on a miss."""
     with _RESIDENT_LOCK:
         t = _RESIDENT.get(int(table_id))
+        live = len(_RESIDENT)
     if t is None:
-        raise KeyError(f"unknown device table id {table_id}")
+        raise _unknown_id_error(table_id, live)
+    return t
+
+
+def _resident_get(table_id: int) -> Table:
+    """Resolved Table for ``table_id`` — THE blocking point of the
+    pipelined plane: a pending entry is waited for here, with any
+    worker error replayed synchronously so the originating op's own
+    exception surfaces (pipeline.Pending.resolve)."""
+    t = _resident_peek(table_id)
+    if isinstance(t, pipeline.Pending):
+        t = t.resolve()
+        with _RESIDENT_LOCK:
+            # swap the settled Table in so later gets skip the handle
+            # (unless the id was freed while we waited)
+            if int(table_id) in _RESIDENT:
+                _RESIDENT[int(table_id)] = t
     metrics.counter_add("resident.get")
     return t
 
 
-def _resident_put(t: Table) -> int:
+def _resident_put(t) -> int:
+    """Register a Table (or a ``pipeline.Pending`` still computing it)
+    and return its id. Pending entries count as live — backpressure and
+    the leak report both see in-flight results."""
     tid = next(_NEXT_TABLE_ID)
+    is_pending = isinstance(t, pipeline.Pending)
+    rows = None if is_pending else int(t.logical_row_count)
     meta = None
     if _provenance_on():
         meta = {
-            "rows": int(t.logical_row_count),
-            "columns": len(t.columns),
+            "rows": rows,
+            "columns": None if is_pending else len(t.columns),
             "allocated_under": list(metrics.span_stack()),
             "age_anchor_ns": _time.perf_counter_ns(),
         }
+        if is_pending:
+            meta["pending"] = t.label
     with _RESIDENT_LOCK:
         _RESIDENT[tid] = t
         if meta is not None:
             _RESIDENT_META[tid] = meta
         live = len(_RESIDENT)
     log.log("DEBUG", "handles", "resident_put", table_id=tid,
-            rows=int(t.logical_row_count), live=live)
+            rows=rows, live=live)
     # resident.live's high-water mark is the leak-report analog: a chain
     # that frees what it allocates returns to the pre-chain value while
     # high_water records the peak resident set
@@ -694,22 +868,143 @@ def table_upload_wire(
     )
 
 
-def table_op_resident(op_json: str, table_ids: Sequence[int]) -> int:
+# table id -> in-flight pipelined ops READING that id (pruned as they
+# settle). A donate-consume of an id must terminally settle these
+# before its executable deletes the buffers: without the barrier,
+# op1=[A] then op2=[A, donate] on two workers could delete A's device
+# arrays out from under op1's running dispatch (or its later replay) —
+# an error the synchronous ordering (op1 completes before op2 starts)
+# can never produce.
+_RESIDENT_READERS: dict = {}
+
+
+def _capture_inputs(
+    table_ids: Sequence[int], donate: bool, reader=None
+) -> tuple:
+    """Atomically snapshot the input entries at CALL time (Tables or
+    Pendings) -> ``(inputs, donate_barrier)``.
+
+    The capture is what makes the async chain pattern safe: a caller
+    may ``table_free`` an input right after enqueueing the op that
+    consumes it — the op holds its own reference, exactly as if it had
+    completed before the free (the synchronous ordering). Unknown ids
+    raise the labeled KeyError synchronously (all ids validated BEFORE
+    the donated input is consumed, so a bad rest id leaves it intact).
+
+    One lock acquisition covers validation, the donate-consume, the
+    barrier snapshot AND registering ``reader`` (the op's own not-yet-
+    enqueued Pending) against the ids it captured: a concurrent
+    donate-consume of the same id therefore either sees this reader in
+    its barrier or ordered itself first (in which case THIS capture
+    fails with the labeled KeyError) — there is no window where a
+    reader runs unprotected."""
+    ids = [int(t) for t in table_ids]
+    took = False
+    with _RESIDENT_LOCK:
+        live = len(_RESIDENT)
+        for t in ids:
+            if t not in _RESIDENT:
+                raise _unknown_id_error(t, live)
+        objs = [_RESIDENT[t] for t in ids]
+        barrier = []
+        if donate:
+            _RESIDENT.pop(ids[0])
+            _RESIDENT_META.pop(ids[0], None)
+            barrier = [
+                p for p in _RESIDENT_READERS.pop(ids[0], ())
+                if not p.done()
+            ]
+            live = len(_RESIDENT)
+            took = True
+        if reader is not None:
+            for t in (ids[1:] if donate else ids):
+                lst = _RESIDENT_READERS.setdefault(t, [])
+                lst[:] = [p for p in lst if not p.done()]
+                lst.append(reader)
+    metrics.counter_add("resident.get", len(ids))
+    if took:
+        log.log("DEBUG", "handles", "resident_take", table_id=ids[0],
+                live=live)
+        metrics.counter_add("resident.free")
+        metrics.gauge_set("resident.live", live)
+        if flight.enabled():
+            flight.record("C", "resident.live", live)
+    return objs, barrier
+
+
+def _run_resident_op(
+    op: dict, inputs: list, donate: bool, name: str, barrier=(),
+):
+    """The shared (sync or worker-side) body of ``table_op_resident``:
+    resolve pending inputs, dispatch — through the donated single-op
+    executable when the input was consumed — and return the result.
+    ``barrier`` holds still-running readers of the donated input; they
+    must be terminally settled (later replays included) before the
+    donated executable may delete its buffers."""
+    tables = pipeline.materialize_inputs(inputs)
+    out = None
+    if donate:
+        from . import bucketed
+
+        for p in barrier:
+            p.settle_terminally()
+        out = bucketed.dispatch_bucketed_donated(op, tables[0], name)
+    if out is None:
+        out = _dispatch(op, tables[0], tables[1:])
+    return out
+
+
+def table_op_resident(
+    op_json: str, table_ids: Sequence[int], donate: bool = False
+) -> int:
     """Run one op over resident tables; the result STAYS resident.
 
     No host transfer happens here — chaining filter -> join -> groupby
     costs upload + download once, not per op.
+
+    ``donate=True`` declares ``table_ids[0]`` CONSUMED: the id is freed
+    now (equivalent to op + table_free, but the op may then donate the
+    input's HBM buffers to its executable and update them in place —
+    ``hbm.donated_bytes``). The caller must not use the id again.
+
+    With ``SPARK_RAPIDS_TPU_PIPELINE`` on this enqueues and returns the
+    result id immediately; ``table_download_wire``/``table_num_rows``
+    are the blocking points, and any worker error is replayed
+    synchronously there so the op's own exception surfaces unchanged.
     """
     if not table_ids:
         raise ValueError("table_op_resident needs at least one input")
     op = json.loads(op_json)
-    tables = [_resident_get(t) for t in table_ids]
-    out = _dispatch(op, tables[0], tables[1:])
-    return _resident_put(out)
+    name = str(op.get("op", "?")) if isinstance(op, dict) else "?"
+    if pipeline.enabled():
+        # donated work is at-most-once once its own dispatch starts
+        # (the input may be consumed by a partial run): the worker's
+        # post-consumption error is authoritative; input-materialize
+        # failures stay replayable (pipeline.DependencyFailed). The
+        # Pending is built FIRST so _capture_inputs can register it as
+        # a reader atomically with the capture; the captured state
+        # lands in `cell` before the enqueue makes the work runnable.
+        cell: dict = {}
+
+        def work():
+            return _run_resident_op(
+                op, cell["inputs"], donate, name, cell["barrier"]
+            )
+
+        pending = pipeline.Pending(
+            work, "op." + name, replayable=not donate
+        )
+        cell["inputs"], cell["barrier"] = _capture_inputs(
+            table_ids, donate, reader=pending
+        )
+        return _resident_put(pipeline.enqueue(pending))
+    inputs, barrier = _capture_inputs(table_ids, donate)
+    return _resident_put(_run_resident_op(op, inputs, donate, name,
+                                          barrier))
 
 
 def table_plan_resident(
-    plan_json: str, table_ids: Sequence[int]
+    plan_json: str, table_ids: Sequence[int], donate: bool = False
 ) -> int:
     """Run a whole PLAN (a JSON list of ops) over resident tables; the
     result stays resident. ``table_ids[0]`` is the chain input; the
@@ -717,34 +1012,87 @@ def table_plan_resident(
     explicit ``"rest"`` indices into this list, or sequential
     consumption; see plan._take_rest). Fusable runs execute as ONE
     cached executable each (plan.py), so an N-op chain costs one
-    launch per segment instead of N dispatches."""
+    launch per segment instead of N dispatches.
+
+    ``donate=True`` consumes ``table_ids[0]`` (freed now) and lets the
+    plan's first fused segment donate its buffers; later segments
+    always donate their plan-owned intermediates. Enqueues and returns
+    immediately when the pipeline is on (see ``table_op_resident``)."""
     if not table_ids:
         raise ValueError("table_plan_resident needs at least one input")
     from . import plan as plan_mod
 
     ops = json.loads(plan_json)
-    tables = [_resident_get(t) for t in table_ids]
-    out = plan_mod.run_plan(ops, tables[0], tables[1:])
-    return _resident_put(out)
+    cell: dict = {}
+
+    def work():
+        tables = pipeline.materialize_inputs(cell["inputs"])
+        for p in cell["barrier"]:
+            p.settle_terminally()
+        return plan_mod.run_plan(
+            ops, tables[0], tables[1:], donate_input=donate
+        )
+
+    if pipeline.enabled():
+        # capture + reader registration are atomic (see
+        # table_op_resident); the enqueue comes after the cell is set
+        pending = pipeline.Pending(work, "plan", replayable=not donate)
+        cell["inputs"], cell["barrier"] = _capture_inputs(
+            table_ids, donate, reader=pending
+        )
+        return _resident_put(pipeline.enqueue(pending))
+    cell["inputs"], cell["barrier"] = _capture_inputs(table_ids, donate)
+    return _resident_put(work())
 
 
 def table_download_wire(table_id: int):
     """Resident table -> the wire 5-tuple of table_op_wire (shape-bucket
-    padding sliced away host-side; the wire never sees it)."""
+    padding sliced away host-side; the wire never sees it). One of the
+    two BLOCKING points of the pipelined plane: a pending chain is
+    waited for here and any worker failure is replayed synchronously so
+    the originating op's labeled error raises from this call. Raises
+    the labeled KeyError on an unknown or already-freed id."""
     return _table_to_wire(_resident_get(table_id))
 
 
 def table_num_rows(table_id: int) -> int:
+    """Logical row count — the other blocking point (see
+    ``table_download_wire``)."""
     return int(_resident_get(table_id).logical_row_count)
 
 
 def table_free(table_id: int) -> None:
+    """Release a resident id. A still-pending entry is dropped without
+    waiting (the enqueued op keeps its own input references and simply
+    completes unobserved); a pending that already FAILED logs the
+    dropped error — the caller chose to never hit a blocking point, so
+    this WARN is the only trace the op ever broke. Raises the labeled
+    KeyError naming the id and live count on an unknown or
+    already-freed id."""
     with _RESIDENT_LOCK:
-        gone = _RESIDENT.pop(int(table_id), None) is None
+        t = _RESIDENT.pop(int(table_id), None)
+        gone = t is None
         _RESIDENT_META.pop(int(table_id), None)
+        readers = _RESIDENT_READERS.pop(int(table_id), ())
         live = len(_RESIDENT)
     if gone:
-        raise KeyError(f"unknown device table id {table_id}")
+        raise _unknown_id_error(table_id, live)
+    if isinstance(t, pipeline.Pending):
+        if not any(not p.done() for p in readers):
+            # fire-and-forget: nothing downstream captured this handle
+            # and no blocking point remains — a failure (already
+            # landed or still to come) must log itself; when an
+            # in-flight consumer DID capture it, error surfacing is
+            # delegated to that consumer's blocking point (the normal
+            # enqueue -> free(input) chain idiom)
+            t.orphan()
+            if t.failed_nowait():
+                log.log(
+                    "WARN", "handles", "freed_failed_pending",
+                    table_id=int(table_id), stage=t.label,
+                )
+                if flight.enabled():
+                    flight.record("I", "pipeline.freed_failed", t.label)
     log.log("DEBUG", "handles", "table_free", table_id=int(table_id),
             live=live)
     metrics.counter_add("resident.free")
@@ -772,21 +1120,31 @@ def leak_report() -> list:
     now = _time.perf_counter_ns()
     out = []
     for tid, t, meta in items:
+        # never resolve a pending here: the leak report runs at exit
+        # and must not replay abandoned work just to size it
+        pending = isinstance(t, pipeline.Pending)
+        if pending:
+            settled = t.value_nowait()
+            if settled is not None:
+                t, pending = settled, False
         rec = {
             "table_id": tid,
-            "rows": int(t.logical_row_count),
-            "columns": len(t.columns),
+            "rows": None if pending else int(t.logical_row_count),
+            "columns": None if pending else len(t.columns),
             "allocated_under": meta.get("allocated_under", []),
         }
+        if pending:
+            rec["pending"] = t.label
         anchor = meta.get("age_anchor_ns")
         if anchor is not None:
             rec["age_s"] = round((now - anchor) / 1e9, 3)
-        try:
-            from .utils import hbm
+        if not pending:
+            try:
+                from .utils import hbm
 
-            rec["approx_bytes"] = int(hbm.table_bytes(t))
-        except Exception:
-            pass
+                rec["approx_bytes"] = int(hbm.table_bytes(t))
+            except Exception:
+                pass
         out.append(rec)
     return out
 

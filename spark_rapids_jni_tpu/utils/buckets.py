@@ -349,11 +349,26 @@ _CACHE_LOCK = threading.Lock()
 _CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
 
 
-def cached_jit(key: tuple, build: Callable[[], Callable], name: str):
+def cached_jit(
+    key: tuple, build: Callable[[], Callable], name: str,
+    donate_args: tuple = (),
+):
     """Jitted callable for ``key``; ``build`` constructs the python fn
     on a miss. ``name`` becomes the callable's __name__ so compile-log
     lines (jax.log_compiles) are attributable to the bucket plane —
-    the recompile-regression test greps for it."""
+    the recompile-regression test greps for it.
+
+    ``donate_args`` (jax ``donate_argnums``) marks positional arguments
+    whose buffers the executable may consume IN PLACE — resident chains
+    and fused plan segments pass their padded input table here when its
+    table id is consumed, so an N-op chain updates HBM instead of
+    doubling peak. Donation is part of the executable (XLA aliases
+    output to input buffers), so it is folded into the cache key: a
+    donated and a non-donated call of the same op compile separately
+    and never serve each other. Callers must never reuse a donated
+    argument's buffers after the call."""
+    if donate_args:
+        key = key + (("donate", tuple(donate_args)),)
     with _CACHE_LOCK:
         fn = _CACHE.get(key)
         if fn is not None:
@@ -366,7 +381,7 @@ def cached_jit(key: tuple, build: Callable[[], Callable], name: str):
     raw = build()
     raw.__name__ = name
     raw.__qualname__ = name
-    jfn = jax.jit(raw)
+    jfn = jax.jit(raw, donate_argnums=tuple(donate_args))
     with _CACHE_LOCK:
         cur = _CACHE.setdefault(key, jfn)
         won = cur is jfn

@@ -125,6 +125,14 @@ _FLAGS = {
             "'floor:growth[:cap]', an explicit 'a,b,c' size list, or "
             "off|none|0 to disable pad-to-bucket batching",
         ),
+        Flag(
+            "PIPELINE", "", str,
+            "pipelined dispatch plane (pipeline.py): off (default) = "
+            "fully synchronous dispatch; an integer = pipeline depth "
+            "(max batches in flight: wire serde on background workers "
+            "overlapping device compute, resident ops enqueue and "
+            "return ids immediately); on = default depth 2",
+        ),
     ]
 }
 

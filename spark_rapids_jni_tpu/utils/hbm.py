@@ -19,11 +19,13 @@ not enumerate.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
 
 from . import config
+from . import flight
 from . import log
 from . import metrics
 
@@ -96,6 +98,34 @@ def key_word_count(cols: Sequence) -> int:
         if c.validity is not None:
             words += 1
     return words
+
+
+# cumulative donated bytes for the flight counter track (the
+# bucket.pad_waste_bytes discipline: kept locally so the track survives
+# flight-only mode and per-config metrics resets)
+_DONATED_LOCK = threading.Lock()
+_DONATED_TOTAL = 0
+
+
+def note_donation(nbytes: int) -> None:
+    """Record one buffer donation: ``nbytes`` of input HBM the chained
+    executable updated IN PLACE instead of allocating fresh output
+    buffers next to. The plan-vs-budget picture reads this as peak
+    relief — a fused chain that donates never holds input + output of
+    a segment simultaneously, so the steady-state estimates above are
+    conservative by exactly the donated volume."""
+    global _DONATED_TOTAL
+    if not (metrics.enabled() or flight.enabled()):
+        return
+    metrics.counter_add("hbm.donations")
+    metrics.bytes_add("hbm.donated_bytes", int(nbytes))
+    if flight.enabled():
+        # cumulative donated bytes as a counter track: the Chrome trace
+        # shows WHEN in-place chaining kicked in alongside resident.live
+        with _DONATED_LOCK:
+            _DONATED_TOTAL += int(nbytes)
+            total = _DONATED_TOTAL
+        flight.record("C", "hbm.donated_bytes", total)
 
 
 def _record_plan(kind: str, plan: dict, planned_bytes: int) -> None:

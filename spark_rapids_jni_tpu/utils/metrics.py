@@ -228,8 +228,10 @@ NULL_SPAN = _NullSpan()
 
 # span-duration histogram edges in MILLISECONDS: ~x3 rungs from 10us to
 # 30s + overflow — wide enough for a tunnel round-trip, fine enough that
-# analyze_bench's p50/p95 estimates are meaningful
-_SPAN_MS_BOUNDS = (
+# analyze_bench's p50/p95 estimates are meaningful. Public: subsystem-
+# owned duration histograms (pipeline.stall_ms / pipeline.overlap_ms)
+# share these edges so analyze_bench percentiles line up across planes.
+SPAN_MS_BOUNDS = (
     0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0, 300.0,
     1000.0, 3000.0, 10000.0, 30000.0,
 )
@@ -291,7 +293,7 @@ class _Span:
                 stack[-1]._child_s += dur
             self_time_record(self.name, dur - self._child_s)
             hist_observe(
-                "span_ms." + self.name, dur * 1e3, bounds=_SPAN_MS_BOUNDS
+                "span_ms." + self.name, dur * 1e3, bounds=SPAN_MS_BOUNDS
             )
         if exc_type is not None:
             counter_add("span." + self.name + ".errors")

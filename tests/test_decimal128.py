@@ -279,7 +279,9 @@ class TestArrowInterop:
 
         vals = _rand_ints(rng, 40) + [None]
         scale = 10
-        with _dec.localcontext(prec=50):
+        # localcontext(prec=...) kwargs need Python 3.11+
+        with _dec.localcontext() as ctx:
+            ctx.prec = 50
             py = [
                 None if v is None else _dec.Decimal(v).scaleb(-scale)
                 for v in vals

@@ -491,8 +491,30 @@ class TestSerializeSavedBytes:
         )
         assert out[4] == n
         snap = metrics.snapshot()
-        # second STRING column of the same (n, pad) shape reuses the
-        # first one's mask buffer — the only REAL saving the counter
-        # tracks (contiguous fixed-width tobytes never copied anyway);
-        # one reuse of an (n, pad=2) bool buffer
-        assert snap["bytes"]["wire.serialize.saved_bytes"] == n * 2
+        # both STRING columns are constant-width (every "sN" is 2
+        # bytes, pad=2), so each takes the ISSUE-5 serialize fast path:
+        # the (n, pad) row mask is never built at all — counted as one
+        # saved (n, pad) buffer per column
+        assert snap["bytes"]["wire.serialize.saved_bytes"] == 2 * n * 2
+
+    def test_saved_bytes_mask_reuse_for_ragged_strings(self):
+        # ragged lengths force the mask path; the second same-shape
+        # column reuses the first one's mask buffer (the pre-ISSUE-5
+        # saving, still live for non-constant-width payloads)
+        config.set_flag("METRICS", True)
+        n = 64
+        strs = _string_wire(
+            [("s" * ((i % 3) + 1)) for i in range(n)]
+        )
+        metrics.reset()
+        out = rb.table_op_wire(
+            json.dumps({"op": "slice", "start": 0, "stop": n}),
+            [STR, STR, I64], [0, 0, 0],
+            [strs, strs,
+             np.arange(n, dtype=np.int64).tobytes()],
+            [None, None, None], n,
+        )
+        assert out[4] == n
+        snap = metrics.snapshot()
+        # one reuse of an (n, pad=3) bool mask buffer
+        assert snap["bytes"]["wire.serialize.saved_bytes"] == n * 3

@@ -365,6 +365,45 @@ def summarize_plan_fusion(raw: list, merged=None) -> None:
         )
 
 
+def summarize_pipeline(raw: list, merged=None) -> None:
+    """Pipelined-dispatch summary: per-entry ``pipeline`` blocks (the
+    bench ``pipelined_stream`` config) plus the merged ``pipeline.*``
+    counters — depth, overlap fraction, stalls/replays, donated and
+    batch-upload savings. Old BENCH files have neither — silent skip,
+    like the other metrics summaries."""
+    if merged is None:
+        merged = _merge_metrics(raw)
+    c = merged["counters"]
+    b = merged["bytes"]
+    blocks = [e for e in raw if isinstance(e.get("pipeline"), dict)]
+    enq = int(c.get("pipeline.enqueued", 0))
+    donated = int(b.get("hbm.donated_bytes", 0))
+    if not (blocks or enq or donated):
+        return
+    print("\npipelined dispatch:")
+    if enq or donated:
+        print(
+            f"  stages={enq} completed={int(c.get('pipeline.completed', 0))} "
+            f"stalls={int(c.get('pipeline.stalls', 0))} "
+            f"replays={int(c.get('pipeline.replays', 0))} "
+            f"donated {donated / 1e6:.2f} MB over "
+            f"{int(c.get('hbm.donations', 0))} donations, "
+            f"batched-upload transfers saved "
+            f"{int(c.get('wire.upload.batched', 0))}"
+        )
+    for e in blocks:
+        p = e["pipeline"]
+        print(
+            f"  {e.get('name', '?'):42} depth={p.get('depth', '?')} "
+            f"overlap {p.get('overlap_fraction', '?')} "
+            f"({p.get('overlap_ms', '?')} ms) "
+            f"stalls={p.get('stalls', '?')} "
+            f"donated {int(p.get('donated_bytes', 0)) / 1e6:.2f} MB; "
+            f"warm {e.get('warm_speedup', '?')}x vs per-op sync, "
+            f"{e.get('vs_plan_sync', '?')}x vs plan sync"
+        )
+
+
 def summarize_failures(raw: list) -> None:
     """Print the structured failure records (diagnosable-from-JSON)."""
     fails = [e for e in raw if isinstance(e.get("failure"), dict)]
@@ -397,6 +436,7 @@ def main() -> None:
         summarize_spans(raw, merged=merged)
         summarize_compile_cache(raw)
         summarize_plan_fusion(raw, merged=merged)
+        summarize_pipeline(raw, merged=merged)
         summarize_failures(raw)
         return
     for label, arms in _GROUPS.items():
@@ -423,6 +463,7 @@ def main() -> None:
     summarize_spans(raw, merged=merged)
     summarize_compile_cache(raw)
     summarize_plan_fusion(raw, merged=merged)
+    summarize_pipeline(raw, merged=merged)
     summarize_failures(raw)
 
 
