@@ -132,6 +132,7 @@ def _metrics_enable():
 
         _srt_config.set_flag("METRICS", True)
         _srt_config.set_flag("FLIGHT", True)
+        _srt_config.set_flag("PROFILE", "on")
     except Exception:
         pass
 
@@ -144,9 +145,11 @@ def _flush_telemetry():
     try:
         from spark_rapids_jni_tpu.utils import flight as _srt_flight
         from spark_rapids_jni_tpu.utils import metrics as _srt_metrics
+        from spark_rapids_jni_tpu.utils import profiler as _srt_profiler
 
         _srt_metrics.dump()
         _srt_flight.dump()
+        _srt_profiler.dump()
     except Exception:
         pass
 
@@ -162,6 +165,27 @@ def _metrics_snapshot(reset=False):
         if reset:
             _srt_metrics.reset()
         return snap
+    except Exception:
+        return None
+
+
+def _profile_block(reset=False):
+    """Aggregated per-segment profiler summary for this config's
+    sessions (utils/profiler.summarize), or None when the package is
+    absent or no session ran. ``reset=True`` clears the session
+    registry afterward — the _metrics_snapshot discipline, so
+    consecutive in-process configs get per-config blocks."""
+    try:
+        from spark_rapids_jni_tpu.utils import profiler as _srt_profiler
+
+        docs = _srt_profiler.sessions(reset=reset)
+        if not docs:
+            return None
+        block = _srt_profiler.summarize(docs)
+        # keep the LAST few full session docs for tools/explain.py;
+        # the aggregate above is the compact per-config story
+        block["sessions_tail"] = docs[-3:]
+        return block
     except Exception:
         return None
 
@@ -1585,10 +1609,13 @@ def _guard(entries, name, fn):
     # first config's counters (the subprocess path is per-config by
     # virtue of the fresh process)
     snap = _metrics_snapshot(reset=True)
+    prof = _profile_block(reset=True)
     for g in got:
         _progress(f"  {g}")  # progress line WITHOUT the bulky block
         if snap is not None:
             g.setdefault("metrics", snap)
+        if prof is not None:
+            g.setdefault("profile", prof)
     entries.extend(got)
     return out
 
@@ -1695,10 +1722,13 @@ def _run_one(name: str) -> None:
     out = _SUBPROCESS_CONFIGS[name](platform)
     got = out if isinstance(out, list) else [out]
     snap = _metrics_snapshot()
+    prof = _profile_block()
     for g in got:
         g.setdefault("platform", platform)
         if snap is not None:
             g["metrics"] = snap
+        if prof is not None:
+            g["profile"] = prof
         print("BENCH_ENTRY " + json.dumps(g), flush=True)
 
 

@@ -149,3 +149,81 @@ print(
     f"+ {len(stage)} stage spans on {len(worker_tids)} worker tid(s)",
 )
 PY
+
+# query profiler + EXPLAIN ANALYZE (ISSUE 8): two fused-plan runs under
+# PROFILE=on (distinct processes -> distinct pids) must each leave a
+# flight dump carrying profile sessions; explain.py must render a
+# per-op report naming EVERY plan op with a nonzero fused count and a
+# valid --json form, and --merge must combine both dumps into one
+# report + one Perfetto trace with two process tracks
+export SPARK_RAPIDS_TPU_PROFILE=on
+export SRT_BENCH_PLAN_ROWS=4000
+
+export SPARK_RAPIDS_TPU_METRICS_DUMP="$out/metrics_prof0.json"
+export SPARK_RAPIDS_TPU_FLIGHT_DUMP="$out/flight_prof0.json"
+export SPARK_RAPIDS_TPU_PROFILE_DUMP="$out/profile0.json"
+python3 bench.py --one fused_plan > "$out/bench_prof0.json"
+export SPARK_RAPIDS_TPU_METRICS_DUMP="$out/metrics_prof1.json"
+export SPARK_RAPIDS_TPU_FLIGHT_DUMP="$out/flight_prof1.json"
+export SPARK_RAPIDS_TPU_PROFILE_DUMP="$out/profile1.json"
+python3 bench.py --one fused_plan > "$out/bench_prof1.json"
+# the analysis tools below import the package too — drop the dump envs
+# so THEIR atexit hooks can't clobber the artifacts under test
+unset SPARK_RAPIDS_TPU_PROFILE SPARK_RAPIDS_TPU_PROFILE_DUMP \
+  SPARK_RAPIDS_TPU_FLIGHT_DUMP SPARK_RAPIDS_TPU_METRICS_DUMP
+
+test -s "$out/profile0.json"
+test -s "$out/profile1.json"
+python3 -m json.tool "$out/profile0.json" > /dev/null
+
+# the report names every plan op, shows fused segments, and the
+# machine form is valid JSON with the split-sums invariant
+python3 tools/explain.py "$out/profile0.json" > "$out/explain.txt"
+grep -q "EXPLAIN ANALYZE" "$out/explain.txt"
+for op in filter cast sort_by groupby; do
+  grep -q "$op" "$out/explain.txt"
+done
+grep -q "fused)" "$out/explain.txt"
+python3 tools/explain.py --json "$out/profile0.json" > "$out/explain.json"
+python3 - "$out/explain.json" <<'PY'
+import json
+import sys
+
+sessions = json.load(open(sys.argv[1]))
+assert sessions, "no sessions in --json output"
+fused = 0
+for s in sessions:
+    for seg in s["segments"]:
+        fused += seg["kind"] == "fused"
+        total = (
+            seg["compile_s"] + seg["execute_s"] + seg["serde_s"]
+            + seg["stall_s"]
+        )
+        assert abs(total - seg["wall_s"]) < 1e-6, seg
+assert fused > 0, "no fused segments profiled"
+print(f"explain smoke OK: {len(sessions)} sessions, {fused} fused segments")
+PY
+
+# multi-process merge: both flight dumps (which carry the sessions and
+# the pid/host/session_id stamps) -> one report + one Perfetto trace
+# with two distinct process tracks
+python3 tools/explain.py --merge \
+  "$out/flight_prof0.json" "$out/flight_prof1.json" \
+  -o "$out/merged.trace.json" > "$out/merged.txt"
+grep -q "MERGED PROFILE  2 process(es)" "$out/merged.txt"
+python3 - "$out/merged.trace.json" <<'PY'
+import json
+import sys
+
+trace = json.load(open(sys.argv[1]))
+events = trace["traceEvents"]
+assert events, "empty merged trace"
+pids = {e["pid"] for e in events}
+assert len(pids) >= 2, f"merge kept only {pids}"
+names = [e for e in events if e["name"] == "process_name"]
+assert len({e["pid"] for e in names}) >= 2, names
+print(
+    f"profile merge smoke OK: {len(events)} events across "
+    f"{len(pids)} process tracks"
+)
+PY

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from . import dtype as dt
 from .column import Column, Table
-from .utils import buckets, log, metrics
+from .utils import buckets, log, metrics, profiler
 
 
 class _Decline(Exception):
@@ -71,6 +71,7 @@ def dispatch_bucketed(
             # falls back to the exact path, which raises the real error
             # if the op itself is at fault
             metrics.counter_add("bucket.fallback_errors")
+            profiler.note_fallback("bucketed")
             if name not in _WARNED_OPS:
                 _WARNED_OPS.add(name)
                 log.log(
@@ -107,6 +108,7 @@ def dispatch_bucketed_donated(
             if plan_mod._input_consumed(table):
                 raise
             metrics.counter_add("bucket.fallback_errors")
+            profiler.note_fallback("bucketed")
             if name not in _WARNED_OPS:
                 _WARNED_OPS.add(name)
                 log.log(

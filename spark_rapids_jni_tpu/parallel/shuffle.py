@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..column import Column, Table
 from ..ops.partition import partition_ids_hash
-from ..utils import flight, metrics
+from ..utils import flight, metrics, profiler
 from .mesh import SHUFFLE_AXIS, shard_map, shard_table
 
 
@@ -391,6 +391,7 @@ def shuffle_table_compact(
     """
     metrics.counter_add("shuffle.exchanges")
     metrics.counter_add("shuffle.rows_exchanged", table.row_count)
+    profiler.note_shuffle(table.row_count)
     if flight.enabled():
         flight.record("I", "shuffle.exchange", table.row_count)
     validate_on_overflow(on_overflow)
@@ -440,6 +441,7 @@ def shuffle_table(
     """
     metrics.counter_add("shuffle.exchanges")
     metrics.counter_add("shuffle.rows_exchanged", table.row_count)
+    profiler.note_shuffle(table.row_count)
     if flight.enabled():
         flight.record("I", "shuffle.exchange", table.row_count)
     validate_on_overflow(on_overflow)

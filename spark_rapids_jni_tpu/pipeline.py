@@ -46,7 +46,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
-from .utils import config, flight, log, metrics
+from .utils import config, flight, log, metrics, profiler
 
 DEFAULT_DEPTH = 2
 MAX_DEPTH = 64
@@ -361,6 +361,7 @@ def _log_dropped_failure(label: str, error: BaseException) -> None:
 
 
 def _note_stall(seconds: float) -> None:
+    profiler.note_stall(seconds)
     if getattr(_WORKER_TLS, "on", False):
         # a worker blocked on an input: subtracted from that job's
         # overlap_ms so the wait isn't double-counted as overlap

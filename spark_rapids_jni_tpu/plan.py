@@ -46,7 +46,7 @@ import jax.numpy as jnp
 
 from . import dtype as dt
 from .column import Column, Table
-from .utils import buckets, flight, log, metrics
+from .utils import buckets, flight, log, metrics, profiler
 
 # single-table ops a fused segment can carry anywhere in its run
 _SIMPLE_FUSABLE = frozenset(
@@ -389,48 +389,76 @@ def run_plan(
             with metrics.span(
                 "plan.segment", index=i, kind=kind, ops=len(seg_ops)
             ):
-                replay = seg_ops
-                if kind == "fused":
-                    donate = owned and protected.isdisjoint(
-                        _buffer_ids(table)
-                    )
-                    try:
-                        table = _run_fused(seg_ops, table, donate=donate)
-                        metrics.counter_add("plan.fused_segments")
-                        metrics.counter_add("plan.fused_ops", len(seg_ops))
-                        replay = ()
-                    except bucketed._Decline:
-                        # not a failure: no bucket for this shape —
-                        # the per-op path owns it
-                        metrics.counter_add("plan.declined")
-                    except Exception as e:
-                        if _input_consumed(table):
-                            # the donated executable failed AFTER
-                            # consuming its input: a per-op replay
-                            # would dereference deleted buffers —
-                            # surface the real error instead
-                            raise
-                        # fusion must never change semantics: replay
-                        # per-op; the exact path raises the real error
-                        # if an op itself is at fault
-                        metrics.counter_add("plan.fallbacks")
-                        names = ",".join(
-                            str(o.get("op", "?")) for o in seg_ops
+                pseg = profiler.segment_begin(
+                    i, kind, seg_ops,
+                    rows_in=int(table.logical_row_count),
+                )
+                fell_back = False
+                try:
+                    replay = seg_ops
+                    if kind == "fused":
+                        donate = owned and protected.isdisjoint(
+                            _buffer_ids(table)
                         )
-                        if flight.enabled():
-                            flight.record("I", "plan.fallback", names)
-                        if names not in _WARNED_SIGS:
-                            _WARNED_SIGS.add(names)
-                            log.log(
-                                "WARN", "plan", "fused_segment_failed",
-                                ops=names,
-                                error=f"{type(e).__name__}: {str(e)[:200]}",
+                        try:
+                            table = _run_fused(
+                                seg_ops, table, donate=donate
                             )
-                for op in replay:
-                    table = runtime_bridge._dispatch(
-                        op, table, _take_rest(op, orig_rest, queue)
-                    )
-                    metrics.counter_add("plan.exact_ops")
+                            metrics.counter_add("plan.fused_segments")
+                            metrics.counter_add(
+                                "plan.fused_ops", len(seg_ops)
+                            )
+                            replay = ()
+                        except bucketed._Decline:
+                            # not a failure: no bucket for this shape —
+                            # the per-op path owns it
+                            metrics.counter_add("plan.declined")
+                        except Exception as e:
+                            if _input_consumed(table):
+                                # the donated executable failed AFTER
+                                # consuming its input: a per-op replay
+                                # would dereference deleted buffers —
+                                # surface the real error instead
+                                raise
+                            # fusion must never change semantics: replay
+                            # per-op; the exact path raises the real
+                            # error if an op itself is at fault
+                            fell_back = True
+                            metrics.counter_add("plan.fallbacks")
+                            names = ",".join(
+                                str(o.get("op", "?")) for o in seg_ops
+                            )
+                            if flight.enabled():
+                                flight.record("I", "plan.fallback", names)
+                            if names not in _WARNED_SIGS:
+                                _WARNED_SIGS.add(names)
+                                log.log(
+                                    "WARN", "plan",
+                                    "fused_segment_failed",
+                                    ops=names,
+                                    error=(
+                                        f"{type(e).__name__}: "
+                                        f"{str(e)[:200]}"
+                                    ),
+                                )
+                    for op in replay:
+                        table = runtime_bridge._dispatch(
+                            op, table, _take_rest(op, orig_rest, queue)
+                        )
+                        metrics.counter_add("plan.exact_ops")
+                finally:
+                    if pseg is not None:
+                        from .utils import hbm
+
+                        try:
+                            ro = int(table.logical_row_count)
+                            ob = int(hbm.table_bytes(table))
+                        except Exception:  # donated-and-failed input
+                            ro, ob = 0, 0
+                        profiler.segment_end(
+                            pseg, rows_out=ro, out_bytes=ob,
+                            fallback=fell_back,
+                        )
             # every segment output is a fresh plan-owned intermediate:
             # the NEXT fused segment may donate it
             owned = True

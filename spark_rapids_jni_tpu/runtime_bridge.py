@@ -39,7 +39,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 from . import dtype as dt
 from . import pipeline
 from .column import Column, Table
-from .utils import buckets, flight, log, metrics
+from .utils import buckets, flight, log, metrics, profiler
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -573,16 +573,21 @@ def _table_from_wire(
     """One wire-deserialize pass -> a (possibly host-padded) Table.
     Host decode per column, then the whole table's buffers cross to the
     device as ONE batched ``jax.device_put`` pytree transfer."""
+    prof = profiler.session_active()
+    nbytes = (
+        sum(len(d) for d in datas if d is not None)
+        if (prof or flight.enabled()) else 0
+    )
     if flight.enabled():
-        flight.record(
-            "I", "wire.in",
-            sum(len(d) for d in datas if d is not None),
-        )
+        flight.record("I", "wire.in", nbytes)
+    t0 = _time.perf_counter() if prof else 0.0
     with metrics.span("wire.deserialize"):
         cols = _upload_host_columns([
             _host_column_from_wire(t, s, d, v, num_rows, pad_to=pad_to)
             for t, s, d, v in zip(type_ids, scales, datas, valids)
         ])
+    if prof:
+        profiler.note_serde("in", _time.perf_counter() - t0, nbytes)
     tbl = Table(cols, logical_rows=num_rows if pad_to is not None else None)
     if pad_to is not None:
         buckets.note_padded(tbl)
@@ -595,6 +600,8 @@ def _table_to_wire(t: Table):
     ``_SerializePass`` scratch across the table's columns)."""
     out_t, out_s, out_d, out_v = [], [], [], []
     ctx = _SerializePass()
+    prof = profiler.session_active()
+    t0 = _time.perf_counter() if prof else 0.0
     with metrics.span("wire.serialize"):
         for c in t.columns:
             ti, s, d, v = _column_to_wire(c, t.logical_rows, ctx)
@@ -602,10 +609,14 @@ def _table_to_wire(t: Table):
             out_s.append(s)
             out_d.append(d)
             out_v.append(v)
-    if flight.enabled():
-        flight.record(
-            "I", "wire.out", sum(len(d) for d in out_d if d is not None)
-        )
+    if prof or flight.enabled():
+        nbytes = sum(len(d) for d in out_d if d is not None)
+        if flight.enabled():
+            flight.record("I", "wire.out", nbytes)
+        if prof:
+            profiler.note_serde(
+                "out", _time.perf_counter() - t0, nbytes
+            )
     return out_t, out_s, out_d, out_v, int(t.logical_row_count)
 
 
@@ -677,12 +688,13 @@ def table_plan_wire(
     ops = json.loads(plan_json)
     if not isinstance(ops, list):
         raise TypeError("table_plan_wire: plan must be a JSON list of ops")
-    tbl = _table_from_wire(
-        type_ids, scales, datas, valids, num_rows,
-        _plan_pad_to(ops, num_rows),
-    )
-    result = plan_mod.run_plan(ops, tbl, donate_input=True)
-    return _table_to_wire(result)
+    with profiler.maybe_session(ops, label="plan_wire"):
+        tbl = _table_from_wire(
+            type_ids, scales, datas, valids, num_rows,
+            _plan_pad_to(ops, num_rows),
+        )
+        result = plan_mod.run_plan(ops, tbl, donate_input=True)
+        return _table_to_wire(result)
 
 
 def table_stream_wire(plan_json: str, batches: Sequence) -> list:
@@ -719,10 +731,15 @@ def table_stream_wire(plan_json: str, batches: Sequence) -> list:
         return plan_mod.run_plan(ops, tbl, donate_input=True)
 
     batches = list(batches)
-    with metrics.span(
-        "stream", batches=len(batches), depth=pipeline.depth()
+    with profiler.maybe_session(
+        ops, label="stream", batches=len(batches)
     ):
-        return pipeline.run_stream(batches, decode, compute, _table_to_wire)
+        with metrics.span(
+            "stream", batches=len(batches), depth=pipeline.depth()
+        ):
+            return pipeline.run_stream(
+                batches, decode, compute, _table_to_wire
+            )
 
 
 def platform() -> str:
@@ -833,6 +850,11 @@ def _resident_put(t) -> int:
         }
         if is_pending:
             meta["pending"] = t.label
+        sid = profiler.current_session_id()
+        if sid is not None:
+            # which profiled plan run allocated this table: the leak
+            # report names the session, the session report the leak
+            meta["session"] = sid
     with _RESIDENT_LOCK:
         _RESIDENT[tid] = t
         if meta is not None:
@@ -1026,12 +1048,16 @@ def table_plan_resident(
     cell: dict = {}
 
     def work():
-        tables = pipeline.materialize_inputs(cell["inputs"])
-        for p in cell["barrier"]:
-            p.settle_terminally()
-        return plan_mod.run_plan(
-            ops, tables[0], tables[1:], donate_input=donate
-        )
+        # the session opens INSIDE the work closure so it scopes the
+        # actual execution — on a pipeline worker when enqueued, on the
+        # caller when synchronous — not the enqueue-and-return
+        with profiler.maybe_session(ops, label="plan_resident"):
+            tables = pipeline.materialize_inputs(cell["inputs"])
+            for p in cell["barrier"]:
+                p.settle_terminally()
+            return plan_mod.run_plan(
+                ops, tables[0], tables[1:], donate_input=donate
+            )
 
     if pipeline.enabled():
         # capture + reader registration are atomic (see
@@ -1127,14 +1153,18 @@ def leak_report() -> list:
             settled = t.value_nowait()
             if settled is not None:
                 t, pending = settled, False
+        logical = None if pending else int(t.logical_row_count)
         rec = {
             "table_id": tid,
-            "rows": None if pending else int(t.logical_row_count),
+            "rows": logical,
+            "logical_rows": logical,
             "columns": None if pending else len(t.columns),
             "allocated_under": meta.get("allocated_under", []),
         }
         if pending:
             rec["pending"] = t.label
+        if meta.get("session"):
+            rec["session"] = meta["session"]
         anchor = meta.get("age_anchor_ns")
         if anchor is not None:
             rec["age_s"] = round((now - anchor) / 1e9, 3)
@@ -1168,7 +1198,7 @@ def _leak_report_at_exit() -> None:  # pragma: no cover - atexit path
         under = "/".join(rec["allocated_under"]) or "<no span>"
         print(
             f"[srt][leak][WARN]   table_id={rec['table_id']} "
-            f"rows={rec['rows']} cols={rec['columns']} "
+            f"logical_rows={rec['logical_rows']} cols={rec['columns']} "
             f"bytes~{rec.get('approx_bytes', '?')} "
             f"allocated_under={under}",
             file=_sys.stderr,

@@ -43,6 +43,7 @@ from . import config
 from . import flight
 from . import log
 from . import metrics
+from . import profiler
 
 # default ladder: 1024, 2048, ... 2^23 (8.4M rows). The cap keeps the
 # fused join graphs the bucketed runners build below the TPU worker
@@ -223,7 +224,8 @@ def _record_pad_metrics(table, target: int, logical: int) -> None:
     """Pad-waste accounting shared by the device-side ``pad_table`` and
     the host-side wire upload padding (runtime_bridge)."""
     global _PAD_WASTE_TOTAL
-    if not (metrics.enabled() or flight.enabled()):
+    if not (metrics.enabled() or flight.enabled()
+            or profiler.session_active()):
         return
     from . import hbm
 
@@ -234,6 +236,7 @@ def _record_pad_metrics(table, target: int, logical: int) -> None:
         per_row = -(-hbm.table_bytes(table) // max(table.row_count, 1))
         waste = extra * per_row
         metrics.bytes_add("bucket.pad_waste_bytes", waste)
+        profiler.note_pad(extra, waste)
         if flight.enabled():
             # cumulative waste as a flight counter track: the Chrome
             # trace shows WHEN padding cost spiked, not just how much
@@ -375,6 +378,7 @@ def cached_jit(
             _CACHE.move_to_end(key)
     if fn is not None:
         metrics.counter_add("compile_cache.hit")
+        profiler.note_cache(True)
         return fn
     import jax
 
@@ -391,6 +395,7 @@ def cached_jit(
         size = len(_CACHE)
     if won:
         metrics.counter_add("compile_cache.miss")
+        profiler.note_cache(False)
         metrics.gauge_set("compile_cache.size", size)
         if flight.enabled():
             # a miss on the hot path means an XLA compile is coming —
@@ -399,9 +404,17 @@ def cached_jit(
         if log.enabled("DEBUG", "buckets"):
             log.log("DEBUG", "buckets", "compile_cache_miss", name=name,
                     size=size)
+        if profiler.session_active():
+            # jax.jit compiles lazily at the FIRST call: hand this
+            # caller (the miss winner — the launch about to pay the
+            # compile) a transient wrapper that times that call and
+            # attributes it as compile_s to the active segment. The
+            # cache keeps the raw jfn, so steady state is untouched.
+            cur = profiler.time_first_call(cur, name)
     else:
         # another thread built the same key first; use theirs
         metrics.counter_add("compile_cache.hit")
+        profiler.note_cache(True)
     return cur
 
 

@@ -133,6 +133,21 @@ _FLAGS = {
             "overlapping device compute, resident ops enqueue and "
             "return ids immediately); on = default depth 2",
         ),
+        Flag(
+            "PROFILE", "", str,
+            "query profiler (utils/profiler.py): on = auto-open a "
+            "profile session around every table_plan_wire / "
+            "table_plan_resident / table_stream_wire call, collecting "
+            "per-segment compile/execute/serde/stall splits rendered "
+            "by tools/explain.py; off (default) costs one cached "
+            "generation compare per entry",
+        ),
+        Flag(
+            "PROFILE_DUMP", "", str,
+            "path to write finished profile sessions as JSON at "
+            "process exit (atexit) and from the bench SIGTERM handler; "
+            "a non-empty path implies PROFILE",
+        ),
     ]
 }
 

@@ -47,12 +47,15 @@ import atexit
 import itertools
 import json
 import os
+import socket
 import sys
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from . import config
+
+_HOSTNAME = socket.gethostname()
 
 DEFAULT_CAPACITY = 8192
 # pow2 ceiling on env-sized rings: a typo'd huge capacity must not
@@ -78,6 +81,22 @@ _GEN = -1
 _WARNED_SPEC = False
 
 _EXIT_SECTIONS: Dict[str, Callable[[], Any]] = {}
+
+# (pid, host, session_id, ...) metadata stamped into every dump so a
+# multi-process merge (tools/explain.py --merge) can tell the dumps
+# apart; the profiler stamps the current session id through here
+_PROCESS_META: Dict[str, Any] = {}
+
+
+def set_process_meta(**kv) -> None:
+    """Attach metadata keys to every future ``snapshot()``/``dump()``
+    (``utils/profiler.py`` stamps ``session_id``); a None value removes
+    the key."""
+    for k, v in kv.items():
+        if v is None:
+            _PROCESS_META.pop(k, None)
+        else:
+            _PROCESS_META[k] = v
 
 
 def _capacity_of(value) -> int:
@@ -224,12 +243,15 @@ def snapshot(limit: Optional[int] = None) -> dict:
     doc = {
         "version": 1,
         "pid": os.getpid(),
+        "host": _HOSTNAME,
         "capacity": capacity(),
         "dropped": dropped(),
         "epoch_ns": _EPOCH_NS,
         "anchor_perf_ns": _ANCHOR_NS,
         "events": evs,
     }
+    for k, v in _PROCESS_META.items():
+        doc.setdefault(k, v)
     sections = {}
     for name, fn in _EXIT_SECTIONS.items():
         try:
@@ -248,6 +270,7 @@ def reset() -> None:
         _SLOTS = None
         _SEQ = itertools.count()
         _GEN = -1
+        _PROCESS_META.clear()
 
 
 def dump(path: Optional[str] = None) -> Optional[str]:

@@ -28,6 +28,7 @@ from . import config
 from . import flight
 from . import log
 from . import metrics
+from . import profiler
 
 GIB = 1 << 30
 
@@ -115,6 +116,7 @@ def note_donation(nbytes: int) -> None:
     a segment simultaneously, so the steady-state estimates above are
     conservative by exactly the donated volume."""
     global _DONATED_TOTAL
+    profiler.note_donation(int(nbytes))
     if not (metrics.enabled() or flight.enabled()):
         return
     metrics.counter_add("hbm.donations")

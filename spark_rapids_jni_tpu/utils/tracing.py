@@ -78,7 +78,13 @@ def _chrome_cat(name: str) -> str:
     return leaf.split(".", 1)[0] if "." in leaf else leaf
 
 
-def to_chrome_trace(events, pid: int = 0) -> dict:
+def to_chrome_trace(
+    events,
+    pid: int = 0,
+    process_name: Optional[str] = None,
+    process_sort_index: Optional[int] = None,
+    t0_ns: Optional[int] = None,
+) -> dict:
     """Flight-recorder event dicts -> a Chrome Trace Event JSON object.
 
     ``events`` is the ``tail_records()`` / flight-dump ``"events"``
@@ -97,12 +103,16 @@ def to_chrome_trace(events, pid: int = 0) -> dict:
 
     ``I`` events become instants (``ph:"i"``), ``C`` events become
     counter tracks (``ph:"C"``, one series per name). Thread-name
-    metadata rows give each tid a stable label.
+    metadata rows give each tid a stable label; ``process_name`` /
+    ``process_sort_index`` label the process track (a multi-process
+    merge passes "host:pid" per dump so timelines stop colliding on tid
+    alone), and ``t0_ns`` pins the timeline origin so several dumps
+    share one clock (``merge_chrome_traces``).
     """
     evs = sorted(events, key=lambda e: e.get("seq", 0))
     if not evs:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    t0 = min(e["t_ns"] for e in evs)
+    t0 = min(e["t_ns"] for e in evs) if t0_ns is None else t0_ns
     t_end = max(e["t_ns"] for e in evs)
 
     def us(t_ns: int) -> float:
@@ -189,8 +199,16 @@ def to_chrome_trace(events, pid: int = 0) -> dict:
         "ph": "M",
         "pid": pid,
         "tid": 0,
-        "args": {"name": "spark-rapids-tpu"},
+        "args": {"name": process_name or "spark-rapids-tpu"},
     }]
+    if process_sort_index is not None:
+        meta.append({
+            "name": "process_sort_index",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"sort_index": int(process_sort_index)},
+        })
     for i, tid in enumerate(tids):
         meta.append({
             "name": "thread_name",
@@ -207,6 +225,56 @@ def to_chrome_trace(events, pid: int = 0) -> dict:
             "args": {"sort_index": i},
         })
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def merge_chrome_traces(dumps) -> dict:
+    """Several flight dumps -> ONE Chrome/Perfetto trace with one
+    process track per dump.
+
+    Each dump's ``perf_counter_ns`` timestamps are epoch-less and
+    process-local; the wall-clock anchors every dump carries
+    (``epoch_ns`` + ``anchor_perf_ns``, utils/flight.py) shift each
+    event to wall time, and the earliest event across ALL dumps becomes
+    the shared origin — so two processes' timelines line up the way
+    they actually overlapped. Per dump: its own ``pid`` (bumped on
+    collision — two hosts can reuse a pid), a ``process_name`` of
+    "host:pid" (plus the profiler session id when stamped), and a
+    ``process_sort_index`` preserving input order."""
+    prepped = []
+    for d in dumps:
+        evs = [
+            e for e in (d.get("events") or [])
+            if isinstance(e, dict) and "t_ns" in e
+        ]
+        if not evs:
+            continue
+        epoch = d.get("epoch_ns")
+        anchor = d.get("anchor_perf_ns")
+        shift = (epoch - anchor) if (
+            epoch is not None and anchor is not None
+        ) else 0
+        evs = [dict(e, t_ns=e["t_ns"] + shift) for e in evs]
+        prepped.append((d, evs))
+    if not prepped:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    origin = min(e["t_ns"] for _, evs in prepped for e in evs)
+    merged: list = []
+    used_pids: set = set()
+    for i, (d, evs) in enumerate(prepped):
+        pid = int(d.get("pid") or (i + 1))
+        while pid in used_pids:
+            pid += 1
+        used_pids.add(pid)
+        name = f"{d.get('host', '?')}:{d.get('pid', pid)}"
+        sid = d.get("session_id")
+        if sid:
+            name = f"{name} [{str(sid)[:8]}]"
+        tr = to_chrome_trace(
+            evs, pid=pid, process_name=name, process_sort_index=i,
+            t0_ns=origin,
+        )
+        merged.extend(tr["traceEvents"])
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
 
 
 @contextlib.contextmanager

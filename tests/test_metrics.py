@@ -40,7 +40,7 @@ def _metrics_isolated(monkeypatch):
     flight.reset()
     yield
     for f in ("METRICS", "METRICS_DUMP", "LOG_LEVEL", "TRACE",
-              "FLIGHT", "FLIGHT_DUMP"):
+              "FLIGHT", "FLIGHT_DUMP", "PROFILE", "PROFILE_DUMP"):
         config.clear_flag(f)
     metrics.reset()
     flight.reset()

@@ -1,0 +1,437 @@
+"""The query-profiler plane (ISSUE 8 tentpole).
+
+Covers the attribution invariants the EXPLAIN ANALYZE report rests on:
+per-segment compile/execute/serde/stall splits sum to the session wall
+time, a forced compile-cache miss shows up as compile time on exactly
+the segment that launched it, multi-process merges preserve every
+session and every flight event on one wall-clock-ordered timeline, the
+disabled path stays in the metrics-gate overhead class, the
+``(pid, host, session_id)`` stamping of flight dumps, the leak report's
+``logical_rows``/bytes fields, and the ``tools/explain.py`` renderer.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import runtime_bridge as rb
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.utils import (
+    buckets,
+    config,
+    flight,
+    metrics,
+    profiler,
+    tracing,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+I64 = int(dt.TypeId.INT64)
+B8 = int(dt.TypeId.BOOL8)
+
+# the bench fused_plan chain: one 4-op fused segment
+CHAIN = [
+    {"op": "filter", "mask": 2},
+    {"op": "cast", "column": 1, "type_id": int(dt.TypeId.FLOAT64)},
+    {"op": "sort_by", "keys": [{"column": 0}]},
+    {"op": "groupby", "by": [0],
+     "aggs": [{"column": 1, "agg": "sum"}]},
+]
+
+
+@pytest.fixture(autouse=True)
+def _profiler_isolated(monkeypatch):
+    for env in ("PROFILE", "PROFILE_DUMP", "FLIGHT", "FLIGHT_DUMP",
+                "METRICS", "METRICS_DUMP"):
+        monkeypatch.delenv("SPARK_RAPIDS_TPU_" + env, raising=False)
+        # a flag OVERRIDE leaked by an earlier module (bench helpers
+        # run in-process set PROFILE/METRICS/FLIGHT) beats the env
+        config.clear_flag(env)
+    profiler.reset()
+    flight.reset()
+    metrics.reset()
+    yield
+    for f in ("PROFILE", "PROFILE_DUMP", "FLIGHT", "FLIGHT_DUMP",
+              "METRICS", "METRICS_DUMP"):
+        config.clear_flag(f)
+    profiler.reset()
+    flight.reset()
+    metrics.reset()
+
+
+def _wire_inputs(n=2500, seed=7):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 100, n, dtype=np.int64)
+    v = rng.integers(-100, 100, n, dtype=np.int64)
+    m = (v > 0).astype(np.uint8)
+    return (
+        [I64, I64, B8], [0, 0, 0],
+        [k.tobytes(), v.tobytes(), m.tobytes()],
+        [None, None, None], n,
+    )
+
+
+def _run_chain(plan=None):
+    return rb.table_plan_wire(json.dumps(plan or CHAIN), *_wire_inputs())
+
+
+class TestGate:
+    def test_disabled_by_default(self):
+        assert not profiler.enabled()
+        assert not profiler.session_active()
+        scope = profiler.maybe_session([], label="x")
+        assert scope is profiler._NULL_SCOPE
+        _run_chain()
+        assert profiler.sessions() == []
+
+    def test_flag_enables_auto_sessions(self):
+        config.set_flag("PROFILE", "on")
+        assert profiler.enabled()
+        _run_chain()
+        docs = profiler.sessions()
+        assert len(docs) == 1
+        assert docs[0]["label"] == "plan_wire"
+
+    def test_dump_path_implies_profile(self, tmp_path):
+        config.set_flag("PROFILE_DUMP", str(tmp_path / "p.json"))
+        assert profiler.enabled()
+
+    def test_hooks_without_session_are_noops(self):
+        profiler.note_cache(True)
+        profiler.note_compile("x", 0.1)
+        profiler.note_serde("in", 0.1, 10)
+        profiler.note_stall(0.1)
+        profiler.note_pad(1, 2)
+        profiler.note_donation(3)
+        profiler.note_fallback("fused")
+        profiler.note_shuffle(4)
+        assert profiler.segment_begin(0, "fused", CHAIN) is None
+        profiler.segment_end(None)
+        assert profiler.sessions() == []
+
+
+class TestAttribution:
+    def test_splits_sum_to_session_wall(self):
+        """The acceptance invariant: per-segment splits + boundary +
+        unattributed == session wall, by construction."""
+        config.set_flag("PROFILE", "on")
+        _run_chain(CHAIN + [{"op": "concat"}])
+        doc = profiler.sessions()[-1]
+        segs = doc["segments"]
+        assert len(segs) >= 2  # the fused run + the exact boundary op
+        assert {s["kind"] for s in segs} == {"fused", "exact"}
+        for s in segs:
+            total = (
+                s["compile_s"] + s["execute_s"] + s["serde_s"]
+                + s["stall_s"]
+            )
+            assert total == pytest.approx(s["wall_s"], abs=1e-9)
+        b = doc["boundary"]
+        covered = (
+            sum(s["wall_s"] for s in segs)
+            + b["serde_s"] + b["stall_s"] + b["compile_s"]
+            + doc["unattributed_s"]
+        )
+        assert covered == pytest.approx(doc["wall_s"], rel=1e-6)
+        # the wire upload/download happened outside any segment
+        assert b["serde_bytes_in"] > 0 and b["serde_bytes_out"] > 0
+
+    def test_forced_cache_miss_is_compile_time_on_fused_segment(self):
+        config.set_flag("PROFILE", "on")
+        buckets.cache_clear()
+        _run_chain()
+        cold = profiler.sessions()[-1]["segments"][0]
+        assert cold["kind"] == "fused"
+        assert cold["cache_misses"] >= 1
+        assert cold["compile_s"] > 0
+        # the compile dominates the cold fused segment's wall
+        assert cold["compile_s"] > 0.5 * cold["wall_s"]
+        # warm rerun of the SAME plan: hit, no compile attributed
+        _run_chain()
+        warm = profiler.sessions()[-1]["segments"][0]
+        assert warm["cache_hits"] >= 1
+        assert warm["cache_misses"] == 0
+        assert warm["compile_s"] == 0.0
+
+    def test_rows_and_launches_per_segment(self):
+        config.set_flag("PROFILE", "on")
+        out = _run_chain()
+        seg = profiler.sessions()[-1]["segments"][0]
+        assert seg["rows_in"] == 2500
+        assert seg["rows_out"] == out[4]
+        assert seg["launches"] == seg["cache_hits"] + seg["cache_misses"]
+        assert seg["launches"] >= 1
+        assert seg["ops"] == [op["op"] for op in CHAIN]
+
+    def test_explicit_session_scopes_resident_plan(self):
+        t = Table(
+            [Column.from_numpy(np.arange(4096, dtype=np.int64))], ["k"]
+        )
+        with profiler.profile_session(
+            [{"op": "sort_by", "keys": [{"column": 0}]}], label="manual"
+        ) as prof:
+            tid = rb._resident_put(t)
+            res = rb.table_plan_resident(
+                json.dumps([{"op": "sort_by", "keys": [{"column": 0}]}]),
+                [tid],
+            )
+            rb.table_num_rows(res)
+            rb.table_free(tid)
+            rb.table_free(res)
+        assert prof.session_id
+        doc = profiler.sessions()[-1]
+        assert doc["session_id"] == prof.session_id
+        assert doc["label"] == "manual"
+        assert len(doc["segments"]) >= 1
+
+    def test_stream_session_accumulates_batches(self):
+        config.set_flag("PROFILE", "on")
+        plan = [{"op": "sort_by", "keys": [{"column": 0}]}]
+        rng = np.random.default_rng(3)
+        batches = []
+        for n in (1500, 1700):
+            k = rng.integers(0, 50, n, dtype=np.int64)
+            batches.append(([I64], [0], [k.tobytes()], [None], n))
+        outs = rb.table_stream_wire(json.dumps(plan), batches)
+        assert len(outs) == 2
+        doc = profiler.sessions()[-1]
+        assert doc["label"] == "stream"
+        assert doc["batches"] == 2
+        seg = doc["segments"][0]
+        assert seg["calls"] == 2
+        assert seg["rows_in"] == 1500 + 1700
+
+
+class TestMergeSessions:
+    def _two_process_docs(self):
+        config.set_flag("PROFILE", "on")
+        _run_chain()
+        d1 = profiler.sessions()[-1]
+        # the second process: same shape, different identity, later
+        d2 = json.loads(json.dumps(d1))
+        d2["pid"] = d1["pid"] + 1
+        d2["host"] = "otherhost"
+        d2["session_id"] = "f" * 16
+        d2["epoch_ns"] = d1["epoch_ns"] + 1_000_000
+        return d1, d2
+
+    def test_merge_preserves_and_orders_sessions(self):
+        d1, d2 = self._two_process_docs()
+        merged = profiler.merge_sessions([
+            {"version": 1, "sessions": [d2]},
+            {"version": 1, "sessions": [d1]},
+        ])
+        ids = [s["session_id"] for s in merged["sessions"]]
+        assert ids == [d1["session_id"], d2["session_id"]]  # epoch order
+        procs = {
+            (p["host"], p["pid"]) for p in merged["processes"]
+        }
+        assert procs == {
+            (d1["host"], d1["pid"]), ("otherhost", d1["pid"] + 1),
+        }
+
+    def test_merge_accepts_flight_dumps(self):
+        d1, d2 = self._two_process_docs()
+        fd = {"version": 1, "events": [],
+              "sections": {"profile_sessions": [d1]}}
+        merged = profiler.merge_sessions([fd, d2])
+        assert len(merged["sessions"]) == 2
+
+    def test_merged_chrome_trace_preserves_every_event(self):
+        """Two single-process dumps -> ONE timeline: every event
+        survives, processes get distinct pids + name metadata, and
+        wall-clock alignment orders them as they actually happened."""
+        d1 = {
+            "pid": 100, "host": "hosta",
+            "epoch_ns": 1_000_000_000, "anchor_perf_ns": 500,
+            "events": [
+                {"seq": 0, "t_ns": 600, "tid": 1, "ph": "I", "name": "a0"},
+                {"seq": 1, "t_ns": 900, "tid": 1, "ph": "I", "name": "a1"},
+            ],
+        }
+        d2 = {
+            "pid": 100, "host": "hostb",  # pid COLLIDES across hosts
+            "epoch_ns": 1_000_000_000, "anchor_perf_ns": 100,
+            "events": [
+                {"seq": 0, "t_ns": 350, "tid": 7, "ph": "I", "name": "b0"},
+            ],
+        }
+        trace = tracing.merge_chrome_traces([d1, d2])
+        evs = trace["traceEvents"]
+        inst = {e["name"]: e for e in evs if e["ph"] == "i"}
+        assert set(inst) == {"a0", "a1", "b0"}  # nothing dropped
+        assert len({e["pid"] for e in evs}) == 2  # collision bumped
+        names = {
+            e["args"]["name"] for e in evs if e["name"] == "process_name"
+        }
+        assert names == {"hosta:100", "hostb:100"}
+        assert any(e["name"] == "process_sort_index" for e in evs)
+        # wall order: a0 @ wall 1e9+100, b0 @ 1e9+250, a1 @ 1e9+400
+        assert inst["a0"]["ts"] < inst["b0"]["ts"] < inst["a1"]["ts"]
+
+    def test_session_id_labels_merged_process_track(self):
+        d = {
+            "pid": 5, "host": "h", "session_id": "abcd1234ffff0000",
+            "epoch_ns": 10, "anchor_perf_ns": 1,
+            "events": [
+                {"seq": 0, "t_ns": 2, "tid": 1, "ph": "I", "name": "x"},
+            ],
+        }
+        trace = tracing.merge_chrome_traces([d])
+        names = {
+            e["args"]["name"]
+            for e in trace["traceEvents"]
+            if e["name"] == "process_name"
+        }
+        assert names == {"h:5 [abcd1234]"}
+
+
+class TestDisabledOverhead:
+    def test_disabled_hook_cost_within_metrics_gate_class(self):
+        """The acceptance bound: with no session open, a profiler hook
+        costs one module-global load + branch — the metrics/flight gate
+        class (same budget as test_flight's disabled record)."""
+        assert not profiler.session_active()
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            profiler.note_cache(True)
+        per = (time.perf_counter() - t0) / n
+        assert per < 5e-6, f"disabled note_cache costs {per * 1e6:.2f}us"
+
+    def test_disabled_maybe_session_cost_within_budget(self):
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with profiler.maybe_session(None, label="x"):
+                pass
+        per = (time.perf_counter() - t0) / n
+        assert per < 5e-6, f"disabled maybe_session {per * 1e6:.2f}us"
+
+
+class TestFlightStamping:
+    def test_snapshot_carries_pid_host_and_session_id(self):
+        config.set_flag("FLIGHT", True)
+        with profiler.profile_session([], label="stamp") as prof:
+            flight.record("I", "inside")
+            snap = flight.snapshot()
+        assert snap["pid"] == os.getpid()
+        assert snap["host"]
+        assert snap["session_id"] == prof.session_id
+
+    def test_sessions_ride_flight_dump_sections(self):
+        config.set_flag("FLIGHT", True)
+        with profiler.profile_session([], label="ride"):
+            pass
+        snap = flight.snapshot()
+        docs = snap["sections"]["profile_sessions"]
+        assert docs and docs[-1]["label"] == "ride"
+
+
+class TestLeakReportBytes:
+    def test_leak_report_has_logical_rows_and_bytes(self):
+        config.set_flag("METRICS", True)
+        t = Table(
+            [Column.from_numpy(np.arange(128, dtype=np.int64))], ["k"]
+        )
+        tid = rb._resident_put(t)
+        try:
+            rec = next(
+                r for r in rb.leak_report() if r["table_id"] == tid
+            )
+            assert rec["logical_rows"] == 128
+            assert rec["rows"] == 128  # back-compat field
+            assert rec["approx_bytes"] >= 128 * 8
+        finally:
+            rb.table_free(tid)
+
+    def test_leak_record_names_allocating_session(self):
+        config.set_flag("METRICS", True)
+        t = Table(
+            [Column.from_numpy(np.arange(16, dtype=np.int64))], ["k"]
+        )
+        with profiler.profile_session([], label="alloc") as prof:
+            tid = rb._resident_put(t)
+        try:
+            rec = next(
+                r for r in rb.leak_report() if r["table_id"] == tid
+            )
+            assert rec["session"] == prof.session_id
+        finally:
+            rb.table_free(tid)
+
+
+class TestExplainRenderer:
+    def _explain(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "explain", os.path.join(_ROOT, "tools", "explain.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_report_names_every_plan_op_and_splits(self):
+        config.set_flag("PROFILE", "on")
+        _run_chain(CHAIN + [{"op": "concat"}])
+        doc = profiler.sessions()[-1]
+        text = self._explain().render_session(doc)
+        for op in [o["op"] for o in CHAIN] + ["concat"]:
+            assert op in text
+        assert "fused" in text and "exact" in text
+        assert "compile" in text and "execute" in text
+        assert "serde" in text and "stall" in text
+        assert doc["session_id"] in text
+
+    def test_merged_report_lists_both_processes(self):
+        config.set_flag("PROFILE", "on")
+        _run_chain()
+        d1 = profiler.sessions()[-1]
+        d2 = json.loads(json.dumps(d1))
+        d2["pid"], d2["host"], d2["session_id"] = 1, "peer", "e" * 16
+        mod = self._explain()
+        merged = profiler.merge_sessions([d1, d2])
+        text = mod.render_merged(merged)
+        assert "2 process(es)" in text
+        assert f"{d1['host']}:{d1['pid']}" in text
+        assert "peer:1" in text
+
+    def test_extract_sessions_from_bench_profile_block(self):
+        config.set_flag("PROFILE", "on")
+        _run_chain()
+        doc = profiler.sessions()[-1]
+        bench_doc = {
+            "configs": [
+                {"name": "fused_plan",
+                 "profile": {"sessions": 3, "segments": [],
+                             "sessions_tail": [doc]}},
+            ]
+        }
+        got = profiler.extract_sessions(bench_doc)
+        assert [s["session_id"] for s in got] == [doc["session_id"]]
+
+
+class TestDumpPlane:
+    def test_dump_and_reload_roundtrip(self, tmp_path):
+        config.set_flag("PROFILE", "on")
+        _run_chain()
+        path = str(tmp_path / "profile.json")
+        assert profiler.dump(path) == path
+        doc = json.loads(open(path).read())
+        assert doc["pid"] == os.getpid()
+        got = profiler.extract_sessions(doc)
+        assert len(got) == 1
+        assert got[0]["segments"]
+
+    def test_dump_bad_path_warns_not_raises(self, capsys):
+        config.set_flag("PROFILE", "on")
+        _run_chain()
+        assert profiler.dump("/nonexistent-dir/x/p.json") is None
+        assert "[srt][profiler][WARN]" in capsys.readouterr().err

@@ -404,6 +404,74 @@ def summarize_pipeline(raw: list, merged=None) -> None:
         )
 
 
+def summarize_profile(raw: list, top: int = 8) -> None:
+    """Top plan segments by time from the entries' ``profile`` blocks
+    (the per-config aggregated profiler summary bench embeds since the
+    query-profiler PR; tools/explain.py renders the full per-session
+    tree). Old BENCH files have no such blocks — silent skip, like the
+    other summaries."""
+    segs: dict = {}
+    order: list = []
+    n_sessions = 0
+    seen = set()
+    for e in raw:
+        p = e.get("profile")
+        if not isinstance(p, dict) or not isinstance(
+            p.get("segments"), list
+        ):
+            continue
+        # several entries of one config share one block: fold once
+        key = json.dumps(p, sort_keys=True)
+        if key in seen:
+            continue
+        seen.add(key)
+        n_sessions += int(p.get("sessions") or 0)
+        for sd in p["segments"]:
+            k = (
+                e.get("name", "?"), sd.get("index"), sd.get("kind"),
+                tuple(sd.get("ops", [])),
+            )
+            agg = segs.get(k)
+            if agg is None:
+                agg = dict(sd)
+                agg["config"] = e.get("name", "?")
+                segs[k] = agg
+                order.append(k)
+            else:
+                for f in (
+                    "calls", "wall_s", "compile_s", "execute_s",
+                    "serde_s", "stall_s", "cache_hits", "cache_misses",
+                    "launches",
+                ):
+                    agg[f] = (agg.get(f) or 0) + (sd.get(f) or 0)
+    if not segs:
+        return
+    ranked = sorted(
+        segs.values(), key=lambda s: float(s.get("wall_s") or 0.0),
+        reverse=True,
+    )[:top]
+    print(f"\ntop plan segments by time ({n_sessions} profiled sessions):")
+    print(
+        f"  {'config/segment':42} {'wall':>9} {'compile':>9} "
+        f"{'execute':>9} {'cache':>9}"
+    )
+    for s in ranked:
+        label = (
+            f"{s['config']}#"
+            f"{s.get('index', '?')}[{s.get('kind', '?')}] "
+            + "+".join(s.get("ops", []))
+        )[:42]
+        hits = int(s.get("cache_hits") or 0)
+        misses = int(s.get("cache_misses") or 0)
+        print(
+            f"  {label:42} "
+            f"{float(s.get('wall_s') or 0) * 1e3:8.2f}ms "
+            f"{float(s.get('compile_s') or 0) * 1e3:8.2f}ms "
+            f"{float(s.get('execute_s') or 0) * 1e3:8.2f}ms "
+            f"{hits:>4}H/{misses}M"
+        )
+
+
 def summarize_failures(raw: list) -> None:
     """Print the structured failure records (diagnosable-from-JSON)."""
     fails = [e for e in raw if isinstance(e.get("failure"), dict)]
@@ -437,6 +505,7 @@ def main() -> None:
         summarize_compile_cache(raw)
         summarize_plan_fusion(raw, merged=merged)
         summarize_pipeline(raw, merged=merged)
+        summarize_profile(raw)
         summarize_failures(raw)
         return
     for label, arms in _GROUPS.items():
@@ -464,6 +533,7 @@ def main() -> None:
     summarize_compile_cache(raw)
     summarize_plan_fusion(raw, merged=merged)
     summarize_pipeline(raw, merged=merged)
+    summarize_profile(raw)
     summarize_failures(raw)
 
 

@@ -63,14 +63,14 @@ def _events_from(doc) -> list:
     return out
 
 
-def load_events(path: str) -> list:
+def load_doc(path: str):
     """Parse ``path`` as one JSON doc, or line-wise (bench stdout /
     BENCH_r*.json: take the LAST parseable line, the analyze_bench
     discipline)."""
     with open(path) as f:
         text = f.read()
     try:
-        return _events_from(json.loads(text))
+        return json.loads(text)
     except json.JSONDecodeError:
         doc = None
         for line in text.splitlines():
@@ -80,7 +80,11 @@ def load_events(path: str) -> list:
                 continue
         if doc is None:
             raise
-        return _events_from(doc)
+        return doc
+
+
+def load_events(path: str) -> list:
+    return _events_from(load_doc(path))
 
 
 def main(argv=None) -> int:
@@ -93,7 +97,8 @@ def main(argv=None) -> int:
         help="output path (default: <input>.trace.json)",
     )
     args = ap.parse_args(argv)
-    events = load_events(args.input)
+    doc = load_doc(args.input)
+    events = _events_from(doc)
     if not events:
         print(
             f"trace2chrome: no flight events in {args.input!r} "
@@ -101,7 +106,20 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 1
-    trace = to_chrome_trace(events)
+    # a flight dump carries (pid, host, session_id) process metadata:
+    # label the process track so a multi-process Perfetto merge doesn't
+    # collide on tid alone
+    kw = {}
+    if isinstance(doc, dict) and isinstance(doc.get("events"), list):
+        if doc.get("pid") is not None:
+            kw["pid"] = int(doc["pid"])
+        if doc.get("host"):
+            name = f"{doc['host']}:{doc.get('pid', '?')}"
+            if doc.get("session_id"):
+                name = f"{name} [{str(doc['session_id'])[:8]}]"
+            kw["process_name"] = name
+            kw["process_sort_index"] = 0
+    trace = to_chrome_trace(events, **kw)
     out_path = args.output or args.input + ".trace.json"
     with open(out_path, "w") as f:
         json.dump(trace, f, indent=1, sort_keys=True)
