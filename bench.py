@@ -1148,6 +1148,211 @@ def bench_pipelined_stream(platform, n_batches=12, depth=None):
     }
 
 
+def bench_serving_multiquery(platform, n_sessions=3, n_batches=5):
+    """Serving-daemon bench (ISSUE 9 tentpole): TPC-DS-shaped plan
+    mixes (the q5 / q23 / q64 silhouettes: filter->agg,
+    filter->sort->agg, filter->cast->sort->agg) served as CONCURRENT
+    tenant sessions through one long-lived daemon.
+
+    Three phases:
+
+      serial    every mix over its batch stream via ``table_plan_wire``
+                — the parity reference and the no-daemon baseline.
+      warm      ONE daemon session streams all mixes against a cleared
+                compile cache: it pays every compile (the recorded
+                ``warm_misses``).
+      served    ``n_sessions`` NEW sessions stream the same mixes
+                concurrently. Their compiled-executable lookups land in
+                the process-global ``buckets.cached_jit`` the warm
+                session populated — the ``cross_session_hits`` /
+                ``hit_rate`` headline (misses here stay ~0: tenant B
+                never re-pays tenant A's compiles).
+
+    Byte parity of every served result against the serial reference is
+    asserted, as is zero leaked resident tables after shutdown. The
+    structured ``serving`` block carries sessions, shed count, merged
+    p50/p95 queue wait, and the cross-session cache-hit rate.
+    SRT_BENCH_SERVE_ROWS shrinks the shape for smoke runs
+    (ci/smoke-observability.sh drives this config)."""
+    import os as _os
+    import threading as _threading
+    import time as _time
+
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import runtime_bridge as rb
+    from spark_rapids_jni_tpu import serving
+    from spark_rapids_jni_tpu.utils import buckets as srt_buckets
+    from spark_rapids_jni_tpu.utils import metrics as srt_metrics
+
+    _metrics_enable()  # the cache/shed/wait counters ARE the story
+    base = int(_os.environ.get("SRT_BENCH_SERVE_ROWS", 60_000))
+    rng = np.random.default_rng(59)
+    sizes = sorted(
+        int(s)
+        for s in rng.integers(base // 2, base * 3 // 2 + 2, n_batches)
+    )
+    i64 = int(dt.TypeId.INT64)
+    b8 = int(dt.TypeId.BOOL8)
+    mixes = {
+        # q5 silhouette: scan -> filter -> aggregate
+        "q5": [
+            {"op": "filter", "mask": 2},
+            {"op": "groupby", "by": [0],
+             "aggs": [{"column": 1, "agg": "sum"}]},
+        ],
+        # q23 silhouette: filter -> order -> aggregate
+        "q23": [
+            {"op": "filter", "mask": 2},
+            {"op": "sort_by", "keys": [{"column": 0}]},
+            {"op": "groupby", "by": [0],
+             "aggs": [{"column": 1, "agg": "sum"}]},
+        ],
+        # q64 silhouette: filter -> project(cast) -> order -> aggregate
+        "q64": [
+            {"op": "filter", "mask": 2},
+            {"op": "cast", "column": 1,
+             "type_id": int(dt.TypeId.FLOAT64)},
+            {"op": "sort_by", "keys": [{"column": 0}]},
+            {"op": "groupby", "by": [0],
+             "aggs": [{"column": 1, "agg": "sum"}]},
+        ],
+    }
+    batches = []
+    for nn in sizes:
+        kk = rng.integers(0, 1000, nn, dtype=np.int64)
+        vv = rng.integers(-100, 100, nn, dtype=np.int64)
+        mm = (vv > 0).astype(np.uint8)
+        batches.append((
+            [i64, i64, b8], [0, 0, 0],
+            [kk.tobytes(), vv.tobytes(), mm.tobytes()],
+            [None, None, None], nn,
+        ))
+
+    def serial_pass():
+        t0 = _time.perf_counter()
+        outs = {
+            name: [
+                rb.table_plan_wire(json.dumps(ops), *b) for b in batches
+            ]
+            for name, ops in mixes.items()
+        }
+        return _time.perf_counter() - t0, outs
+
+    serial_cold_s, serial_outs = serial_pass()
+    serial_warm_s = serial_pass()[0]
+
+    got = {}
+    errs = []
+    with serving.serve() as srv:
+        # warm phase: ONE session pays every compile against a cleared
+        # cache, so the served phase's hits are strictly CROSS-session
+        srt_buckets.cache_clear()
+        srt_metrics.reset()
+        with serving.Client(srv.port, name="warm") as w:
+            for name, ops in mixes.items():
+                w.stream(ops, batches)
+        warm_snap = _metrics_snapshot() or {}
+        warm_misses = int(
+            warm_snap.get("counters", {}).get("compile_cache.miss", 0)
+        )
+
+        srt_metrics.reset()
+        clients = [
+            serving.Client(
+                srv.port, name=f"tenant-{i}-{list(mixes)[i % 3]}"
+            ).connect()
+            for i in range(n_sessions)
+        ]
+
+        def run(i):
+            try:
+                got[i] = {
+                    name: clients[i].stream(ops, batches)
+                    for name, ops in mixes.items()
+                }
+            except BaseException as e:  # pragma: no cover
+                errs.append(e)
+
+        t0 = _time.perf_counter()
+        threads = [
+            _threading.Thread(target=run, args=(i,))
+            for i in range(n_sessions)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        served_s = _time.perf_counter() - t0
+        snap = _metrics_snapshot() or {}
+        # merged queue-wait percentiles over every live tenant session
+        # (in-process peek at the raw wait samples: exact, not a
+        # percentile-of-percentiles)
+        waits = sorted(
+            wt
+            for s in srv._sessions.values()
+            for wt in list(s._waits)
+        )
+        docs = srv.stats()["sessions"]
+        for c in clients:
+            c.close()
+    if errs:
+        raise errs[0]
+    for i in range(n_sessions):
+        assert got[i] == serial_outs, (
+            f"served results for tenant {i} diverge from serial"
+        )
+    leaked = rb.resident_table_count()
+    assert leaked == 0, f"{leaked} resident table(s) leaked"
+
+    def pct(p):
+        if not waits:
+            return 0.0
+        i = min(int(p * (len(waits) - 1) + 0.5), len(waits) - 1)
+        return round(waits[i] * 1e3, 3)
+
+    ctr = snap.get("counters", {})
+    hits = int(ctr.get("compile_cache.hit", 0))
+    misses = int(ctr.get("compile_cache.miss", 0))
+    rows = sum(b[4] for b in batches) * len(mixes)
+    return {
+        "config": "serving",
+        "name": f"serving_multiquery_{n_sessions}x{len(mixes)}mix",
+        "rows": rows,
+        "host_cpus": _os.cpu_count(),
+        "serial_cold_seconds": round(serial_cold_s, 4),
+        "serial_warm_seconds": round(serial_warm_s, 4),
+        "served_seconds": round(served_s, 4),
+        "rows_per_s": round(rows * n_sessions / served_s, 1),
+        "serving": {
+            "sessions": n_sessions,
+            "mixes": sorted(mixes),
+            "batches_per_mix": n_batches,
+            "requests": int(ctr.get("serving.requests", 0)),
+            "shed": int(ctr.get("serving.shed", 0)),
+            "queue_wait_ms_p50": pct(0.50),
+            "queue_wait_ms_p95": pct(0.95),
+            "warm_misses": warm_misses,
+            "cross_session_hits": hits,
+            "cross_session_misses": misses,
+            "cross_session_hit_rate": round(
+                hits / max(hits + misses, 1), 3
+            ),
+            "sessions_detail": [
+                {
+                    "name": d["name"],
+                    "requests": d["requests"],
+                    "shed": d["shed"],
+                    "queue_wait": d["queue_wait"],
+                    "donated_credit_bytes": d["donated_credit_bytes"],
+                }
+                for d in docs
+            ],
+            "leaked_tables": leaked,
+        },
+        "platform": platform,
+    }
+
+
 def bench_resident_chain(platform, n=None):
     """VERDICT item 4 bench: a 3-op chain (filter -> sort -> groupby)
     through device-RESIDENT table handles vs the bytes-wire path that
@@ -1675,6 +1880,7 @@ _SUBPROCESS_CONFIGS = {
     "bucketed_stream": bench_bucketed_stream,
     "fused_plan": bench_fused_plan,
     "pipelined_stream": bench_pipelined_stream,
+    "serving_multiquery": bench_serving_multiquery,
     "parquet": bench_parquet_pipeline,
     "parquet_device": bench_parquet_device,
     "tpcds": bench_tpcds,
@@ -1696,7 +1902,7 @@ _LADDER = (
     "groupby16m_packed_pallas32", "chunk_sort_ab",
     "strings", "transpose", "transpose_pallas", "resident",
     "bucketed_stream", "fused_plan", "pipelined_stream",
-    "parquet", "parquet_device",
+    "serving_multiquery", "parquet", "parquet_device",
     # 100M tier: likely winners first
     "groupby100m_flat_gather", "groupby100m_gather", "groupby100m",
     "groupby100m_packed_pallas32", "groupby100m_packed",

@@ -1071,6 +1071,17 @@ def table_plan_resident(
     return _resident_put(work())
 
 
+# table id -> count of table_download_wire serializers currently
+# reading that id's buffers. table_free never touches buffers, so a
+# plain free under an active download is safe (the download holds its
+# own Table reference) — but table_reclaim DELETES device buffers and
+# must drain these readers first, exactly like the pipelined-reader
+# barrier. Registered atomically with the registry lookup so a reclaim
+# that popped the id either sees this read or ordered itself first.
+_RESIDENT_ACTIVE_READS: dict = {}
+_RESIDENT_READS_CV = threading.Condition(_RESIDENT_LOCK)
+
+
 def table_download_wire(table_id: int):
     """Resident table -> the wire 5-tuple of table_op_wire (shape-bucket
     padding sliced away host-side; the wire never sees it). One of the
@@ -1078,7 +1089,34 @@ def table_download_wire(table_id: int):
     waited for here and any worker failure is replayed synchronously so
     the originating op's labeled error raises from this call. Raises
     the labeled KeyError on an unknown or already-freed id."""
-    return _table_to_wire(_resident_get(table_id))
+    tid = int(table_id)
+    with _RESIDENT_LOCK:
+        t = _RESIDENT.get(tid)
+        live = len(_RESIDENT)
+        if t is not None:
+            _RESIDENT_ACTIVE_READS[tid] = (
+                _RESIDENT_ACTIVE_READS.get(tid, 0) + 1
+            )
+    if t is None:
+        raise _unknown_id_error(tid, live)
+    try:
+        if isinstance(t, pipeline.Pending):
+            t = t.resolve()
+            with _RESIDENT_LOCK:
+                # swap the settled Table in so later gets skip the
+                # handle (unless the id was freed while we waited)
+                if tid in _RESIDENT:
+                    _RESIDENT[tid] = t
+        metrics.counter_add("resident.get")
+        return _table_to_wire(t)
+    finally:
+        with _RESIDENT_READS_CV:
+            n = _RESIDENT_ACTIVE_READS.get(tid, 1) - 1
+            if n > 0:
+                _RESIDENT_ACTIVE_READS[tid] = n
+            else:
+                _RESIDENT_ACTIVE_READS.pop(tid, None)
+            _RESIDENT_READS_CV.notify_all()
 
 
 def table_num_rows(table_id: int) -> int:
@@ -1125,6 +1163,116 @@ def table_free(table_id: int) -> None:
     metrics.gauge_set("resident.live", live)
     if flight.enabled():
         flight.record("C", "resident.live", live)
+
+
+def _column_device_arrays(col) -> list:
+    """The column's device buffers (data + validity + LIST lengths)."""
+    out = []
+    for name in ("data", "validity", "lengths"):
+        a = getattr(col, name, None)
+        if a is not None and hasattr(a, "delete"):
+            out.append(a)
+    return out
+
+
+def table_reclaim(table_id: int) -> int:
+    """Serving-teardown free: release a resident id AND return its HBM
+    to the device now. Returns the approximate bytes reclaimed.
+
+    ``table_free`` only drops the registry reference — safe under
+    concurrent readers because each holds its own Table reference — but
+    a multi-tenant daemon tearing a session down needs the bytes back
+    while OTHER tenants keep running, which means deleting device
+    buffers that an in-flight pipelined reader may still dereference.
+    That is exactly the donate-consume hazard, so this settles through
+    the same barrier before touching anything: (1) every registered
+    pipelined reader of the id is terminally settled (later replays
+    included, ``Pending.settle_terminally``), (2) in-flight
+    ``table_download_wire`` serializers of the id drain, and only then
+    (3) the buffers are deleted — skipping any buffer shared with a
+    still-live resident table (an aliasing op output), and tolerating
+    buffers an executable already consumed by donation. Like donation,
+    the caller owns the id: no OTHER thread may still be synchronously
+    dispatching ops over it (the serving scheduler guarantees this by
+    draining a session's in-flight work before teardown reclaims).
+    Raises the labeled KeyError on an unknown or already-freed id."""
+    tid = int(table_id)
+    with _RESIDENT_LOCK:
+        t = _RESIDENT.pop(tid, None)
+        gone = t is None
+        _RESIDENT_META.pop(tid, None)
+        readers = _RESIDENT_READERS.pop(tid, ())
+        live = len(_RESIDENT)
+    if gone:
+        raise _unknown_id_error(table_id, live)
+    for p in readers:
+        # the donate barrier: a still-running (or failed-but-
+        # replayable) reader would dereference the buffers we are about
+        # to delete — run it to terminal settlement NOW
+        p.settle_terminally()
+    if isinstance(t, pipeline.Pending):
+        t.orphan()  # no blocking point remains for this handle
+        t.wait_settled()
+        settled = t.value_nowait()
+        if settled is None:
+            # the producing op failed: there are no buffers to reclaim,
+            # and table_free's fire-and-forget WARN is the only trace
+            if t.failed_nowait():
+                log.log(
+                    "WARN", "handles", "reclaimed_failed_pending",
+                    table_id=tid, stage=t.label,
+                )
+            metrics.counter_add("resident.free")
+            metrics.gauge_set("resident.live", live)
+            if flight.enabled():
+                flight.record("C", "resident.live", live)
+            return 0
+        t = settled
+    # drain in-flight wire serializers of this id (they registered
+    # atomically with their registry lookup; the pop above makes new
+    # ones impossible, so this wait terminates)
+    with _RESIDENT_READS_CV:
+        while _RESIDENT_ACTIVE_READS.get(tid):
+            _RESIDENT_READS_CV.wait()
+    from .utils import hbm
+
+    try:
+        nbytes = int(hbm.table_bytes(t))
+    except Exception:
+        nbytes = 0
+    # never delete a buffer another live table can still see: an op
+    # output may alias its input outright (e.g. single-table concat
+    # returns the input Table), and settled pending entries count
+    shared = set()
+    with _RESIDENT_LOCK:
+        others = list(_RESIDENT.values())
+    for o in others:
+        if isinstance(o, pipeline.Pending):
+            o = o.value_nowait()
+            if o is None:
+                continue
+        for c in o.columns:
+            for a in _column_device_arrays(c):
+                shared.add(id(a))
+    for c in t.columns:
+        for a in _column_device_arrays(c):
+            if id(a) in shared:
+                continue
+            try:
+                a.delete()
+            except Exception:
+                # already consumed by a donated executable, or a
+                # backend without explicit delete — the reference drop
+                # below reclaims it either way
+                pass
+    log.log("DEBUG", "handles", "table_reclaim", table_id=tid,
+            live=live, nbytes=nbytes)
+    metrics.counter_add("resident.free")
+    metrics.bytes_add("resident.reclaimed_bytes", nbytes)
+    metrics.gauge_set("resident.live", live)
+    if flight.enabled():
+        flight.record("C", "resident.live", live)
+    return nbytes
 
 
 def resident_table_count() -> int:

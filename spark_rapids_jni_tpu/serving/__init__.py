@@ -1,0 +1,30 @@
+"""Multi-tenant serving tier: the resident-daemon deployment shape.
+
+The reference stack serves many concurrent Spark tasks from ONE
+long-lived device process (the JVM executor holding the shaded
+``rapids-4-spark-jni`` artifact). This package is that tier for the
+TPU-native backend: a localhost query-stream daemon
+(:class:`~.server.Server`) with per-client sessions
+(:class:`~.session.Session`: scoped table namespace + HBM budget),
+weighted-deficit fair-share scheduling with typed BUSY shedding
+(:class:`~.scheduler.FairScheduler`), and a small client
+(:class:`~.client.Client`) for tests and bench. See
+CONTRIBUTING.md "Serving daemon".
+"""
+
+from .client import (  # noqa: F401
+    Client,
+    ServingBusy,
+    ServingError,
+    ServingOverBudget,
+    ServingSessionLimit,
+    ServingTableError,
+)
+from .scheduler import Busy, FairScheduler, Ticket  # noqa: F401
+from .server import Server, SessionLimit, serve  # noqa: F401
+from .session import (  # noqa: F401
+    OverBudget,
+    Session,
+    SessionClosed,
+    estimate_request_bytes,
+)

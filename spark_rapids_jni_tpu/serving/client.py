@@ -1,0 +1,176 @@
+"""In-process client for the serving daemon (tests + bench).
+
+Speaks the frame protocol of serving/frames.py over a localhost socket
+and maps the daemon's typed error responses back onto typed Python
+exceptions — so a shed request raises :class:`ServingBusy`, an
+admission rejection :class:`ServingOverBudget` (message names the
+session budget), and a cross-session table access
+:class:`ServingTableError` (a KeyError naming the session), exactly
+mirroring what an embedded JNI caller would see as status codes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+from typing import List, Optional, Sequence
+
+from . import frames
+
+
+class ServingError(RuntimeError):
+    """Base typed daemon error. ``type`` is the wire error type."""
+
+    def __init__(self, type_: str, message: str, exception: str = ""):
+        super().__init__(message)
+        self.type = type_
+        self.exception = exception
+
+
+class ServingBusy(ServingError):
+    """The session's queue was at depth: request shed, retry later."""
+
+
+class ServingOverBudget(ServingError):
+    """Admission rejected the request against the session HBM budget."""
+
+
+class ServingSessionLimit(ServingError):
+    """The daemon is at SERVE_MAX_SESSIONS."""
+
+
+class ServingTableError(ServingError, KeyError):
+    """Unknown (or cross-session) table id — labeled per session."""
+
+    def __str__(self) -> str:  # KeyError reprs its arg; keep the label
+        return self.args[0] if self.args else ""
+
+
+_ERROR_CLASSES = {
+    "busy": ServingBusy,
+    "over_budget": ServingOverBudget,
+    "session_limit": ServingSessionLimit,
+    "unknown_table": ServingTableError,
+}
+
+
+def _raise_error(err: dict) -> None:
+    type_ = str(err.get("type", "internal"))
+    cls = _ERROR_CLASSES.get(type_, ServingError)
+    raise cls(type_, str(err.get("message", "")),
+              str(err.get("exception", "")))
+
+
+class Client:
+    """One connection to the daemon. ``with Client(port) as c:`` opens
+    a session on connect; pass ``session=`` to attach another
+    connection to an existing session (many Spark tasks, one tenant)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 name: Optional[str] = None, weight: float = 1.0,
+                 session: Optional[str] = None, timeout: float = 60.0):
+        self._addr = (host, int(port))
+        self._hello = {
+            k: v for k, v in (
+                ("name", name), ("weight", weight), ("session", session),
+            ) if v is not None
+        }
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self.session: Optional[str] = None
+        self.name: Optional[str] = None
+        self.budget_bytes: Optional[int] = None
+        self.queue_depth: Optional[int] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def connect(self) -> "Client":
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        resp = self._rpc({"cmd": "hello", **self._hello})
+        self.session = resp.get("session")
+        self.name = resp.get("name")
+        self.budget_bytes = resp.get("budget_bytes")
+        self.queue_depth = resp.get("queue_depth")
+        return self
+
+    def close(self) -> None:
+        """Graceful detach: bye + socket close (idempotent)."""
+        s = self._sock
+        if s is None:
+            return
+        self._sock = None
+        with contextlib.suppress(Exception):
+            frames.send_frame(s, {"cmd": "bye"})
+            frames.recv_frame(s)
+        with contextlib.suppress(OSError):
+            s.close()
+
+    def kill(self) -> None:
+        """Abrupt disconnect WITHOUT bye — the client-crash path; the
+        daemon must tear the session down and reclaim its tables."""
+        s = self._sock
+        self._sock = None
+        if s is not None:
+            with contextlib.suppress(OSError):
+                s.close()
+
+    def __enter__(self) -> "Client":
+        if self._sock is None:
+            self.connect()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
+
+    # -- protocol ---------------------------------------------------------
+    def _rpc(self, header: dict, buffers: Sequence[bytes] = ()):
+        if self._sock is None:
+            raise RuntimeError("client is not connected")
+        frames.send_frame(self._sock, header, buffers)
+        resp, payload = frames.recv_frame(self._sock)
+        if not resp.get("ok"):
+            _raise_error(resp.get("error") or {})
+        resp["_payload"] = payload
+        return resp
+
+    # -- commands ---------------------------------------------------------
+    def stream(self, ops: list, batches: Sequence) -> List[tuple]:
+        """Run ``ops`` (a plan: JSON-able list of op dicts) over wire
+        batches; returns one result 5-tuple per batch, in order."""
+        metas, buffers = frames.batches_to_parts(batches)
+        resp = self._rpc(
+            {"cmd": "stream", "plan": list(ops), "batches": metas},
+            buffers,
+        )
+        return frames.batches_from_parts(
+            resp.get("results") or [], resp["_payload"]
+        )
+
+    def upload(self, batch) -> int:
+        meta, buffers = frames.batch_to_parts(batch)
+        resp = self._rpc({"cmd": "upload", "batch": meta}, buffers)
+        return int(resp["table"])
+
+    def plan(self, ops: list, tables: Sequence[int],
+             donate: bool = False) -> int:
+        resp = self._rpc({
+            "cmd": "plan", "plan": list(ops),
+            "tables": [int(t) for t in tables], "donate": bool(donate),
+        })
+        return int(resp["table"])
+
+    def download(self, table: int) -> tuple:
+        resp = self._rpc({"cmd": "download", "table": int(table)})
+        batch, _ = frames.batch_from_parts(
+            resp["result"], resp["_payload"], 0
+        )
+        return batch
+
+    def free(self, table: int) -> int:
+        resp = self._rpc({"cmd": "free", "table": int(table)})
+        return int(resp.get("bytes", 0))
+
+    def stats(self) -> dict:
+        return self._rpc({"cmd": "stats"})["stats"]

@@ -1,0 +1,525 @@
+"""The serving daemon: a long-lived multi-tenant query-stream server.
+
+This is the deployment shape the reference stack assumes — one resident
+device process (the JVM executor that loads the shaded
+``rapids-4-spark-jni`` artifact once) serving many concurrent Spark
+tasks. Here the resident process is this :class:`Server`: it listens on
+localhost TCP (length-prefixed JSON+binary frames, serving/frames.py),
+gives each client connection a :class:`~.session.Session` (namespace +
+HBM budget), runs every request through the weighted-deficit
+:class:`~.scheduler.FairScheduler`, and executes through the existing
+runtime bridge — so shape buckets, plan fusion, the pipelined dispatch
+plane and buffer donation all apply per request, and the compiled-
+executable cache (``buckets.cached_jit``) is naturally **shared across
+sessions**: tenant B warm-hits tenant A's compiles because the cache is
+process-global and keyed only by plan/schema/bucket/donation.
+
+Commands (frame header ``cmd``):
+
+* ``hello``      open (or re-attach to) a session; returns id + budget
+* ``stream``     run a plan over N inline batches; returns N results
+* ``upload``     wire batch -> session-resident table id
+* ``plan``       plan over resident ids -> new resident id
+* ``download``   resident id -> wire batch
+* ``free``       reclaim one resident table's HBM now
+* ``stats``      server + per-session statistics
+* ``bye``        detach this connection (last detach tears the session
+                 down with full table reclamation — as does a crash)
+
+Errors are typed responses ``{"ok": false, "error": {"type", value
+"message"}}``; notably ``busy`` (queue shed) and ``over_budget``
+(admission) — a saturated daemon answers, it never hangs.
+
+Every served stream opens a ``profiler.profile_session`` labeled
+``serve:<session-name>``, so profile/flight dumps are session-stamped
+and ``tools/explain.py --merge`` renders a multi-tenant timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import socket
+import threading
+import uuid
+from collections import deque
+from typing import Optional
+
+from .. import pipeline, plan as plan_mod, runtime_bridge as rb
+from ..utils import config, flight, hbm, metrics, profiler
+from . import frames
+from .scheduler import Busy, FairScheduler
+from .session import (
+    OverBudget,
+    Session,
+    SessionClosed,
+    estimate_request_bytes,
+)
+
+
+class SessionLimit(Exception):
+    """Typed HELLO rejection: the daemon is at SERVE_MAX_SESSIONS."""
+
+
+_ERROR_TYPES = {
+    Busy: "busy",
+    OverBudget: "over_budget",
+    SessionLimit: "session_limit",
+    SessionClosed: "session_closed",
+    KeyError: "unknown_table",
+    frames.ProtocolError: "bad_request",
+    TypeError: "bad_request",
+    ValueError: "bad_request",
+}
+
+
+def _error_type(exc: BaseException) -> str:
+    for cls, name in _ERROR_TYPES.items():
+        if isinstance(exc, cls):
+            return name
+    return "internal"
+
+
+def _error_header(exc: BaseException) -> dict:
+    msg = str(exc)
+    if isinstance(exc, KeyError) and exc.args:
+        msg = str(exc.args[0])  # un-repr the KeyError message
+    return {
+        "ok": False,
+        "error": {
+            "type": _error_type(exc),
+            "exception": type(exc).__name__,
+            "message": msg,
+        },
+    }
+
+
+class Server:
+    """The resident daemon. ``with Server().start() as srv:`` or call
+    :meth:`start` / :meth:`stop` explicitly; ``srv.port`` is the bound
+    port (OS-assigned when SERVE_PORT / ``port`` is 0)."""
+
+    def __init__(self, port: Optional[int] = None,
+                 max_sessions: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 session_hbm_fraction: Optional[float] = None,
+                 workers: int = 2):
+        self._port_req = (
+            int(config.get_flag("SERVE_PORT")) if port is None else port
+        )
+        self.max_sessions = (
+            int(config.get_flag("SERVE_MAX_SESSIONS"))
+            if max_sessions is None else int(max_sessions)
+        )
+        self.queue_depth = (
+            int(config.get_flag("SERVE_QUEUE_DEPTH"))
+            if queue_depth is None else int(queue_depth)
+        )
+        self.session_hbm_fraction = (
+            float(config.get_flag("SERVE_SESSION_HBM_FRACTION"))
+            if session_hbm_fraction is None
+            else float(session_hbm_fraction)
+        )
+        self.scheduler = FairScheduler(
+            workers=workers, queue_depth=self.queue_depth
+        )
+        self.port: Optional[int] = None
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._sessions: dict = {}
+        self._conns: set = set()
+        self._conn_threads: list = []
+        self._stopping = False
+        self._sessions_served = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Server":
+        self.scheduler.start()
+        s = socket.create_server(("127.0.0.1", self._port_req))
+        self.port = s.getsockname()[1]
+        self._listener = s
+        t = threading.Thread(
+            target=self._accept_loop, name="srt-serve-accept", daemon=True
+        )
+        t.start()
+        self._accept_thread = t
+        if flight.enabled():
+            flight.record("I", "serving.start", self.port)
+        return self
+
+    def stop(self) -> None:
+        """Shut down: stop accepting, close connections (tearing their
+        sessions down with full reclamation), stop executors, drain the
+        pipelined plane."""
+        with self._lock:
+            if self._stopping:
+                return
+            self._stopping = True
+            conns = list(self._conns)
+            threads = list(self._conn_threads)
+        if self._listener is not None:
+            # closing a listening socket does NOT wake a thread blocked
+            # in accept() on Linux — poke it with a throwaway connection
+            # (the accept loop sees _stopping and exits) so shutdown is
+            # immediate instead of eating the join timeout
+            with contextlib.suppress(OSError):
+                socket.create_connection(
+                    ("127.0.0.1", self.port), timeout=1
+                ).close()
+            with contextlib.suppress(OSError):
+                self._listener.close()
+        for c in conns:
+            with contextlib.suppress(OSError):
+                c.shutdown(socket.SHUT_RDWR)
+            with contextlib.suppress(OSError):
+                c.close()
+        for t in threads:
+            t.join(timeout=10)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=10)
+        # belt-and-braces: a session left attached by a hung handler
+        with self._lock:
+            leftovers = list(self._sessions.values())
+            self._sessions.clear()
+        for sess in leftovers:
+            self.scheduler.unregister(sess)
+            sess.teardown()
+        self.scheduler.stop()
+        pipeline.drain()
+        if flight.enabled():
+            flight.record("I", "serving.stop", self.port)
+
+    def __enter__(self) -> "Server":
+        if self.port is None:
+            self.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop()
+        return False
+
+    # -- accept / connection plumbing ------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed: shutting down
+            with self._lock:
+                if self._stopping:
+                    with contextlib.suppress(OSError):
+                        sock.close()
+                    return
+                self._conns.add(sock)
+                t = threading.Thread(
+                    target=self._handle_conn, args=(sock,),
+                    name="srt-serve-conn", daemon=True,
+                )
+                self._conn_threads.append(t)
+            t.start()
+
+    def _handle_conn(self, sock: socket.socket) -> None:
+        sess: Optional[Session] = None
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                header, payload = frames.recv_frame(sock)
+                cmd = header.get("cmd")
+                if cmd == "hello":
+                    sess = self._cmd_hello(sock, header, sess)
+                    continue
+                if cmd == "bye":
+                    frames.send_frame(sock, {"ok": True})
+                    break
+                if sess is None:
+                    frames.send_frame(sock, _error_header(
+                        frames.ProtocolError(
+                            f"first frame must be hello, got {cmd!r}"
+                        )
+                    ))
+                    continue
+                try:
+                    self._dispatch(sock, sess, cmd, header, payload)
+                except (BrokenPipeError, ConnectionError, OSError):
+                    raise
+                except BaseException as e:
+                    frames.send_frame(sock, _error_header(e))
+        except (ConnectionError, OSError, frames.ProtocolError):
+            # disconnect / crash mid-stream: the finally below detaches
+            # and (on last detach) tears the session down with full
+            # table reclamation — the "crash leaks zero tables" path
+            pass
+        finally:
+            with contextlib.suppress(OSError):
+                sock.close()
+            with self._lock:
+                self._conns.discard(sock)
+            if sess is not None:
+                self._detach(sess)
+
+    # -- session lifecycle ------------------------------------------------
+    def _cmd_hello(self, sock, header, prev: Optional[Session]):
+        try:
+            sess = self._attach(header)
+        except (SessionLimit, SessionClosed, ValueError, TypeError) as e:
+            frames.send_frame(sock, _error_header(e))
+            return prev
+        if prev is not None and prev is not sess:
+            self._detach(prev)
+        frames.send_frame(sock, {
+            "ok": True,
+            "session": sess.id,
+            "name": sess.name,
+            "weight": sess.weight,
+            "budget_bytes": sess.budget_bytes,
+            "queue_depth": self.queue_depth,
+        })
+        return sess
+
+    def _attach(self, header) -> Session:
+        sid = header.get("session")
+        weight = float(header.get("weight", 1.0) or 1.0)
+        with self._lock:
+            if sid is not None:
+                sess = self._sessions.get(sid)
+                if sess is None:
+                    raise SessionClosed(
+                        f"unknown or already-closed session {sid!r}"
+                    )
+                sess.connections += 1
+                return sess
+            if len(self._sessions) >= self.max_sessions:
+                raise SessionLimit(
+                    f"daemon at max sessions ({self.max_sessions}); "
+                    "retry after a session closes"
+                )
+            new_id = uuid.uuid4().hex[:8]
+            name = str(header.get("name") or f"sess-{new_id}")
+            budget = max(
+                int(self.session_hbm_fraction * hbm.budget_bytes()), 1
+            )
+            sess = Session(new_id, name, weight, budget)
+            sess.connections = 1
+            self._sessions[new_id] = sess
+            self._sessions_served += 1
+            live = len(self._sessions)
+        self.scheduler.register(sess)
+        metrics.counter_add("serving.sessions_opened")
+        metrics.gauge_set("serving.sessions_live", live)
+        if flight.enabled():
+            flight.record("I", "serving.session_open", sess.name)
+        return sess
+
+    def _detach(self, sess: Session) -> None:
+        with self._lock:
+            sess.connections -= 1
+            last = sess.connections <= 0
+            if last:
+                self._sessions.pop(sess.id, None)
+            live = len(self._sessions)
+        if not last:
+            return
+        # order matters: unregister drains the session's queued AND
+        # in-flight work first, so teardown reclaims tables no executor
+        # still touches (and table_reclaim's barrier covers any
+        # pipelined reader beyond that)
+        self.scheduler.unregister(sess)
+        reclaimed = sess.teardown()
+        metrics.counter_add("serving.sessions_closed")
+        metrics.bytes_add("serving.reclaimed_bytes", reclaimed)
+        metrics.gauge_set("serving.sessions_live", live)
+        if flight.enabled():
+            flight.record("I", "serving.session_close", sess.name)
+
+    # -- request dispatch -------------------------------------------------
+    def _dispatch(self, sock, sess, cmd, header, payload) -> None:
+        if cmd == "stream":
+            self._cmd_stream(sock, sess, header, payload)
+        elif cmd == "upload":
+            self._cmd_upload(sock, sess, header, payload)
+        elif cmd == "plan":
+            self._cmd_plan(sock, sess, header)
+        elif cmd == "download":
+            self._cmd_download(sock, sess, header)
+        elif cmd == "free":
+            nbytes = sess.free_table(header.get("table"))
+            frames.send_frame(sock, {"ok": True, "bytes": nbytes})
+        elif cmd == "stats":
+            frames.send_frame(sock, {"ok": True, "stats": self.stats()})
+        else:
+            frames.send_frame(sock, _error_header(
+                frames.ProtocolError(f"unknown command {cmd!r}")
+            ))
+
+    @staticmethod
+    def _plan_ops(header) -> list:
+        ops = header.get("plan")
+        if not isinstance(ops, list):
+            raise TypeError("serving: plan must be a JSON list of ops")
+        return ops
+
+    def _cmd_stream(self, sock, sess, header, payload) -> None:
+        """The main entry: one plan over N inline batches, scheduled
+        per batch (so a heavy stream interleaves with other tenants),
+        answered in one frame, byte-identical to ``table_plan_wire``
+        / ``table_stream_wire`` run serially."""
+        ops = self._plan_ops(header)
+        batches = frames.batches_from_parts(
+            header.get("batches") or [], payload
+        )
+        n = len(batches)
+        sess.stats["bytes_in"] += len(payload)
+        scope = profiler.profile_session(
+            ops, label=f"serve:{sess.name}", batches=n
+        )
+        prof = scope.__enter__()
+        results = [None] * n
+        window: deque = deque()
+        try:
+            if flight.enabled():
+                flight.record("I", "serving.stream", f"{sess.name}:{n}")
+
+            def make_work(b):
+                def work():
+                    type_ids, scales, datas, valids, rows = b
+                    tbl = rb._table_from_wire(
+                        type_ids, scales, datas, valids, rows,
+                        rb._plan_pad_to(ops, rows),
+                    )
+                    out = plan_mod.run_plan(ops, tbl, donate_input=True)
+                    return rb._table_to_wire(out)
+
+                return work
+
+            for i, b in enumerate(batches):
+                est = estimate_request_bytes(b)
+                sess.admit(est)  # typed OverBudget / queues on inflight
+                try:
+                    t = self.scheduler.submit(
+                        sess, make_work(b), cost=b[4],
+                        label="stream", charge=est, prof=prof,
+                        shed=(i == 0),
+                    )
+                except BaseException:
+                    sess.release(est)
+                    raise
+                window.append((i, t))
+                # keep at most queue_depth batches of THIS stream in
+                # flight; draining here (in order) bounds the window
+                # without ever blocking the scheduler itself
+                while len(window) >= self.queue_depth:
+                    j, tj = window.popleft()
+                    results[j] = tj.result()
+            while window:
+                j, tj = window.popleft()
+                results[j] = tj.result()
+        except BaseException as e:
+            # drain stragglers before answering: their results are
+            # discarded but their budget charges must settle
+            while window:
+                _, tj = window.popleft()
+                with contextlib.suppress(BaseException):
+                    tj.result()
+            frames.send_frame(sock, _error_header(e))
+            return
+        finally:
+            scope.__exit__(None, None, None)
+        metas, buffers = frames.batches_to_parts(results)
+        sess.stats["bytes_out"] += sum(len(b) for b in buffers)
+        frames.send_frame(sock, {"ok": True, "results": metas}, buffers)
+
+    def _cmd_upload(self, sock, sess, header, payload) -> None:
+        batch = frames.batches_from_parts(
+            [header.get("batch") or {}], payload
+        )[0]
+        sess.stats["bytes_in"] += len(payload)
+        est = estimate_request_bytes(batch)
+        sess.admit(est)
+        try:
+            t = self.scheduler.submit(
+                sess, lambda: rb.table_upload_wire(*batch),
+                cost=batch[4], label="upload", charge=est,
+            )
+        except BaseException:
+            sess.release(est)
+            raise
+        rb_id = t.result()
+        actual = int(hbm.table_bytes(rb._resident_peek(rb_id)))
+        local = sess.put_table(rb_id, actual)
+        frames.send_frame(
+            sock, {"ok": True, "table": local, "bytes": actual}
+        )
+
+    def _cmd_plan(self, sock, sess, header) -> None:
+        ops = self._plan_ops(header)
+        locals_ = [int(x) for x in (header.get("tables") or [])]
+        if not locals_:
+            raise ValueError("serving: plan needs at least one table id")
+        donate = bool(header.get("donate"))
+        rb_ids = [sess.rb_id(x) for x in locals_]
+        # output estimate: the chain input's resident size (already
+        # charged) approximates the result; charge it as in-flight
+        # until the result's actual size lands as resident
+        try:
+            est = int(hbm.table_bytes(rb._resident_get(rb_ids[0])))
+        except KeyError:
+            raise sess._unknown_local_error(locals_[0])
+        sess.admit(est)
+        plan_json = json.dumps(ops)
+        try:
+            t = self.scheduler.submit(
+                sess,
+                lambda: rb.table_plan_resident(plan_json, rb_ids, donate),
+                cost=max(est // 64, 1), label="plan", charge=est,
+            )
+        except BaseException:
+            sess.release(est)
+            raise
+        out_id = t.result()
+        if donate:
+            sess.drop_local(locals_[0])
+        out = rb._resident_peek(out_id)
+        actual = (
+            est if isinstance(out, pipeline.Pending)
+            else int(hbm.table_bytes(out))
+        )
+        local = sess.put_table(out_id, actual)
+        frames.send_frame(sock, {"ok": True, "table": local})
+
+    def _cmd_download(self, sock, sess, header) -> None:
+        rb_id = sess.rb_id(header.get("table"))
+        t = self.scheduler.submit(
+            sess, lambda: rb.table_download_wire(rb_id),
+            cost=1, label="download",
+        )
+        result = t.result()
+        meta, buffers = frames.batch_to_parts(result)
+        sess.stats["bytes_out"] += sum(len(b) for b in buffers)
+        frames.send_frame(sock, {"ok": True, "result": meta}, buffers)
+
+    # -- introspection ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            sessions = [s.to_doc() for s in self._sessions.values()]
+            served = self._sessions_served
+        return {
+            "port": self.port,
+            "max_sessions": self.max_sessions,
+            "queue_depth": self.queue_depth,
+            "session_hbm_fraction": self.session_hbm_fraction,
+            "sessions_live": len(sessions),
+            "sessions_served": served,
+            "resident_tables": rb.resident_table_count(),
+            "sessions": sessions,
+        }
+
+
+@contextlib.contextmanager
+def serve(**kwargs):
+    """``with serve(...) as srv:`` — start a daemon, always stop it."""
+    srv = Server(**kwargs).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()

@@ -1,0 +1,312 @@
+"""Per-client sessions: table namespace, HBM budget, teardown.
+
+A session is the serving daemon's tenant unit — the analog of one Spark
+task attached to the resident executor process. It owns:
+
+* a **table namespace**: session-local table ids mapping to the global
+  resident registry (``runtime_bridge``). Ids are scoped per session;
+  a cross-session access raises a labeled KeyError naming the session,
+  never another tenant's table.
+* an **HBM budget**: a fraction of ``hbm.budget_bytes()``
+  (``SPARK_RAPIDS_TPU_SERVE_SESSION_HBM_FRACTION``). Admission charges
+  each request's estimate against the remainder; a request that can
+  never fit is rejected with a typed OverBudget naming the budget, one
+  that is only blocked by in-flight work queues until the in-flight
+  charge drains. Donation credits flow back: when a tenant's plan
+  donates its buffers (``hbm.note_donation``), the donated bytes are
+  credited against that request's in-flight charge.
+* **teardown with full reclamation**: on disconnect or crash every
+  table the session still holds is reclaimed through
+  ``runtime_bridge.table_reclaim`` — the donate-barrier-settling free,
+  so an in-flight pipelined reader can never be left dereferencing
+  deleted buffers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from .. import runtime_bridge as rb
+from ..utils import buckets, hbm, metrics
+
+
+class OverBudget(Exception):
+    """Typed admission rejection: the request's HBM estimate exceeds
+    the session's budget. The message names the session and its budget
+    so the client can size down or negotiate a bigger fraction."""
+
+
+class SessionClosed(Exception):
+    """The session was torn down while this request was queued or
+    waiting for budget headroom."""
+
+
+def estimate_request_bytes(batch) -> int:
+    """Conservative HBM estimate for serving one wire batch: the wire
+    buffer bytes, scaled up to the shape bucket the decode will pad to,
+    doubled for input + output resident simultaneously (a donating plan
+    never holds both — the donation credit gives the difference back)."""
+    type_ids, scales, datas, valids, num_rows = batch
+    wire = sum(len(d) for d in datas if d is not None)
+    wire += sum(len(v) for v in valids if v is not None)
+    n = max(int(num_rows), 1)
+    pad = buckets.bucket_for(n) if buckets.enabled() else None
+    if pad:
+        wire = int(wire * (pad / n))
+    return max(2 * wire, 1)
+
+
+class Session:
+    """One tenant: namespace + budget + stats. Thread-safe."""
+
+    def __init__(self, session_id: str, name: str, weight: float,
+                 budget_bytes: int):
+        self.id = session_id
+        self.name = name
+        self.weight = max(float(weight), 1e-3)
+        self.budget_bytes = int(budget_bytes)
+        self.created = time.time()
+        self.connections = 0
+        self.closed = False
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._tables: Dict[int, Tuple[int, int]] = {}  # local -> (rb, B)
+        self._next_local = itertools.count(1)
+        self._resident_bytes = 0
+        self._inflight_bytes = 0
+        self._waits = deque(maxlen=4096)  # queue-wait seconds
+        self.stats = {
+            "requests": 0,
+            "shed": 0,
+            "over_budget": 0,
+            "donated_credit_bytes": 0,
+            "bytes_in": 0,
+            "bytes_out": 0,
+        }
+
+    # -- HBM budget -------------------------------------------------------
+    def admit(self, estimate: int, wait: bool = True) -> int:
+        """Charge ``estimate`` bytes against the budget, queueing behind
+        in-flight work when that is what blocks it. Raises the typed
+        :class:`OverBudget` when the estimate can never fit (it exceeds
+        the budget minus the session's resident tables), and
+        :class:`SessionClosed` if torn down while waiting."""
+        est = max(int(estimate), 0)
+        with self._cv:
+            while True:
+                if self.closed:
+                    raise SessionClosed(
+                        f"session {self.name} closed while admitting"
+                    )
+                hard_remaining = self.budget_bytes - self._resident_bytes
+                if est > hard_remaining:
+                    self.stats["over_budget"] += 1
+                    metrics.counter_add("serving.over_budget")
+                    raise OverBudget(
+                        f"session {self.name}: request estimate {est} B "
+                        f"exceeds remaining HBM budget {hard_remaining} B "
+                        f"(session budget {self.budget_bytes} B, "
+                        f"resident {self._resident_bytes} B)"
+                    )
+                if est <= hard_remaining - self._inflight_bytes:
+                    self._inflight_bytes += est
+                    return est
+                if not wait:
+                    self.stats["over_budget"] += 1
+                    metrics.counter_add("serving.over_budget")
+                    raise OverBudget(
+                        f"session {self.name}: request estimate {est} B "
+                        f"exceeds free HBM budget "
+                        f"{hard_remaining - self._inflight_bytes} B "
+                        f"({self._inflight_bytes} B in flight, session "
+                        f"budget {self.budget_bytes} B)"
+                    )
+                # blocked only by in-flight work: queue until it drains
+                self._cv.wait()
+
+    def release(self, charge: int) -> None:
+        """Return an admitted in-flight charge (request completed)."""
+        with self._cv:
+            self._inflight_bytes = max(
+                self._inflight_bytes - max(int(charge), 0), 0
+            )
+            self._cv.notify_all()
+
+    def note_donation(self, nbytes: int, ticket=None) -> int:
+        """Credit donated bytes back against the in-flight charge (and
+        the ticket's remaining charge, so its completion-time release
+        doesn't double-credit). Returns the bytes actually credited."""
+        n = max(int(nbytes), 0)
+        with self._cv:
+            if ticket is not None:
+                n = min(n, max(getattr(ticket, "charge", 0), 0))
+                ticket.charge -= n
+            credited = min(n, self._inflight_bytes)
+            self._inflight_bytes -= credited
+            self.stats["donated_credit_bytes"] += credited
+            if credited:
+                self._cv.notify_all()
+        return credited
+
+    # -- table namespace --------------------------------------------------
+    def _unknown_local_error(self, local_id) -> KeyError:
+        with self._lock:
+            live = len(self._tables)
+        return KeyError(
+            f"table id {int(local_id)} not found in session {self.name} "
+            f"({live} table(s) live in this session; resident table ids "
+            "are session-scoped)"
+        )
+
+    def put_table(self, rb_id: int, nbytes: int) -> int:
+        """Register a resident table under this session; returns its
+        session-local id and charges its bytes as resident."""
+        with self._cv:
+            local = next(self._next_local)
+            self._tables[local] = (int(rb_id), int(nbytes))
+            self._resident_bytes += int(nbytes)
+        return local
+
+    def rb_id(self, local_id: int) -> int:
+        """Global resident id for a session-local id; labeled KeyError
+        on a miss (including every cross-session access)."""
+        with self._lock:
+            ent = self._tables.get(int(local_id))
+        if ent is None:
+            raise self._unknown_local_error(local_id)
+        return ent[0]
+
+    def drop_local(self, local_id: int) -> None:
+        """Forget a local id whose global table was CONSUMED (donated
+        into a plan) — no reclaim, the bytes moved into the result."""
+        with self._cv:
+            ent = self._tables.pop(int(local_id), None)
+            if ent is not None:
+                self._resident_bytes = max(
+                    self._resident_bytes - ent[1], 0
+                )
+                self._cv.notify_all()
+
+    def free_table(self, local_id: int) -> int:
+        """Reclaim one table's HBM now (donate-barrier-settling free);
+        returns bytes reclaimed. Labeled KeyError on a miss."""
+        with self._cv:
+            ent = self._tables.pop(int(local_id), None)
+            if ent is not None:
+                self._resident_bytes = max(
+                    self._resident_bytes - ent[1], 0
+                )
+                self._cv.notify_all()
+        if ent is None:
+            raise self._unknown_local_error(local_id)
+        try:
+            return rb.table_reclaim(ent[0])
+        except KeyError:
+            return 0  # already consumed by a donating plan
+
+    def table_count(self) -> int:
+        with self._lock:
+            return len(self._tables)
+
+    # -- stats ------------------------------------------------------------
+    def note_wait(self, seconds: float) -> None:
+        with self._lock:
+            self._waits.append(float(seconds))
+            self.stats["requests"] += 1
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.stats["shed"] += 1
+
+    def wait_percentiles(self) -> dict:
+        with self._lock:
+            waits = sorted(self._waits)
+        if not waits:
+            return {"p50_ms": 0.0, "p95_ms": 0.0, "max_ms": 0.0}
+
+        def pct(p):
+            i = min(int(p * (len(waits) - 1) + 0.5), len(waits) - 1)
+            return round(waits[i] * 1e3, 3)
+
+        return {
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+            "max_ms": round(waits[-1] * 1e3, 3),
+        }
+
+    def to_doc(self) -> dict:
+        with self._cv:
+            doc = {
+                "session": self.id,
+                "name": self.name,
+                "weight": self.weight,
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": self._resident_bytes,
+                "inflight_bytes": self._inflight_bytes,
+                "tables": len(self._tables),
+                "connections": self.connections,
+                **dict(self.stats),
+            }
+        doc["queue_wait"] = self.wait_percentiles()
+        return doc
+
+    # -- teardown ---------------------------------------------------------
+    def teardown(self) -> int:
+        """Reclaim every table this session still holds (disconnect or
+        crash path). Safe against in-flight pipelined readers: each
+        reclaim settles them via the donation-barrier path before any
+        buffer is deleted. Returns total bytes reclaimed."""
+        with self._cv:
+            self.closed = True
+            tables = list(self._tables.values())
+            self._tables.clear()
+            self._resident_bytes = 0
+            self._cv.notify_all()
+        reclaimed = 0
+        for rb_id, _ in tables:
+            try:
+                reclaimed += rb.table_reclaim(rb_id)
+            except KeyError:
+                pass  # consumed by a donating plan before teardown
+        return reclaimed
+
+
+# ---------------------------------------------------------------------------
+# execution-scope binding: which (session, ticket) the calling thread is
+# serving — the donation listener credits budgets through this.
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+class executing:
+    """Scope marking the calling thread as executing ``ticket`` for
+    ``session`` (scheduler executor threads)."""
+
+    __slots__ = ("_prev", "_cur")
+
+    def __init__(self, session: Optional[Session], ticket=None):
+        self._cur = (session, ticket) if session is not None else None
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "current", None)
+        _TLS.current = self._cur
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _TLS.current = self._prev
+        return False
+
+
+def _donation_listener(nbytes: int) -> None:
+    cur = getattr(_TLS, "current", None)
+    if cur is not None:
+        sess, ticket = cur
+        sess.note_donation(nbytes, ticket)
+
+
+hbm.register_donation_listener(_donation_listener)

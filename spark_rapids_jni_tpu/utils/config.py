@@ -54,6 +54,46 @@ def _parse_formulation(v: str) -> str:
     return got
 
 
+def _parse_port(v: str) -> int:
+    try:
+        got = int(v.strip())
+    except ValueError:
+        raise ValueError(f"SERVE_PORT must be an integer, got {v!r}")
+    if not (0 <= got <= 65535):
+        # a silently-clamped port would bind somewhere the operator
+        # never asked for; refuse instead
+        raise ValueError(f"SERVE_PORT must be in [0, 65535], got {v!r}")
+    return got
+
+
+def _parse_positive_int(name: str):
+    def parse(v: str) -> int:
+        try:
+            got = int(v.strip())
+        except ValueError:
+            raise ValueError(f"{name} must be an integer, got {v!r}")
+        if got <= 0:
+            raise ValueError(f"{name} must be > 0, got {v!r}")
+        return got
+
+    return parse
+
+
+def _parse_fraction(name: str):
+    def parse(v: str) -> float:
+        try:
+            got = float(v.strip())
+        except ValueError:
+            raise ValueError(f"{name} must be a float, got {v!r}")
+        if not (0.0 < got <= 1.0):
+            # a fraction outside (0, 1] silently hands one tenant more
+            # than the whole device (or nothing at all)
+            raise ValueError(f"{name} must be in (0, 1], got {v!r}")
+        return got
+
+    return parse
+
+
 @dataclasses.dataclass(frozen=True)
 class Flag:
     name: str
@@ -147,6 +187,31 @@ _FLAGS = {
             "path to write finished profile sessions as JSON at "
             "process exit (atexit) and from the bench SIGTERM handler; "
             "a non-empty path implies PROFILE",
+        ),
+        Flag(
+            "SERVE_PORT", 0, _parse_port,
+            "serving daemon (serving/server.py) localhost TCP port; "
+            "0 (default) = OS-assigned ephemeral port, read back from "
+            "Server.port",
+        ),
+        Flag(
+            "SERVE_MAX_SESSIONS", 8,
+            _parse_positive_int("SERVE_MAX_SESSIONS"),
+            "serving daemon session-admission cap: a HELLO past this "
+            "many live sessions gets a typed session_limit rejection",
+        ),
+        Flag(
+            "SERVE_SESSION_HBM_FRACTION", 0.25,
+            _parse_fraction("SERVE_SESSION_HBM_FRACTION"),
+            "per-session HBM budget as a fraction of hbm.budget_bytes()"
+            "; admission rejects (or queues behind in-flight work) any "
+            "plan whose estimate exceeds the session's remainder",
+        ),
+        Flag(
+            "SERVE_QUEUE_DEPTH", 16,
+            _parse_positive_int("SERVE_QUEUE_DEPTH"),
+            "serving daemon per-session scheduler queue depth; a "
+            "request past it is shed with a typed BUSY response",
         ),
     ]
 }

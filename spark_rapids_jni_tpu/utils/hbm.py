@@ -107,6 +107,19 @@ def key_word_count(cols: Sequence) -> int:
 _DONATED_LOCK = threading.Lock()
 _DONATED_TOTAL = 0
 
+# Donation listeners: the serving tier registers one so a tenant whose
+# plan donated its buffers gets the bytes credited back against its
+# per-session budget (serving/session.py). Listeners must be cheap and
+# must not raise — they run on the hot donate path, unconditionally
+# (budget credits can't depend on a telemetry flag).
+_DONATION_LISTENERS: list = []
+
+
+def register_donation_listener(fn) -> None:
+    """Register ``fn(nbytes)`` to observe every buffer donation."""
+    if fn not in _DONATION_LISTENERS:
+        _DONATION_LISTENERS.append(fn)
+
 
 def note_donation(nbytes: int) -> None:
     """Record one buffer donation: ``nbytes`` of input HBM the chained
@@ -117,6 +130,8 @@ def note_donation(nbytes: int) -> None:
     conservative by exactly the donated volume."""
     global _DONATED_TOTAL
     profiler.note_donation(int(nbytes))
+    for fn in tuple(_DONATION_LISTENERS):
+        fn(int(nbytes))
     if not (metrics.enabled() or flight.enabled()):
         return
     metrics.counter_add("hbm.donations")

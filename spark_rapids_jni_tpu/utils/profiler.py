@@ -339,6 +339,40 @@ class _SessionScope:
         return False
 
 
+class bound_session:
+    """Bind an already-OPEN :class:`ProfileSession` to the calling
+    thread for the scope's duration.
+
+    The serving daemon's executor threads interleave work from many
+    tenants while several sessions are open at once; without an
+    explicit binding their notes would fall through to the process-wide
+    ``_OPEN[-1]`` fallback — i.e. whichever tenant opened a session
+    most recently, not the tenant whose plan is actually running.
+    ``sess=None`` is a no-op (work executed outside any stream)."""
+
+    __slots__ = ("_sess",)
+
+    def __init__(self, sess: Optional[ProfileSession]):
+        self._sess = sess
+
+    def __enter__(self):
+        sess = self._sess
+        if sess is not None:
+            stack = getattr(_TLS, "sessions", None)
+            if stack is None:
+                stack = _TLS.sessions = []
+            stack.append(sess)
+        return sess
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        sess = self._sess
+        if sess is not None:
+            stack = getattr(_TLS, "sessions", None)
+            if stack and sess in stack:
+                stack.remove(sess)
+        return False
+
+
 class _NullScope:
     """Shared no-op scope: the disabled ``maybe_session`` return."""
 

@@ -1,0 +1,560 @@
+"""Multi-tenant serving daemon: parity, fairness, budgets, teardown.
+
+The ISSUE-9 contract under test: N threaded clients streaming ragged
+batches through one daemon get results BYTE-IDENTICAL to serial
+``table_plan_wire`` execution; session B warm-hits session A's
+compiled executables (process-global ``buckets.cached_jit``); a heavy
+session cannot starve a light one (weighted-deficit scheduling bounds
+the light session's p95 queue wait); an over-budget request gets a
+typed rejection naming the session budget; a shed request gets a typed
+BUSY, never a hang; table ids are session-scoped with labeled
+KeyErrors; and disconnect (graceful OR crash) mid-stream leaks zero
+tables — including the satellite regression that reclaiming a table
+while its ``table_download_wire`` is pending on a pipeline worker
+settles via the donation-barrier path instead of deleting buffers
+under the reader.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import pipeline
+from spark_rapids_jni_tpu import runtime_bridge as rb
+from spark_rapids_jni_tpu import serving
+from spark_rapids_jni_tpu.serving import scheduler as sched_mod
+from spark_rapids_jni_tpu.serving import session as session_mod
+from spark_rapids_jni_tpu.utils import buckets, config, metrics, profiler
+
+I64 = int(dt.TypeId.INT64)
+B8 = int(dt.TypeId.BOOL8)
+STR = int(dt.TypeId.STRING)
+
+BOUNDARY_SIZES = (1023, 1024, 1025)
+
+CHAIN = [
+    {"op": "filter", "mask": 2},
+    {"op": "cast", "column": 1, "type_id": int(dt.TypeId.FLOAT64)},
+    {"op": "sort_by", "keys": [{"column": 0}]},
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    pipeline.drain()
+    for name in ("PIPELINE", "BUCKETS", "METRICS", "HBM_BUDGET_GB",
+                 "SERVE_MAX_SESSIONS", "SERVE_QUEUE_DEPTH",
+                 "SERVE_SESSION_HBM_FRACTION", "SERVE_PORT"):
+        config.clear_flag(name)
+    pipeline.depth()  # flag now off: tears the worker pool down
+
+
+def _string_wire(strings):
+    payload = b"".join(s.encode() for s in strings)
+    offs = np.zeros(len(strings) + 1, np.int32)
+    np.cumsum([len(s.encode()) for s in strings], out=offs[1:])
+    return offs.tobytes() + payload
+
+
+def _batch(n: int, seed: int = 0):
+    """One ragged wire batch: int64 key, int64 value (with nulls),
+    BOOL8 mask, STRING payload."""
+    rng = np.random.default_rng(n + 7919 * seed)
+    k = rng.integers(0, 9, n, dtype=np.int64)
+    v = rng.integers(-100, 100, n, dtype=np.int64)
+    valid = (np.arange(n) % 5 != 0).astype(np.uint8)
+    m = (v > 0).astype(np.uint8)
+    strs = [("s" * (int(x) % 3 + 1)) for x in k]
+    return (
+        [I64, I64, B8, STR], [0, 0, 0, 0],
+        [k.tobytes(), v.tobytes(), m.tobytes(), _string_wire(strs)],
+        [None, valid.tobytes(), None, None], n,
+    )
+
+
+def _norm(wire):
+    t, s, d, v, n = wire
+    return (
+        [int(x) for x in t], [int(x) for x in s],
+        [None if x is None else bytes(x) for x in d],
+        [None if x is None else bytes(x) for x in v], int(n),
+    )
+
+
+def _serial_want(batches):
+    return [
+        _norm(rb.table_plan_wire(json.dumps(CHAIN), *b)) for b in batches
+    ]
+
+
+# ---------------------------------------------------------------------------
+# parity: threaded clients == serial execution, byte for byte
+# ---------------------------------------------------------------------------
+
+
+def test_single_session_stream_parity_boundary_sizes():
+    batches = [_batch(n) for n in BOUNDARY_SIZES]
+    want = _serial_want(batches)
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="solo") as c:
+            got = c.stream(CHAIN, batches)
+    assert [_norm(g) for g in got] == want
+    assert rb.resident_table_count() == 0
+
+
+@pytest.mark.parametrize("n_clients", [2, 4])
+def test_threaded_clients_byte_identical_to_serial(n_clients):
+    per_client = [
+        [_batch(n, seed=i) for n in BOUNDARY_SIZES]
+        for i in range(n_clients)
+    ]
+    want = [_serial_want(bs) for bs in per_client]
+    got = [None] * n_clients
+    errs = []
+
+    with serving.serve() as srv:
+
+        def run(i):
+            try:
+                with serving.Client(srv.port, name=f"c{i}") as c:
+                    got[i] = [
+                        _norm(g) for g in c.stream(CHAIN, per_client[i])
+                    ]
+            except BaseException as e:  # pragma: no cover - diagnostics
+                errs.append(e)
+
+        ts = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(n_clients)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+    assert not errs, errs
+    assert got == want
+    assert rb.resident_table_count() == 0
+
+
+def test_stream_parity_with_pipeline_enabled():
+    batches = [_batch(n) for n in BOUNDARY_SIZES]
+    want = _serial_want(batches)
+    config.set_flag("PIPELINE", "2")
+    with serving.serve() as srv:
+        with serving.Client(srv.port) as c:
+            got = [_norm(g) for g in c.stream(CHAIN, batches)]
+    assert got == want
+
+
+def test_resident_roundtrip_through_daemon():
+    b = _batch(1024)
+    want = _norm(rb.table_plan_wire(json.dumps(CHAIN), *b))
+    with serving.serve() as srv:
+        with serving.Client(srv.port) as c:
+            tid = c.upload(b)
+            out = c.plan(CHAIN, [tid], donate=True)
+            got = _norm(c.download(out))
+            assert c.free(out) >= 0
+    assert got == want
+    assert rb.resident_table_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-session executable-cache sharing (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_second_session_compile_count_near_zero():
+    config.set_flag("METRICS", True)
+    metrics.reset()
+    buckets.cache_clear()
+    batches = [_batch(n) for n in BOUNDARY_SIZES]
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="warm") as a:
+            a.stream(CHAIN, batches)
+        after_a = metrics.snapshot()["counters"]
+        with serving.Client(srv.port, name="rider") as b:
+            got = [_norm(g) for g in b.stream(CHAIN, batches)]
+        after_b = metrics.snapshot()["counters"]
+    assert got == _serial_want(batches)
+    misses_b = (
+        after_b.get("compile_cache.miss", 0)
+        - after_a.get("compile_cache.miss", 0)
+    )
+    hits_b = (
+        after_b.get("compile_cache.hit", 0)
+        - after_a.get("compile_cache.hit", 0)
+    )
+    # session B replays session A's shapes: every fused-segment lookup
+    # must warm-hit the process-global cache — compile count ~ 0
+    assert misses_b == 0, (misses_b, after_b)
+    assert hits_b > 0, after_b
+
+
+# ---------------------------------------------------------------------------
+# fairness: the weighted-deficit queue bounds the light session's wait
+# ---------------------------------------------------------------------------
+
+
+def test_fair_scheduler_heavy_cannot_starve_light():
+    sched = sched_mod.FairScheduler(
+        workers=1, queue_depth=64, quantum_rows=65536
+    ).start()
+    heavy = session_mod.Session("h", "heavy", 1.0, 1 << 40)
+    light = session_mod.Session("l", "light", 1.0, 1 << 40)
+    sched.register(heavy)
+    sched.register(light)
+    try:
+        heavy_t0 = time.perf_counter()
+        hts = [
+            sched.submit(heavy, lambda: time.sleep(0.02), cost=65536,
+                         shed=False)
+            for _ in range(20)
+        ]
+        lts = [
+            sched.submit(light, lambda: None, cost=64, shed=False)
+            for _ in range(5)
+        ]
+        for t in hts + lts:
+            t.result()
+        heavy_total = time.perf_counter() - heavy_t0
+    finally:
+        sched.unregister(heavy)
+        sched.unregister(light)
+        sched.stop()
+    p95 = light.wait_percentiles()["p95_ms"] / 1e3
+    # DRR interleaves: each light request waits at most a couple of
+    # heavy executions (~20 ms each), never the whole heavy backlog
+    assert p95 < heavy_total * 0.5, (p95, heavy_total)
+    assert p95 < 0.2, p95
+
+
+def test_daemon_fairness_two_sessions():
+    heavy_batches = [_batch(8192, seed=i) for i in range(16)]
+    light_batch = [_batch(256)]
+    stats_doc = {}
+    with serving.serve(workers=1) as srv:
+        # warm both bucket shapes first: the timed phase below must
+        # measure queueing under DRR, not first-compile latency
+        with serving.Client(srv.port, name="warmup") as w:
+            w.stream(CHAIN, [heavy_batches[0], light_batch[0]])
+        done = threading.Event()
+
+        def heavy_run():
+            with serving.Client(srv.port, name="heavy") as c:
+                c.stream(CHAIN, heavy_batches)
+            done.set()
+
+        th = threading.Thread(target=heavy_run)
+        t0 = time.perf_counter()
+        th.start()
+        with serving.Client(srv.port, name="light") as c:
+            while not done.is_set():
+                c.stream(CHAIN, light_batch)
+            stats_doc.update({
+                s["name"]: s for s in c.stats()["sessions"]
+            })
+        th.join(timeout=120)
+        heavy_total = time.perf_counter() - t0
+    light_doc = stats_doc.get("light")
+    assert light_doc is not None
+    assert light_doc["requests"] >= 1
+    p95 = light_doc["queue_wait"]["p95_ms"] / 1e3
+    # the light session's requests interleave into the heavy stream:
+    # its p95 queue wait is bounded well below the heavy makespan
+    # (absolute floor tolerates scheduler noise on a loaded runner)
+    assert p95 < max(heavy_total * 0.6, 0.1), (p95, heavy_total)
+
+
+# ---------------------------------------------------------------------------
+# admission: typed over-budget rejection + typed BUSY shed
+# ---------------------------------------------------------------------------
+
+
+def test_over_budget_typed_rejection_names_session_budget():
+    config.set_flag("HBM_BUDGET_GB", 1e-6)  # ~1 KiB device budget
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="greedy") as c:
+            with pytest.raises(serving.ServingOverBudget) as ei:
+                c.stream(CHAIN, [_batch(4096)])
+            msg = str(ei.value)
+            assert "greedy" in msg
+            assert "budget" in msg
+            assert str(c.budget_bytes) in msg
+            # the session survives the rejection: a fitting request on
+            # the same connection still works
+            with pytest.raises(serving.ServingOverBudget):
+                c.stream(CHAIN, [_batch(4096)])
+    assert rb.resident_table_count() == 0
+
+
+def test_busy_shed_is_typed_and_never_hangs():
+    with serving.serve(queue_depth=2, workers=1) as srv:
+        with serving.Client(srv.port, name="shed") as c:
+            sess = srv._sessions[c.session]
+            gate = threading.Event()
+            # block the single executor, then fill the session queue
+            blocker = srv.scheduler.submit(
+                sess, gate.wait, cost=1, shed=False
+            )
+            fillers = [
+                srv.scheduler.submit(sess, lambda: None, cost=1,
+                                     shed=False)
+                for _ in range(2)
+            ]
+            t0 = time.perf_counter()
+            with pytest.raises(serving.ServingBusy) as ei:
+                c.stream(CHAIN, [_batch(64)])
+            assert time.perf_counter() - t0 < 30
+            assert "shed" in str(ei.value)
+            gate.set()
+            for t in [blocker] + fillers:
+                t.result()
+            # queue drained: the same request now succeeds
+            got = c.stream(CHAIN, [_batch(64)])
+            assert len(got) == 1
+            assert c.stats()["sessions"][0]["shed"] >= 1
+
+
+def test_session_limit_typed_rejection():
+    with serving.serve(max_sessions=1) as srv:
+        with serving.Client(srv.port, name="only"):
+            with pytest.raises(serving.ServingSessionLimit):
+                serving.Client(srv.port, name="extra").connect()
+        # the slot freed on close: a new session is admitted again
+        with serving.Client(srv.port, name="next") as c:
+            assert c.session
+
+
+def test_donation_credits_flow_back_to_session():
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="donor") as c:
+            c.stream(CHAIN, [_batch(2048)])
+            doc = c.stats()["sessions"][0]
+    # the fused chain donates its consumed input; the credit lands on
+    # the tenant's budget accounting, and completion clears in-flight
+    assert doc["donated_credit_bytes"] > 0
+    assert doc["inflight_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# session-scoped namespaces
+# ---------------------------------------------------------------------------
+
+
+def test_cross_session_table_access_is_labeled_keyerror():
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="owner") as a, \
+                serving.Client(srv.port, name="thief") as b:
+            tid = a.upload(_batch(512))
+            with pytest.raises(serving.ServingTableError) as ei:
+                b.download(tid)
+            msg = str(ei.value)
+            assert "thief" in msg
+            assert "session-scoped" in msg
+            with pytest.raises(serving.ServingTableError):
+                b.free(tid)
+            # the owner still sees its table
+            assert _norm(a.download(tid))[4] == 512
+    assert rb.resident_table_count() == 0
+
+
+def test_second_connection_attaches_to_same_session():
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="tenant") as a:
+            tid = a.upload(_batch(256))
+            with serving.Client(srv.port, session=a.session) as b:
+                assert b.session == a.session
+                assert _norm(b.download(tid))[4] == 256
+            # detaching the second connection must NOT tear down the
+            # still-attached session
+            assert _norm(a.download(tid))[4] == 256
+    assert rb.resident_table_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# teardown: zero leaks on disconnect and crash
+# ---------------------------------------------------------------------------
+
+
+def _wait_until(cond, timeout=30.0):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_graceful_disconnect_reclaims_all_tables():
+    with serving.serve() as srv:
+        c = serving.Client(srv.port, name="tidy").connect()
+        for n in (256, 512, 1024):
+            c.upload(_batch(n))
+        assert rb.resident_table_count() == 3
+        c.close()
+        assert _wait_until(lambda: rb.resident_table_count() == 0)
+    assert rb.leak_report() == []
+
+
+def test_crash_disconnect_mid_stream_leaks_zero_tables():
+    config.set_flag("PIPELINE", "2")
+    with serving.serve() as srv:
+        c = serving.Client(srv.port, name="crash").connect()
+        for n in (256, 512):
+            c.upload(_batch(n))
+        # fire a stream and kill the socket without waiting: the
+        # daemon finishes or drops the in-flight work, then tears the
+        # session down with full reclamation
+        from spark_rapids_jni_tpu.serving import frames
+
+        metas, buffers = frames.batches_to_parts(
+            [_batch(n, seed=9) for n in BOUNDARY_SIZES]
+        )
+        frames.send_frame(
+            c._sock, {"cmd": "stream", "plan": CHAIN, "batches": metas},
+            buffers,
+        )
+        c.kill()
+        assert _wait_until(lambda: rb.resident_table_count() == 0), (
+            rb.leak_report()
+        )
+    assert rb.leak_report() == []
+
+
+def test_server_stop_tears_down_live_sessions():
+    srv = serving.Server().start()
+    c = serving.Client(srv.port, name="leftover").connect()
+    c.upload(_batch(128))
+    srv.stop()  # client never said bye
+    assert rb.resident_table_count() == 0
+    assert rb.leak_report() == []
+
+
+# ---------------------------------------------------------------------------
+# satellite regression: reclaim vs in-flight readers (donation barrier)
+# ---------------------------------------------------------------------------
+
+
+def test_reclaim_waits_for_download_pending_on_worker(monkeypatch):
+    """Freeing a table while its ``table_download_wire`` is pending on
+    a pipeline worker must settle via the barrier path: the reclaim
+    drains the in-flight serializer before deleting buffers, so the
+    download still returns the full, correct wire bytes."""
+    config.set_flag("PIPELINE", "2")
+    b = _batch(1024)
+    tid = rb.table_upload_wire(*b)
+    want = _norm(rb.table_download_wire(tid))
+
+    real = rb._column_to_wire
+    started = threading.Event()
+
+    def slow_column_to_wire(col, logical_rows, ctx):
+        started.set()
+        time.sleep(0.05)  # hold the serializer open across the reclaim
+        return real(col, logical_rows, ctx)
+
+    monkeypatch.setattr(rb, "_column_to_wire", slow_column_to_wire)
+    p = pipeline.submit(lambda: rb.table_download_wire(tid), "encode")
+    assert started.wait(timeout=30)
+    reclaimed = rb.table_reclaim(tid)  # must wait, not delete underfoot
+    monkeypatch.setattr(rb, "_column_to_wire", real)
+    assert _norm(p.resolve()) == want
+    assert reclaimed > 0
+    with pytest.raises(KeyError, match="already-freed"):
+        rb.table_download_wire(tid)
+    assert rb.resident_table_count() == 0
+
+
+def test_reclaim_settles_pipelined_reader_before_deleting():
+    """A pipelined op still READING the table (registered in
+    ``_RESIDENT_READERS``) is terminally settled by the reclaim — the
+    donate barrier — so its result is correct even though the input's
+    buffers are deleted right after."""
+    config.set_flag("PIPELINE", "2")
+    b = _batch(1024)
+    tid = rb.table_upload_wire(*b)
+    op = json.dumps({"op": "sort_by", "keys": [{"column": 0}]})
+    out = rb.table_op_resident(op, [tid])
+    rb.table_reclaim(tid)  # settles the reader, then deletes buffers
+    got = _norm(rb.table_download_wire(out))
+    pipeline.drain()
+    config.clear_flag("PIPELINE")
+    tid2 = rb.table_upload_wire(*b)
+    out2 = rb.table_op_resident(op, [tid2])
+    want = _norm(rb.table_download_wire(out2))
+    rb.table_free(out)
+    rb.table_free(tid2)
+    rb.table_free(out2)
+    assert got == want
+    assert rb.resident_table_count() == 0
+
+
+def test_reclaim_unknown_id_raises_labeled_keyerror():
+    with pytest.raises(KeyError, match="table id 999999"):
+        rb.table_reclaim(999999)
+
+
+# ---------------------------------------------------------------------------
+# observability: served streams are session-stamped profile sessions
+# ---------------------------------------------------------------------------
+
+
+def test_served_streams_open_labeled_profile_sessions():
+    profiler.sessions(reset=True)
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="alpha") as a:
+            a.stream(CHAIN, [_batch(512)])
+        with serving.Client(srv.port, name="beta") as b:
+            b.stream(CHAIN, [_batch(512)])
+    labels = {s["label"] for s in profiler.sessions(reset=True)}
+    assert "serve:alpha" in labels
+    assert "serve:beta" in labels
+
+
+# ---------------------------------------------------------------------------
+# SERVE* config knobs: centralized, loud-fail parsing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,bad,needle", [
+    ("SERVE_PORT", "abc", "SERVE_PORT"),
+    ("SERVE_PORT", "70000", "SERVE_PORT"),
+    ("SERVE_MAX_SESSIONS", "0", "SERVE_MAX_SESSIONS"),
+    ("SERVE_MAX_SESSIONS", "x", "SERVE_MAX_SESSIONS"),
+    ("SERVE_QUEUE_DEPTH", "-3", "SERVE_QUEUE_DEPTH"),
+    ("SERVE_SESSION_HBM_FRACTION", "2.0", "SERVE_SESSION_HBM_FRACTION"),
+    ("SERVE_SESSION_HBM_FRACTION", "nope", "SERVE_SESSION_HBM_FRACTION"),
+])
+def test_serve_flags_fail_loudly(monkeypatch, name, bad, needle):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_" + name, bad)
+    with pytest.raises(ValueError, match=needle):
+        config.get_flag(name)
+
+
+def test_serve_flags_defaults_and_parse(monkeypatch):
+    assert config.get_flag("SERVE_PORT") == 0
+    assert config.get_flag("SERVE_MAX_SESSIONS") == 8
+    assert config.get_flag("SERVE_QUEUE_DEPTH") == 16
+    assert config.get_flag("SERVE_SESSION_HBM_FRACTION") == 0.25
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_SERVE_PORT", "4242")
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_SERVE_QUEUE_DEPTH", "3")
+    monkeypatch.setenv(
+        "SPARK_RAPIDS_TPU_SERVE_SESSION_HBM_FRACTION", "0.5"
+    )
+    assert config.get_flag("SERVE_PORT") == 4242
+    assert config.get_flag("SERVE_QUEUE_DEPTH") == 3
+    assert config.get_flag("SERVE_SESSION_HBM_FRACTION") == 0.5
+
+
+def test_server_reads_flags_from_config(monkeypatch):
+    config.set_flag("SERVE_MAX_SESSIONS", 1)
+    config.set_flag("SERVE_QUEUE_DEPTH", 5)
+    srv = serving.Server()
+    assert srv.max_sessions == 1
+    assert srv.queue_depth == 5

@@ -404,6 +404,41 @@ def summarize_pipeline(raw: list, merged=None) -> None:
         )
 
 
+def summarize_serving(raw: list) -> None:
+    """Multi-tenant serving summary: per-entry ``serving`` blocks (the
+    bench ``serving_multiquery`` config) — sessions, shed count, merged
+    queue-wait percentiles and the cross-session compile-cache hit rate
+    (tenant B warm-hitting tenant A's executables). Old BENCH files
+    have no such blocks — silent skip, like the other summaries."""
+    blocks = [e for e in raw if isinstance(e.get("serving"), dict)]
+    if not blocks:
+        return
+    print("\nserving daemon:")
+    for e in blocks:
+        s = e["serving"]
+        hits = int(s.get("cross_session_hits", 0))
+        misses = int(s.get("cross_session_misses", 0))
+        print(
+            f"  {e.get('name', '?'):42} sessions={s.get('sessions', '?')} "
+            f"requests={s.get('requests', '?')} shed={s.get('shed', '?')} "
+            f"wait p50/p95 {s.get('queue_wait_ms_p50', '?')}/"
+            f"{s.get('queue_wait_ms_p95', '?')} ms"
+        )
+        print(
+            f"    cross-session cache: {hits} hits / {misses} misses "
+            f"(rate {s.get('cross_session_hit_rate', '?')}; warm session "
+            f"paid {s.get('warm_misses', '?')} compiles); leaked "
+            f"tables={s.get('leaked_tables', '?')}"
+        )
+        for d in s.get("sessions_detail", []) or []:
+            qw = d.get("queue_wait") or {}
+            print(
+                f"    {d.get('name', '?'):28} requests={d.get('requests', '?'):>3} "
+                f"shed={d.get('shed', 0)} wait p95 {qw.get('p95_ms', '?')} ms "
+                f"donated-credit {int(d.get('donated_credit_bytes', 0)) / 1e6:.2f} MB"
+            )
+
+
 def summarize_profile(raw: list, top: int = 8) -> None:
     """Top plan segments by time from the entries' ``profile`` blocks
     (the per-config aggregated profiler summary bench embeds since the
@@ -505,6 +540,7 @@ def main() -> None:
         summarize_compile_cache(raw)
         summarize_plan_fusion(raw, merged=merged)
         summarize_pipeline(raw, merged=merged)
+        summarize_serving(raw)
         summarize_profile(raw)
         summarize_failures(raw)
         return
@@ -533,6 +569,7 @@ def main() -> None:
     summarize_compile_cache(raw)
     summarize_plan_fusion(raw, merged=merged)
     summarize_pipeline(raw, merged=merged)
+    summarize_serving(raw)
     summarize_profile(raw)
     summarize_failures(raw)
 
