@@ -64,16 +64,32 @@ def _flight_note(name, arg=None):
         pass
 
 
+def _classify_failure_text(type_name, message) -> str:
+    """Taxonomy class name for a failure's (type, message) text via
+    faults.classify_text — the shared classifier replacing this file's
+    historical ad-hoc marker list."""
+    try:
+        from spark_rapids_jni_tpu.utils import faults
+
+        return faults.classify_text(
+            str(type_name or ""), str(message or "")
+        ).__name__
+    except Exception:
+        return "PermanentError"
+
+
 def _failure_record(
-    name, error, exc_type=None, elapsed_s=None, retries=0, skipped=False
+    name, error, exc_type=None, elapsed_s=None, retries=0, skipped=False,
+    backoff_ms=0.0,
 ):
-    """Structured failure entry: exception type, message, elapsed time
-    and retry count, so a killed ladder is diagnosable from the JSON
-    alone (rounds 1-5 died with bare '"error": "device unreachable"'
-    strings and no telemetry). The flat "error" string stays for old
-    readers; "failure" is the structured record. ``skipped=True`` marks
-    a config that was never attempted (budget exhausted / fast-fail
-    after the tunnel went down) as opposed to one that ran and died.
+    """Structured failure entry: exception type, message, taxonomy
+    class, elapsed time and retry/backoff counts, so a killed ladder is
+    diagnosable from the JSON alone (rounds 1-5 died with bare
+    '"error": "device unreachable"' strings and no telemetry). The flat
+    "error" string stays for old readers; "failure" is the structured
+    record. ``skipped=True`` marks a config that was never attempted
+    (budget exhausted / fast-fail after the tunnel went down) as
+    opposed to one that ran and died.
     When the flight recorder is on, a record for a config that actually
     RAN and died also carries ``flight_tail`` — the last events before
     the failure, the input of ``tools/trace2chrome.py`` — so "device
@@ -82,15 +98,19 @@ def _failure_record(
     embed N byte-identical tails into the headline JSON; the config
     that triggered the fast-fail carries the one that matters."""
     msg = str(error)[:300]
+    tname = exc_type or (
+        type(error).__name__ if isinstance(error, BaseException)
+        else "Error"
+    )
     failure = {
-        "type": exc_type
-        or (type(error).__name__ if isinstance(error, BaseException)
-            else "Error"),
+        "type": tname,
         "message": msg,
+        "class": _classify_failure_text(tname, msg),
         "elapsed_s": (
             round(float(elapsed_s), 3) if elapsed_s is not None else None
         ),
         "retries": int(retries),
+        "backoff_ms": round(float(backoff_ms), 2),
         "skipped": bool(skipped),
     }
     if not skipped:
@@ -100,25 +120,16 @@ def _failure_record(
     return {"name": name, "error": msg, "failure": failure}
 
 
-# markers of a dead/hung tunnel in a config failure: after the FIRST of
-# these, re-probe once and fast-fail the rest of the device ladder
-# instead of burning a per-config timeout on every remaining entry
-_UNREACHABLE_MARKERS = (
-    "unreachable", "UNAVAILABLE", "DEADLINE_EXCEEDED",
-    "failed to connect", "Connection reset", "socket closed",
-)
-
-
 def _unreachable_failure(entry) -> bool:
     """True when a failure entry smells like the device/tunnel died
-    (vs a genuine per-config crash)."""
+    (vs a genuine per-config crash) — i.e. it classifies transient
+    under the shared fault taxonomy (faults.classify_text subsumes the
+    marker list this file used to keep by hand)."""
     f = entry.get("failure") or {}
-    if f.get("type") in ("DeviceUnreachable", "TimeoutExpired"):
-        return True
-    # casefold both sides: gRPC/absl capitalize freely ("Failed to
-    # connect", "Socket closed")
-    msg = f"{f.get('message', '')} {entry.get('error', '')}".lower()
-    return any(m.lower() in msg for m in _UNREACHABLE_MARKERS)
+    return _classify_failure_text(
+        f.get("type", ""),
+        f"{f.get('message', '')} {entry.get('error', '')}",
+    ) == "TransientDeviceError"
 
 
 def _metrics_enable():
@@ -2309,10 +2320,23 @@ def main():
 
     t_probe = time.time()
     probe_retries = 0
+    probe_backoff_ms = 0.0
     alive = _probe_device()
     if not alive:
-        _progress("device probe failed (tunnel down/hung): retrying once")
+        # jittered backoff from the shared retry plane before the one
+        # re-probe: a tunnel mid-restart often answers a beat later
+        try:
+            from spark_rapids_jni_tpu.utils import faults as _faults
+
+            probe_backoff_ms = _faults.backoff_ms(1, "bench.probe")
+        except Exception:
+            probe_backoff_ms = 0.0
+        _progress(
+            "device probe failed (tunnel down/hung): retrying once "
+            f"after {probe_backoff_ms:.0f}ms"
+        )
         _flight_note("tunnel.probe_retry")
+        time.sleep(probe_backoff_ms / 1e3)
         probe_retries = 1
         alive = _probe_device()
     probe_elapsed = time.time() - t_probe
@@ -2391,7 +2415,7 @@ def main():
                     key, "device unreachable",
                     exc_type="DeviceUnreachable",
                     elapsed_s=probe_elapsed, retries=probe_retries,
-                    skipped=True,
+                    backoff_ms=probe_backoff_ms, skipped=True,
                 ))
         _emit(entries, platform)
 

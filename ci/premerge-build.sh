@@ -52,5 +52,10 @@ print('entry OK')
 # into a Perfetto-loadable Chrome trace (the crash-postmortem contract).
 bash ci/smoke-observability.sh
 
+# Chaos smoke: a served stream under a seeded fault plan must recover
+# byte-identical with nonzero retry counters, the circuit breaker must
+# trip and re-close via the background probe, and zero tables may leak.
+bash ci/smoke-chaos.sh
+
 # Bench smoke on whatever device this node has.
 python3 bench.py

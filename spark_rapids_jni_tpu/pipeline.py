@@ -46,7 +46,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
-from .utils import config, flight, log, metrics, profiler
+from .utils import config, faults, flight, log, metrics, profiler
 
 DEFAULT_DEPTH = 2
 MAX_DEPTH = 64
@@ -184,6 +184,10 @@ class Pending:
                 self._value = self._work()
         except BaseException as e:
             self._error = e
+            # classify at the worker boundary (faults.class.* counters)
+            # — the error itself still surfaces via the sync-replay
+            # contract at the blocking point
+            faults.note_error_class(e, "pipeline." + self.label)
             if self._orphaned:
                 # fire-and-forget: the caller freed this handle before
                 # the failure and no blocking point will ever resolve
@@ -516,13 +520,20 @@ def run_stream(
     items = list(items)
     d = depth()
     if d == 0:
-        return [encode(compute(decode(it))) for it in items]
+        out = []
+        for it in items:
+            # the cooperative cancellation checkpoint between batches
+            # (no-op without a bound faults.CancelToken)
+            faults.check_cancel()
+            out.append(encode(compute(decode(it))))
+        return out
     pool = _pool()
     n = len(items)
     decoded: List[Optional[Pending]] = [None] * n
     encoded: List[Optional[Pending]] = [None] * n
     submitted = 0
     for i in range(n):
+        faults.check_cancel()  # between-batch cancellation checkpoint
         # keep up to `depth` decodes in flight INCLUDING the current
         # one (submitting depth+1 against a depth-slot semaphore would
         # block every iteration and record phantom backpressure stalls)

@@ -46,7 +46,7 @@ import jax.numpy as jnp
 
 from . import dtype as dt
 from .column import Column, Table
-from .utils import buckets, flight, log, metrics, profiler
+from .utils import buckets, faults, flight, log, metrics, profiler
 
 # single-table ops a fused segment can carry anywhere in its run
 _SIMPLE_FUSABLE = frozenset(
@@ -315,6 +315,98 @@ def _run_fused(
     return bucketed._finish(out, int(count))
 
 
+# ops whose output over a row range depends only on the rows in that
+# range — the segments the OOM half-batch degradation may legally
+# split: run each half, concatenate, and the result is byte-identical.
+# sort_by/distinct/groupby/slice are global (cross-row) and must not
+# be chunked; they fall back to the exact path instead.
+_ROW_LOCAL = frozenset({"cast", "filter", "rlike"})
+
+
+def _run_chunked(seg_ops: Sequence[dict], table: Table) -> Table:
+    """The graceful-degradation path for a ResourceExhausted fused
+    segment: split the input at half the rows, run each half through
+    the same fused machinery (smaller bucket -> smaller working set),
+    and concatenate — parity-safe because every op in the segment is
+    row-local (caller-gated on :data:`_ROW_LOCAL`). Returns the exact
+    (unpadded) result table; raises faults.ResourceExhausted when the
+    input is too small to split."""
+    from .ops.copying import concatenate, slice_rows
+
+    t = buckets.unpad_table(table)
+    n = int(t.row_count)
+    if n < 2:
+        raise faults.ResourceExhausted(
+            f"segment OOM at {n} row(s): nothing left to split"
+        )
+    halves = []
+    # a _Decline at the half shape propagates: the exact per-op path
+    # is the smaller-footprint fallback the caller owns
+    for lo, hi in ((0, n // 2), (n // 2, n)):
+        part = slice_rows(t, lo, hi)
+        halves.append(buckets.unpad_table(_run_fused(seg_ops, part)))
+    metrics.counter_add("plan.chunked_segments")
+    if flight.enabled():
+        flight.record(
+            "I", "plan.oom_chunked",
+            ",".join(str(o.get("op", "?")) for o in seg_ops),
+        )
+    return concatenate(halves)
+
+
+def _run_fused_tolerant(
+    seg_ops: Sequence[dict], table: Table, donate: bool
+) -> Table:
+    """One fused segment with the fault-tolerance contract applied at
+    segment granularity:
+
+    * a donated launch that already CONSUMED its input is at-most-once
+      (PR 5's doomed-replay rule): its error surfaces as-is, no retry;
+    * a ResourceExhausted-classified failure with the input intact
+      retries at half-batch chunks first (row-local segments only);
+    * a transient-classified failure retries the whole segment with
+      backoff up to RETRY_MAX (the injection fires BEFORE the launch
+      consumes anything, so an injected retry is always safe);
+    * anything else propagates to run_plan's per-op replay fallback.
+    """
+    from . import bucketed
+
+    attempt = 0
+    while True:
+        faults.check_cancel()
+        try:
+            faults.inject("dispatch")
+            return _run_fused(seg_ops, table, donate=donate)
+        except bucketed._Decline:
+            raise
+        except (faults.Cancelled, faults.DeadlineExceeded):
+            raise
+        except Exception as e:
+            if _input_consumed(table):
+                # donated executable failed AFTER consuming its input:
+                # retrying (or replaying) would dereference deleted
+                # buffers — the worker error is authoritative
+                raise
+            cls = faults.classify(e)
+            if cls is faults.ResourceExhausted and all(
+                o.get("op") in _ROW_LOCAL for o in seg_ops
+            ):
+                try:
+                    return _run_chunked(seg_ops, table)
+                except Exception:
+                    raise e  # exact-path fallback owns it from here
+            if (
+                faults.retryable_class(cls)
+                and attempt < faults.retry_max()
+            ):
+                attempt += 1
+                faults.sleep_backoff(
+                    attempt, "plan.segment", error=e
+                )
+                continue
+            raise
+
+
 def _take_rest(op: dict, orig_rest: tuple, queue: list) -> list:
     """Extra input tables for a multi-table fallback op: an explicit
     ``"rest"`` field names indices into the plan call's extra-table
@@ -386,6 +478,7 @@ def run_plan(
         protected.update(_buffer_ids(t))
     with metrics.span("plan", segments=len(segs), ops=len(ops)):
         for i, (kind, seg_ops) in enumerate(segs):
+            faults.check_cancel()  # between-segment checkpoint
             with metrics.span(
                 "plan.segment", index=i, kind=kind, ops=len(seg_ops)
             ):
@@ -401,7 +494,7 @@ def run_plan(
                             _buffer_ids(table)
                         )
                         try:
-                            table = _run_fused(
+                            table = _run_fused_tolerant(
                                 seg_ops, table, donate=donate
                             )
                             metrics.counter_add("plan.fused_segments")
@@ -413,6 +506,12 @@ def run_plan(
                             # not a failure: no bucket for this shape —
                             # the per-op path owns it
                             metrics.counter_add("plan.declined")
+                        except (
+                            faults.Cancelled, faults.DeadlineExceeded
+                        ):
+                            # cooperative aborts are not segment
+                            # failures: never replayed, never wrapped
+                            raise
                         except Exception as e:
                             if _input_consumed(table):
                                 # the donated executable failed AFTER

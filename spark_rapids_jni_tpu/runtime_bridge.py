@@ -39,7 +39,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 from . import dtype as dt
 from . import pipeline
 from .column import Column, Table
-from .utils import buckets, flight, log, metrics, profiler
+from .utils import buckets, faults, flight, log, metrics, profiler
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -423,8 +423,25 @@ def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
     The disabled path costs one string concat and the span's cheap
     gate checks. Row counters count LOGICAL rows (padding is an
     implementation detail; its cost shows up in ``bucket.*`` instead).
+
+    This is also a fault boundary (utils/faults.py): the ``dispatch``
+    injection site is armed here, transient-classified failures retry
+    with backoff (safe: nothing on this path donates its inputs — the
+    consumed single-op flavor is ``dispatch_bucketed_donated``, gated
+    by its caller), and permanent-classified errors surface unchanged.
     """
     name = op["op"]
+
+    def attempt():
+        faults.inject("dispatch")
+        return _dispatch_once(op, table, rest, name)
+
+    return faults.run_with_retry(attempt, "dispatch." + name)
+
+
+def _dispatch_once(
+    op: dict, table: Table, rest: Sequence[Table], name: str
+) -> Table:
     with metrics.span("dispatch." + name):
         out = None
         if buckets.enabled():
@@ -572,7 +589,22 @@ def _table_from_wire(
 ) -> Table:
     """One wire-deserialize pass -> a (possibly host-padded) Table.
     Host decode per column, then the whole table's buffers cross to the
-    device as ONE batched ``jax.device_put`` pytree transfer."""
+    device as ONE batched ``jax.device_put`` pytree transfer. A wire
+    decode is pure (the caller's bytes are never consumed), so the
+    ``serde`` fault site retries transient failures here freely."""
+
+    def attempt():
+        faults.inject("serde")
+        return _table_from_wire_impl(
+            type_ids, scales, datas, valids, num_rows, pad_to
+        )
+
+    return faults.run_with_retry(attempt, "wire.in")
+
+
+def _table_from_wire_impl(
+    type_ids, scales, datas, valids, num_rows, pad_to
+) -> Table:
     prof = profiler.session_active()
     nbytes = (
         sum(len(d) for d in datas if d is not None)
@@ -597,7 +629,17 @@ def _table_from_wire(
 def _table_to_wire(t: Table):
     """One wire-serialize pass -> the 5-tuple every wire entry returns
     (shape-bucket padding sliced away host-side; one shared
-    ``_SerializePass`` scratch across the table's columns)."""
+    ``_SerializePass`` scratch across the table's columns). Pure reads
+    of device buffers, so the ``serde`` fault site retries here too."""
+
+    def attempt():
+        faults.inject("serde")
+        return _table_to_wire_impl(t)
+
+    return faults.run_with_retry(attempt, "wire.out")
+
+
+def _table_to_wire_impl(t: Table):
     out_t, out_s, out_d, out_v = [], [], [], []
     ctx = _SerializePass()
     prof = profiler.session_active()

@@ -15,10 +15,15 @@ CONTRIBUTING.md "Serving daemon".
 from .client import (  # noqa: F401
     Client,
     ServingBusy,
+    ServingCancelled,
+    ServingDeadlineExceeded,
+    ServingDegraded,
     ServingError,
     ServingOverBudget,
+    ServingResourceExhausted,
     ServingSessionLimit,
     ServingTableError,
+    ServingTransientError,
 )
 from .scheduler import Busy, FairScheduler, Ticket  # noqa: F401
 from .server import Server, SessionLimit, serve  # noqa: F401

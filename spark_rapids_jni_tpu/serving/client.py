@@ -46,11 +46,38 @@ class ServingTableError(ServingError, KeyError):
         return self.args[0] if self.args else ""
 
 
+class ServingDegraded(ServingError):
+    """The daemon's circuit breaker is open: shed without device work.
+    The message names when the next recovery probe runs."""
+
+
+class ServingCancelled(ServingError):
+    """The request was cancelled server-side before completing."""
+
+
+class ServingDeadlineExceeded(ServingError):
+    """The request's deadline elapsed before the work finished."""
+
+
+class ServingResourceExhausted(ServingError):
+    """Device memory pressure the daemon could not degrade around."""
+
+
+class ServingTransientError(ServingError):
+    """A transient device failure that outlived the retry budget —
+    safe to retry client-side."""
+
+
 _ERROR_CLASSES = {
     "busy": ServingBusy,
     "over_budget": ServingOverBudget,
     "session_limit": ServingSessionLimit,
     "unknown_table": ServingTableError,
+    "degraded": ServingDegraded,
+    "cancelled": ServingCancelled,
+    "deadline_exceeded": ServingDeadlineExceeded,
+    "resource_exhausted": ServingResourceExhausted,
+    "transient_device": ServingTransientError,
 }
 
 
@@ -68,11 +95,13 @@ class Client:
 
     def __init__(self, port: int, host: str = "127.0.0.1",
                  name: Optional[str] = None, weight: float = 1.0,
-                 session: Optional[str] = None, timeout: float = 60.0):
+                 session: Optional[str] = None, timeout: float = 60.0,
+                 deadline_s: Optional[float] = None):
         self._addr = (host, int(port))
         self._hello = {
             k: v for k, v in (
                 ("name", name), ("weight", weight), ("session", session),
+                ("deadline_s", deadline_s),
             ) if v is not None
         }
         self._timeout = timeout
@@ -136,14 +165,17 @@ class Client:
         return resp
 
     # -- commands ---------------------------------------------------------
-    def stream(self, ops: list, batches: Sequence) -> List[tuple]:
+    def stream(self, ops: list, batches: Sequence,
+               deadline_s: Optional[float] = None) -> List[tuple]:
         """Run ``ops`` (a plan: JSON-able list of op dicts) over wire
-        batches; returns one result 5-tuple per batch, in order."""
+        batches; returns one result 5-tuple per batch, in order.
+        ``deadline_s`` bounds this one request (overrides the session
+        default from hello)."""
         metas, buffers = frames.batches_to_parts(batches)
-        resp = self._rpc(
-            {"cmd": "stream", "plan": list(ops), "batches": metas},
-            buffers,
-        )
+        header = {"cmd": "stream", "plan": list(ops), "batches": metas}
+        if deadline_s is not None:
+            header["deadline_s"] = float(deadline_s)
+        resp = self._rpc(header, buffers)
         return frames.batches_from_parts(
             resp.get("results") or [], resp["_payload"]
         )
@@ -154,11 +186,15 @@ class Client:
         return int(resp["table"])
 
     def plan(self, ops: list, tables: Sequence[int],
-             donate: bool = False) -> int:
-        resp = self._rpc({
+             donate: bool = False,
+             deadline_s: Optional[float] = None) -> int:
+        header = {
             "cmd": "plan", "plan": list(ops),
             "tables": [int(t) for t in tables], "donate": bool(donate),
-        })
+        }
+        if deadline_s is not None:
+            header["deadline_s"] = float(deadline_s)
+        resp = self._rpc(header)
         return int(resp["table"])
 
     def download(self, table: int) -> tuple:

@@ -19,6 +19,12 @@ A batch is described in the header as::
 
 with ``-1`` meaning "no buffer follows" (a NULL-free column's validity,
 or an empty data buffer encoded as length 0 vs. absent as -1).
+
+Hello and command headers may carry ``deadline_s`` (float seconds):
+on hello it sets the session's default request deadline, on a
+``stream`` / ``plan`` command it bounds that one request — the server
+turns it into a ``faults.CancelToken`` checked between plan segments
+and stream batches, answering ``deadline_exceeded`` when it elapses.
 """
 
 from __future__ import annotations

@@ -33,7 +33,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
-from ..utils import flight, metrics, profiler
+from ..utils import faults, flight, metrics, profiler
 from .session import Session, SessionClosed, executing
 
 # deficit credited to a backlogged session per sweep, in rows, before
@@ -49,18 +49,20 @@ class Ticket:
     """One schedulable request: closure + cost + settlement event."""
 
     __slots__ = (
-        "session", "fn", "cost", "label", "charge", "prof",
+        "session", "fn", "cost", "label", "charge", "prof", "token",
         "submit_t", "start_t", "end_t", "value", "error", "_event",
     )
 
     def __init__(self, session: Session, fn: Callable[[], object],
-                 cost: int, label: str, charge: int, prof=None):
+                 cost: int, label: str, charge: int, prof=None,
+                 token=None):
         self.session = session
         self.fn = fn
         self.cost = max(int(cost), 1)
         self.label = label
         self.charge = max(int(charge), 0)
         self.prof = prof
+        self.token = token  # faults.CancelToken or None
         self.submit_t = time.perf_counter()
         self.start_t: Optional[float] = None
         self.end_t: Optional[float] = None
@@ -166,13 +168,17 @@ class FairScheduler:
     # -- submission -------------------------------------------------------
     def submit(self, session: Session, fn: Callable[[], object],
                cost: int = 1, label: str = "req", charge: int = 0,
-               prof=None, shed: bool = True) -> Ticket:
+               prof=None, shed: bool = True, token=None) -> Ticket:
         """Queue one request. ``shed=True`` raises the typed
         :class:`Busy` when the session queue is at depth;
         ``shed=False`` (a stream's follow-on batches, whose in-flight
         window the server already bounds) waits for a slot instead —
-        executors always drain, so the wait terminates."""
-        t = Ticket(session, fn, cost, label, charge, prof)
+        executors always drain, so the wait terminates. ``token`` is
+        the request's :class:`faults.CancelToken`: the executor binds
+        it around the work (so between-segment / between-batch
+        checkpoints observe it) and settles an already-cancelled
+        ticket without running it at all."""
+        t = Ticket(session, fn, cost, label, charge, prof, token)
         with self._cv:
             while True:
                 if self._stopping:
@@ -255,13 +261,17 @@ class FairScheduler:
                 bounds=metrics.SPAN_MS_BOUNDS,
             )
             try:
-                with executing(sess, t), profiler.bound_session(t.prof):
+                if t.token is not None:
+                    t.token.check()  # cancelled while queued: never run
+                with executing(sess, t), profiler.bound_session(t.prof), \
+                        faults.scoped_token(t.token):
                     with metrics.span(
                         "serving." + t.label, session=sess.name
                     ):
                         t.value = t.fn()
             except BaseException as e:
                 t.error = e
+                faults.note_error_class(e, "serving." + t.label)
             t.end_t = time.perf_counter()
             with self._cv:
                 self._inflight[sess.id] = max(

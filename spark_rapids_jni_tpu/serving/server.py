@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import select
 import socket
 import threading
 import uuid
@@ -46,7 +47,7 @@ from collections import deque
 from typing import Optional
 
 from .. import pipeline, plan as plan_mod, runtime_bridge as rb
-from ..utils import config, flight, hbm, metrics, profiler
+from ..utils import config, faults, flight, hbm, metrics, profiler
 from . import frames
 from .scheduler import Busy, FairScheduler
 from .session import (
@@ -61,7 +62,14 @@ class SessionLimit(Exception):
     """Typed HELLO rejection: the daemon is at SERVE_MAX_SESSIONS."""
 
 
+# ordered most-specific first: the fault taxonomy entries must win
+# over any generic base class they might share
 _ERROR_TYPES = {
+    faults.Degraded: "degraded",
+    faults.Cancelled: "cancelled",
+    faults.DeadlineExceeded: "deadline_exceeded",
+    faults.ResourceExhausted: "resource_exhausted",
+    faults.TransientDeviceError: "transient_device",
     Busy: "busy",
     OverBudget: "over_budget",
     SessionLimit: "session_limit",
@@ -123,6 +131,12 @@ class Server:
         self.scheduler = FairScheduler(
             workers=workers, queue_depth=self.queue_depth
         )
+        # N consecutive transient failures flip the daemon to typed
+        # Degraded sheds; a background probe closes it again without
+        # waiting for client traffic (faults.CircuitBreaker)
+        self.breaker = faults.CircuitBreaker(name="serving")
+        self._probe_stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -144,6 +158,11 @@ class Server:
         )
         t.start()
         self._accept_thread = t
+        p = threading.Thread(
+            target=self._probe_loop, name="srt-serve-probe", daemon=True
+        )
+        p.start()
+        self._probe_thread = p
         if flight.enabled():
             flight.record("I", "serving.start", self.port)
         return self
@@ -158,6 +177,9 @@ class Server:
             self._stopping = True
             conns = list(self._conns)
             threads = list(self._conn_threads)
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=10)
         if self._listener is not None:
             # closing a listening socket does NOT wake a thread blocked
             # in accept() on Linux — poke it with a throwaway connection
@@ -218,6 +240,28 @@ class Server:
                 )
                 self._conn_threads.append(t)
             t.start()
+
+    def _probe_loop(self) -> None:
+        """Background half-open probing: while the breaker is OPEN,
+        periodically run one trivial device op so the daemon recovers
+        (closes the breaker) even with zero client traffic. Client
+        requests race for the same half-open slot; whoever wins is the
+        trial — the loser sheds typed Degraded as usual."""
+        interval = max(self.breaker.probe_interval_s / 4, 0.05)
+        while not self._probe_stop.wait(interval):
+            if self.breaker.state == faults.CLOSED:
+                continue
+            try:
+                if not self.breaker.allow():
+                    continue  # closed between the check and the call
+            except faults.Degraded:
+                continue  # probe interval not yet elapsed
+            try:
+                faults.default_probe()
+            except BaseException as e:
+                self.breaker.note_failure(e)
+            else:
+                self.breaker.note_success()
 
     def _handle_conn(self, sock: socket.socket) -> None:
         sess: Optional[Session] = None
@@ -280,6 +324,11 @@ class Server:
     def _attach(self, header) -> Session:
         sid = header.get("session")
         weight = float(header.get("weight", 1.0) or 1.0)
+        deadline_s = float(header.get("deadline_s") or 0.0)
+        if deadline_s < 0:
+            raise ValueError(
+                f"hello: deadline_s must be >= 0, got {deadline_s}"
+            )
         with self._lock:
             if sid is not None:
                 sess = self._sessions.get(sid)
@@ -288,6 +337,8 @@ class Server:
                         f"unknown or already-closed session {sid!r}"
                     )
                 sess.connections += 1
+                if deadline_s:
+                    sess.deadline_s = deadline_s
                 return sess
             if len(self._sessions) >= self.max_sessions:
                 raise SessionLimit(
@@ -300,6 +351,7 @@ class Server:
                 int(self.session_hbm_fraction * hbm.budget_bytes()), 1
             )
             sess = Session(new_id, name, weight, budget)
+            sess.deadline_s = deadline_s
             sess.connections = 1
             self._sessions[new_id] = sess
             self._sessions_served += 1
@@ -333,15 +385,30 @@ class Server:
             flight.record("I", "serving.session_close", sess.name)
 
     # -- request dispatch -------------------------------------------------
+    _DEVICE_CMDS = frozenset({"stream", "upload", "plan", "download"})
+
     def _dispatch(self, sock, sess, cmd, header, payload) -> None:
-        if cmd == "stream":
-            self._cmd_stream(sock, sess, header, payload)
-        elif cmd == "upload":
-            self._cmd_upload(sock, sess, header, payload)
-        elif cmd == "plan":
-            self._cmd_plan(sock, sess, header)
-        elif cmd == "download":
-            self._cmd_download(sock, sess, header)
+        if cmd in self._DEVICE_CMDS:
+            # breaker gate: an OPEN breaker sheds with typed Degraded
+            # before any device work; a True return marks this request
+            # as the half-open trial (the accounting below is the same
+            # either way)
+            self.breaker.allow()
+            try:
+                faults.inject("serve_accept")
+                err = self._cmd_device(sock, sess, cmd, header, payload)
+            except BaseException as e:
+                # socket errors are peer failures, not device health:
+                # a crashing client must never trip the breaker
+                if not isinstance(e, (ConnectionError, OSError)):
+                    self.breaker.note_failure(e)
+                raise
+            if err is not None:
+                # _cmd_stream answered the client itself; the breaker
+                # still needs to see the failure
+                self.breaker.note_failure(err)
+            else:
+                self.breaker.note_success()
         elif cmd == "free":
             nbytes = sess.free_table(header.get("table"))
             frames.send_frame(sock, {"ok": True, "bytes": nbytes})
@@ -352,6 +419,20 @@ class Server:
                 frames.ProtocolError(f"unknown command {cmd!r}")
             ))
 
+    def _cmd_device(self, sock, sess, cmd, header, payload):
+        """Route one device command. Returns the exception a handler
+        answered itself (stream sends its own error frame) or None —
+        the breaker accounting in :meth:`_dispatch` needs it."""
+        if cmd == "stream":
+            return self._cmd_stream(sock, sess, header, payload)
+        if cmd == "upload":
+            self._cmd_upload(sock, sess, header, payload)
+        elif cmd == "plan":
+            self._cmd_plan(sock, sess, header)
+        else:
+            self._cmd_download(sock, sess, header)
+        return None
+
     @staticmethod
     def _plan_ops(header) -> list:
         ops = header.get("plan")
@@ -359,12 +440,50 @@ class Server:
             raise TypeError("serving: plan must be a JSON list of ops")
         return ops
 
-    def _cmd_stream(self, sock, sess, header, payload) -> None:
+    def _request_token(self, header, sess) -> faults.CancelToken:
+        """Per-request cancellation token. Deadline precedence:
+        command header ``deadline_s`` > session hello ``deadline_s`` >
+        SPARK_RAPIDS_TPU_DEADLINE_DEFAULT_S; 0 anywhere means none."""
+        d = header.get("deadline_s")
+        if d is None:
+            d = sess.deadline_s or float(
+                config.get_flag("DEADLINE_DEFAULT_S")
+            )
+        d = float(d)
+        if d < 0:
+            raise ValueError(
+                f"serving: deadline_s must be >= 0, got {d}"
+            )
+        return faults.CancelToken(deadline_s=d if d > 0 else None)
+
+    @staticmethod
+    def _client_gone(sock) -> bool:
+        """Liveness poll while this conn thread is busy serving: a
+        readable socket whose peek returns no bytes is a closed or
+        reset peer (a pipelined next command peeks non-empty and is
+        NOT a disconnect)."""
+        try:
+            r, _, _ = select.select([sock], [], [], 0)
+            if not r:
+                return False
+            return sock.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _cmd_stream(self, sock, sess, header, payload):
         """The main entry: one plan over N inline batches, scheduled
         per batch (so a heavy stream interleaves with other tenants),
         answered in one frame, byte-identical to ``table_plan_wire``
-        / ``table_stream_wire`` run serially."""
+        / ``table_stream_wire`` run serially.
+
+        Returns the exception it answered with, or None on success
+        (breaker accounting). Every batch runs under the request's
+        :class:`faults.CancelToken`; between batches the conn thread
+        polls the socket, so a client that crashed mid-stream cancels
+        the remaining work at its next checkpoint instead of leaving
+        it running against a dead peer while holding HBM charge."""
         ops = self._plan_ops(header)
+        tok = self._request_token(header, sess)
         batches = frames.batches_from_parts(
             header.get("batches") or [], payload
         )
@@ -376,6 +495,20 @@ class Server:
         prof = scope.__enter__()
         results = [None] * n
         window: deque = deque()
+
+        def checkpoint():
+            if self._client_gone(sock):
+                tok.cancel("client disconnected mid-stream")
+                metrics.counter_add("serving.cancelled")
+                if flight.enabled():
+                    flight.record(
+                        "I", "serving.client_gone", sess.name
+                    )
+                raise ConnectionResetError(
+                    f"session {sess.name}: client gone mid-stream"
+                )
+            tok.check()
+
         try:
             if flight.enabled():
                 flight.record("I", "serving.stream", f"{sess.name}:{n}")
@@ -393,13 +526,14 @@ class Server:
                 return work
 
             for i, b in enumerate(batches):
+                checkpoint()
                 est = estimate_request_bytes(b)
                 sess.admit(est)  # typed OverBudget / queues on inflight
                 try:
                     t = self.scheduler.submit(
                         sess, make_work(b), cost=b[4],
                         label="stream", charge=est, prof=prof,
-                        shed=(i == 0),
+                        shed=(i == 0), token=tok,
                     )
                 except BaseException:
                     sess.release(est)
@@ -411,23 +545,36 @@ class Server:
                 while len(window) >= self.queue_depth:
                     j, tj = window.popleft()
                     results[j] = tj.result()
+                    checkpoint()
             while window:
                 j, tj = window.popleft()
                 results[j] = tj.result()
+                if window:
+                    # more results pending: a dead peer cancels them
+                    # instead of computing for nobody
+                    checkpoint()
         except BaseException as e:
             # drain stragglers before answering: their results are
-            # discarded but their budget charges must settle
+            # discarded but their budget charges must settle. The
+            # token is cancelled first so queued batches settle
+            # without running and in-flight ones abort at their next
+            # between-segment checkpoint
+            if not tok.cancelled:
+                tok.cancel(f"stream aborted: {type(e).__name__}")
             while window:
                 _, tj = window.popleft()
                 with contextlib.suppress(BaseException):
                     tj.result()
+            if isinstance(e, (ConnectionError, OSError)):
+                raise  # peer is gone: nobody to answer
             frames.send_frame(sock, _error_header(e))
-            return
+            return e
         finally:
             scope.__exit__(None, None, None)
         metas, buffers = frames.batches_to_parts(results)
         sess.stats["bytes_out"] += sum(len(b) for b in buffers)
         frames.send_frame(sock, {"ok": True, "results": metas}, buffers)
+        return None
 
     def _cmd_upload(self, sock, sess, header, payload) -> None:
         batch = frames.batches_from_parts(
@@ -453,6 +600,7 @@ class Server:
 
     def _cmd_plan(self, sock, sess, header) -> None:
         ops = self._plan_ops(header)
+        tok = self._request_token(header, sess)
         locals_ = [int(x) for x in (header.get("tables") or [])]
         if not locals_:
             raise ValueError("serving: plan needs at least one table id")
@@ -472,6 +620,7 @@ class Server:
                 sess,
                 lambda: rb.table_plan_resident(plan_json, rb_ids, donate),
                 cost=max(est // 64, 1), label="plan", charge=est,
+                token=tok,
             )
         except BaseException:
             sess.release(est)
@@ -511,6 +660,7 @@ class Server:
             "sessions_live": len(sessions),
             "sessions_served": served,
             "resident_tables": rb.resident_table_count(),
+            "breaker": self.breaker.to_doc(),
             "sessions": sessions,
         }
 

@@ -31,7 +31,7 @@ from collections import deque
 from typing import Dict, Optional, Tuple
 
 from .. import runtime_bridge as rb
-from ..utils import buckets, hbm, metrics
+from ..utils import buckets, faults, hbm, metrics
 
 
 class OverBudget(Exception):
@@ -69,6 +69,9 @@ class Session:
         self.name = name
         self.weight = max(float(weight), 1e-3)
         self.budget_bytes = int(budget_bytes)
+        # session-default request deadline (seconds) from the hello
+        # frame; per-command headers override, 0 means none
+        self.deadline_s = 0.0
         self.created = time.time()
         self.connections = 0
         self.closed = False
@@ -96,6 +99,7 @@ class Session:
         the budget minus the session's resident tables), and
         :class:`SessionClosed` if torn down while waiting."""
         est = max(int(estimate), 0)
+        faults.inject("hbm_admit")
         with self._cv:
             while True:
                 if self.closed:

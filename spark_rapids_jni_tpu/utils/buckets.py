@@ -380,6 +380,9 @@ def cached_jit(
         metrics.counter_add("compile_cache.hit")
         profiler.note_cache(True)
         return fn
+    from . import faults
+
+    faults.inject("compile")
     import jax
 
     raw = build()

@@ -94,6 +94,59 @@ def _parse_fraction(name: str):
     return parse
 
 
+def _parse_nonneg_int(name: str):
+    def parse(v: str) -> int:
+        try:
+            got = int(v.strip())
+        except ValueError:
+            raise ValueError(f"{name} must be an integer, got {v!r}")
+        if got < 0:
+            raise ValueError(f"{name} must be >= 0, got {v!r}")
+        return got
+
+    return parse
+
+
+def _parse_nonneg_float(name: str):
+    def parse(v: str) -> float:
+        try:
+            got = float(v.strip())
+        except ValueError:
+            raise ValueError(f"{name} must be a float, got {v!r}")
+        if got < 0.0:
+            raise ValueError(f"{name} must be >= 0, got {v!r}")
+        return got
+
+    return parse
+
+
+def _parse_positive_float(name: str):
+    def parse(v: str) -> float:
+        try:
+            got = float(v.strip())
+        except ValueError:
+            raise ValueError(f"{name} must be a float, got {v!r}")
+        if got <= 0.0:
+            raise ValueError(f"{name} must be > 0, got {v!r}")
+        return got
+
+    return parse
+
+
+def _parse_fault_spec(v: str) -> str:
+    """Validate a SPARK_RAPIDS_TPU_FAULTS plan
+    (``[seed=N,]site:kind:prob[:count],...``) at flag-read time so a
+    typo'd chaos plan fails loudly instead of silently injecting
+    nothing. The compiled (seeded) form lives in utils/faults.py; the
+    site and kind vocabularies are declared there."""
+    from . import faults
+
+    spec = v.strip()
+    if spec:
+        faults.parse_spec(spec)  # raises ValueError naming the env var
+    return spec
+
+
 @dataclasses.dataclass(frozen=True)
 class Flag:
     name: str
@@ -212,6 +265,46 @@ _FLAGS = {
             _parse_positive_int("SERVE_QUEUE_DEPTH"),
             "serving daemon per-session scheduler queue depth; a "
             "request past it is shed with a typed BUSY response",
+        ),
+        Flag(
+            "FAULTS", "", _parse_fault_spec,
+            "deterministic fault-injection plan (utils/faults.py): "
+            "'[seed=N,]site:kind:prob[:count],...' — site in "
+            "dispatch|compile|serde|hbm_admit|serve_accept, kind in "
+            "transient|oom|permanent, prob in [0,1], count = max "
+            "injections (0/absent = unlimited); '' (default) = off",
+        ),
+        Flag(
+            "RETRY_MAX", 3, _parse_nonneg_int("RETRY_MAX"),
+            "max retries for a transient-classified failure at one "
+            "dispatch/segment boundary (utils/faults.py); 0 disables "
+            "retry, surfacing the typed error on the first failure",
+        ),
+        Flag(
+            "RETRY_BASE_MS", 25.0,
+            _parse_positive_float("RETRY_BASE_MS"),
+            "base backoff for transient retries in milliseconds; "
+            "attempt N sleeps ~base*2^(N-1) with deterministic jitter",
+        ),
+        Flag(
+            "DEADLINE_DEFAULT_S", 0.0,
+            _parse_nonneg_float("DEADLINE_DEFAULT_S"),
+            "default per-request deadline in seconds for served "
+            "requests whose hello/command frames carry none; 0 "
+            "(default) = no deadline",
+        ),
+        Flag(
+            "BREAKER_THRESHOLD", 5,
+            _parse_positive_int("BREAKER_THRESHOLD"),
+            "serving circuit breaker: consecutive transient-classified "
+            "failures before the daemon flips to the typed Degraded "
+            "shed state",
+        ),
+        Flag(
+            "BREAKER_PROBE_S", 1.0,
+            _parse_positive_float("BREAKER_PROBE_S"),
+            "serving circuit breaker: seconds an OPEN breaker waits "
+            "before letting one half-open probe through",
         ),
     ]
 }

@@ -440,8 +440,10 @@ class TestBenchFailureRecords:
         assert r["failure"] == {
             "type": "ValueError",
             "message": "boom",
+            "class": "PermanentError",
             "elapsed_s": 1.234,
             "retries": 2,
+            "backoff_ms": 0.0,
             "skipped": False,
         }
         json.dumps(r)

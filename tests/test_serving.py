@@ -427,6 +427,45 @@ def test_crash_disconnect_mid_stream_leaks_zero_tables():
     assert rb.leak_report() == []
 
 
+def test_crash_disconnect_cancels_inflight_stream_promptly():
+    """ISSUE-10 satellite bugfix: a client crash mid-stream used to
+    leave the whole request running against the dead socket — every
+    queued batch still executed while holding the session's in-flight
+    HBM charge. The conn thread now polls peer liveness between batch
+    results, cancels the request's token (``serving.cancelled``), and
+    the remaining queued batches settle WITHOUT running."""
+    config.set_flag("METRICS", "1")
+    # a chain/shape combination no other test compiles, so the first
+    # batches are guaranteed still in flight when the kill lands
+    chain = [
+        {"op": "cast", "column": 1, "type_id": int(dt.TypeId.FLOAT64)},
+        {"op": "sort_by", "keys": [{"column": 1}, {"column": 0}]},
+        {"op": "distinct", "keys": [0]},
+    ]
+    batches = [_batch(30_000, seed=s) for s in range(12)]
+    with serving.serve(queue_depth=4) as srv:
+        c = serving.Client(srv.port, name="crash-cancel").connect()
+        from spark_rapids_jni_tpu.serving import frames
+
+        metas, buffers = frames.batches_to_parts(batches)
+        frames.send_frame(
+            c._sock,
+            {"cmd": "stream", "plan": chain, "batches": metas},
+            buffers,
+        )
+        time.sleep(0.15)  # well inside the first bucket's compile
+        c.kill()
+        # prompt teardown: the cancelled stream must not run its 12
+        # batches to completion first
+        assert _wait_until(
+            lambda: srv.stats()["sessions_live"] == 0, timeout=60
+        )
+        assert _wait_until(lambda: rb.resident_table_count() == 0)
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("serving.cancelled", 0) >= 1
+    assert rb.leak_report() == []
+
+
 def test_server_stop_tears_down_live_sessions():
     srv = serving.Server().start()
     c = serving.Client(srv.port, name="leftover").connect()

@@ -508,20 +508,34 @@ def summarize_profile(raw: list, top: int = 8) -> None:
 
 
 def summarize_failures(raw: list) -> None:
-    """Print the structured failure records (diagnosable-from-JSON)."""
+    """Print the structured failure records (diagnosable-from-JSON),
+    grouped headline-first by taxonomy class. Old result files predate
+    the ``class``/``backoff_ms`` fields — they render as
+    ``unclassified`` / no backoff note rather than erroring."""
     fails = [e for e in raw if isinstance(e.get("failure"), dict)]
     if not fails:
         return
-    print("\nfailures:")
+    by_class: dict = {}
+    for e in fails:
+        cls = e["failure"].get("class") or "unclassified"
+        by_class[cls] = by_class.get(cls, 0) + 1
+    classes = ", ".join(
+        f"{c}={n}" for c, n in sorted(by_class.items())
+    )
+    print(f"\nfailures ({len(fails)} total: {classes}):")
     for e in fails:
         f = e["failure"]
         extra = []
+        if f.get("class"):
+            extra.append(f["class"])
         if f.get("skipped"):
             extra.append("skipped")
         if f.get("elapsed_s") is not None:
             extra.append(f"after {f['elapsed_s']}s")
         if f.get("retries"):
             extra.append(f"{f['retries']} retries")
+        if f.get("backoff_ms"):
+            extra.append(f"{f['backoff_ms']}ms backoff")
         tail = f" ({', '.join(extra)})" if extra else ""
         print(
             f"  {e.get('name', '?'):32} {f.get('type', 'Error')}: "
