@@ -57,5 +57,11 @@ bash ci/smoke-observability.sh
 # trip and re-close via the background probe, and zero tables may leak.
 bash ci/smoke-chaos.sh
 
+# Spill smoke: a served stream with a device working set ~2x the
+# (shrunk) HBM budget must complete byte-identical by spilling cold
+# tables host->disk (zero sheds), re-promote them on re-access, and
+# leak zero tables and zero spill files.
+bash ci/smoke-spill.sh
+
 # Bench smoke on whatever device this node has.
 python3 bench.py

@@ -500,6 +500,60 @@ def drain() -> None:
         p._slots.release()
 
 
+# ---------------------------------------------------------------------------
+# dedicated IO lane: spill writes/restores (utils/spill.py)
+#
+# Spill IO must overlap compute WITHOUT competing for the dispatch
+# pool's depth slots: an eviction triggered from inside a pool job that
+# then blocked on the pool's own backpressure semaphore would deadlock
+# at depth 1 (the only slot is held by the job doing the evicting), and
+# spill traffic should never consume the stream's backpressure budget.
+# One FIFO worker thread, created lazily, independent of the PIPELINE
+# flag — disk writes overlap compute even when dispatch is synchronous.
+# ---------------------------------------------------------------------------
+
+_IO_Q: "queue.SimpleQueue" = queue.SimpleQueue()
+_IO_LOCK = threading.Lock()
+_IO_THREAD: Optional[threading.Thread] = None
+
+
+def _io_loop() -> None:
+    while True:
+        item = _IO_Q.get()
+        if item is None:
+            return
+        item._run()
+
+
+def submit_io(
+    work: Callable[[], object], label: str, replayable: bool = True
+) -> Pending:
+    """Enqueue host-side I/O work on the dedicated IO worker; returns
+    its Pending (same sync-replay error contract as pool stages —
+    failures surface at ``resolve``)."""
+    global _IO_THREAD
+    p = Pending(work, label, replayable=replayable)
+    with _IO_LOCK:
+        if _IO_THREAD is None or not _IO_THREAD.is_alive():
+            _IO_THREAD = threading.Thread(
+                target=_io_loop, name="srt-io", daemon=True
+            )
+            _IO_THREAD.start()
+    metrics.counter_add("pipeline.io_enqueued")
+    _IO_Q.put(p)
+    return p
+
+
+def drain_io() -> None:
+    """Block until every queued IO job has finished (test isolation):
+    the lane is FIFO, so a no-op fence job is a barrier."""
+    with _IO_LOCK:
+        t = _IO_THREAD
+    if t is None or not t.is_alive():
+        return
+    submit_io(lambda: None, "io.fence").wait_settled()
+
+
 def run_stream(
     items: Sequence,
     decode: Callable,

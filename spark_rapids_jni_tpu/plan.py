@@ -363,7 +363,11 @@ def _run_fused_tolerant(
     * a donated launch that already CONSUMED its input is at-most-once
       (PR 5's doomed-replay rule): its error surfaces as-is, no retry;
     * a ResourceExhausted-classified failure with the input intact
-      retries at half-batch chunks first (row-local segments only);
+      first asks the spill tier for headroom (utils/spill.py: the
+      coldest resident tables demote to host/disk) and retries the
+      SAME launch — degrade by moving cold data, not by splitting hot
+      work; only when nothing could spill does it retry at half-batch
+      chunks (row-local segments only);
     * a transient-classified failure retries the whole segment with
       backoff up to RETRY_MAX (the injection fires BEFORE the launch
       consumes anything, so an injected retry is always safe);
@@ -372,6 +376,7 @@ def _run_fused_tolerant(
     from . import bucketed
 
     attempt = 0
+    spill_tried = False
     while True:
         faults.check_cancel()
         try:
@@ -388,6 +393,21 @@ def _run_fused_tolerant(
                 # buffers — the worker error is authoritative
                 raise
             cls = faults.classify(e)
+            if cls is faults.ResourceExhausted and not spill_tried:
+                # OOM ladder rung 1: free headroom by spilling cold
+                # resident tables, then retry the SAME shape. 2x the
+                # input sizes the launch's input + output residency.
+                spill_tried = True
+                from .utils import hbm, spill
+
+                freed = spill.request_headroom(
+                    2 * hbm.table_bytes(table), reason="oom"
+                )
+                if freed:
+                    metrics.counter_add("plan.oom_spill_retries")
+                    if flight.enabled():
+                        flight.record("I", "plan.oom_spill_retry", freed)
+                    continue
             if cls is faults.ResourceExhausted and all(
                 o.get("op") in _ROW_LOCAL for o in seg_ops
             ):

@@ -39,7 +39,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 from . import dtype as dt
 from . import pipeline
 from .column import Column, Table
-from .utils import buckets, faults, flight, log, metrics, profiler
+from .utils import buckets, faults, flight, log, metrics, profiler, spill
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -847,14 +847,20 @@ def _unknown_id_error(table_id, live: int) -> KeyError:
 
 
 def _resident_peek(table_id: int):
-    """Registry entry for ``table_id`` WITHOUT resolving: a Table, or a
-    ``pipeline.Pending`` still computing. Raises the labeled KeyError
+    """Registry entry for ``table_id`` WITHOUT resolving a pending: a
+    Table, or a ``pipeline.Pending`` still computing. A SPILLED entry
+    (utils/spill.py) transparently repages back to the device here —
+    access is what promotes a cold table. Raises the labeled KeyError
     on a miss."""
     with _RESIDENT_LOCK:
         t = _RESIDENT.get(int(table_id))
         live = len(_RESIDENT)
+        if isinstance(t, spill.SpilledTable):
+            t = spill.repage_locked(int(table_id))
     if t is None:
         raise _unknown_id_error(table_id, live)
+    spill.flush_events()
+    spill.touch(int(table_id))
     return t
 
 
@@ -871,6 +877,7 @@ def _resident_get(table_id: int) -> Table:
             # (unless the id was freed while we waited)
             if int(table_id) in _RESIDENT:
                 _RESIDENT[int(table_id)] = t
+        spill.note_put(int(table_id), t)
     metrics.counter_add("resident.get")
     return t
 
@@ -911,6 +918,10 @@ def _resident_put(t) -> int:
     metrics.gauge_set("resident.live", live)
     if flight.enabled():
         flight.record("C", "resident.live", live)
+    if not is_pending:
+        # spill tracking + proactive pressure: a put that carries the
+        # device tier past the HBM budget evicts the coldest entries
+        spill.note_put(tid, t)
     return tid
 
 
@@ -943,7 +954,8 @@ _RESIDENT_READERS: dict = {}
 
 
 def _capture_inputs(
-    table_ids: Sequence[int], donate: bool, reader=None
+    table_ids: Sequence[int], donate: bool, reader=None,
+    pin: bool = False,
 ) -> tuple:
     """Atomically snapshot the input entries at CALL time (Tables or
     Pendings) -> ``(inputs, donate_barrier)``.
@@ -961,7 +973,14 @@ def _capture_inputs(
     donate-consume of the same id therefore either sees this reader in
     its barrier or ordered itself first (in which case THIS capture
     fails with the labeled KeyError) — there is no window where a
-    reader runs unprotected."""
+    reader runs unprotected.
+
+    Spilled inputs repage inside the same lock hold, so the captured
+    objects are always device Tables (or Pendings). ``pin=True``
+    additionally pins the non-donated ids against eviction atomically
+    with the capture — the SYNCHRONOUS dispatch paths use it (no
+    reader Pending exists there to make the eviction check see them);
+    the caller must ``spill.unpin_ids`` the same ids when done."""
     ids = [int(t) for t in table_ids]
     took = False
     with _RESIDENT_LOCK:
@@ -969,11 +988,17 @@ def _capture_inputs(
         for t in ids:
             if t not in _RESIDENT:
                 raise _unknown_id_error(t, live)
-        objs = [_RESIDENT[t] for t in ids]
+        objs = []
+        for t in ids:
+            o = _RESIDENT[t]
+            if isinstance(o, spill.SpilledTable):
+                o = spill.repage_locked(t)
+            objs.append(o)
         barrier = []
         if donate:
             _RESIDENT.pop(ids[0])
             _RESIDENT_META.pop(ids[0], None)
+            spill.note_free(ids[0])
             barrier = [
                 p for p in _RESIDENT_READERS.pop(ids[0], ())
                 if not p.done()
@@ -985,6 +1010,11 @@ def _capture_inputs(
                 lst = _RESIDENT_READERS.setdefault(t, [])
                 lst[:] = [p for p in lst if not p.done()]
                 lst.append(reader)
+        if pin:
+            spill.pin_ids(ids[1:] if donate else ids)
+        for t in (ids[1:] if donate else ids):
+            spill.touch(t)
+    spill.flush_events()
     metrics.counter_add("resident.get", len(ids))
     if took:
         log.log("DEBUG", "handles", "resident_take", table_id=ids[0],
@@ -1062,9 +1092,14 @@ def table_op_resident(
             table_ids, donate, reader=pending
         )
         return _resident_put(pipeline.enqueue(pending))
-    inputs, barrier = _capture_inputs(table_ids, donate)
-    return _resident_put(_run_resident_op(op, inputs, donate, name,
-                                          barrier))
+    # synchronous path: pin the surviving inputs for the dispatch (no
+    # reader Pending exists here for the eviction check to see)
+    inputs, barrier = _capture_inputs(table_ids, donate, pin=True)
+    try:
+        return _resident_put(_run_resident_op(op, inputs, donate, name,
+                                              barrier))
+    finally:
+        spill.unpin_ids(table_ids[1:] if donate else table_ids)
 
 
 def table_plan_resident(
@@ -1109,8 +1144,13 @@ def table_plan_resident(
             table_ids, donate, reader=pending
         )
         return _resident_put(pipeline.enqueue(pending))
-    cell["inputs"], cell["barrier"] = _capture_inputs(table_ids, donate)
-    return _resident_put(work())
+    cell["inputs"], cell["barrier"] = _capture_inputs(
+        table_ids, donate, pin=True
+    )
+    try:
+        return _resident_put(work())
+    finally:
+        spill.unpin_ids(table_ids[1:] if donate else table_ids)
 
 
 # table id -> count of table_download_wire serializers currently
@@ -1134,11 +1174,15 @@ def table_download_wire(table_id: int):
     tid = int(table_id)
     with _RESIDENT_LOCK:
         t = _RESIDENT.get(tid)
+        if isinstance(t, spill.SpilledTable):
+            t = spill.repage_locked(tid)
         live = len(_RESIDENT)
         if t is not None:
             _RESIDENT_ACTIVE_READS[tid] = (
                 _RESIDENT_ACTIVE_READS.get(tid, 0) + 1
             )
+            spill.touch(tid)
+    spill.flush_events()
     if t is None:
         raise _unknown_id_error(tid, live)
     try:
@@ -1183,6 +1227,9 @@ def table_free(table_id: int) -> None:
         live = len(_RESIDENT)
     if gone:
         raise _unknown_id_error(table_id, live)
+    # drops spill tracking; for a spilled entry this also releases the
+    # host/disk backing (no orphaned spill files)
+    spill.note_free(int(table_id), t)
     if isinstance(t, pipeline.Pending):
         if not any(not p.done() for p in readers):
             # fire-and-forget: nothing downstream captured this handle
@@ -1252,6 +1299,19 @@ def table_reclaim(table_id: int) -> int:
         # replayable) reader would dereference the buffers we are about
         # to delete — run it to terminal settlement NOW
         p.settle_terminally()
+    if isinstance(t, spill.SpilledTable):
+        # already off the device: release the host/disk backing and
+        # credit the device bytes the table would have re-occupied
+        nbytes = spill.note_free(tid, t)
+        metrics.counter_add("resident.free")
+        metrics.bytes_add("resident.reclaimed_bytes", nbytes)
+        metrics.gauge_set("resident.live", live)
+        if flight.enabled():
+            flight.record("C", "resident.live", live)
+        log.log("DEBUG", "handles", "table_reclaim", table_id=tid,
+                live=live, nbytes=nbytes)
+        return nbytes
+    spill.note_free(tid)
     if isinstance(t, pipeline.Pending):
         t.orphan()  # no blocking point remains for this handle
         t.wait_settled()
@@ -1293,6 +1353,8 @@ def table_reclaim(table_id: int) -> int:
             o = o.value_nowait()
             if o is None:
                 continue
+        if isinstance(o, spill.SpilledTable):
+            continue  # holds no device buffers
         for c in o.columns:
             for a in _column_device_arrays(c):
                 shared.add(id(a))
@@ -1343,22 +1405,32 @@ def leak_report() -> list:
             settled = t.value_nowait()
             if settled is not None:
                 t, pending = settled, False
-        logical = None if pending else int(t.logical_row_count)
+        spilled = isinstance(t, spill.SpilledTable)
+        if spilled:
+            logical = int(t.rows)
+        else:
+            logical = None if pending else int(t.logical_row_count)
         rec = {
             "table_id": tid,
             "rows": logical,
             "logical_rows": logical,
-            "columns": None if pending else len(t.columns),
+            "columns": t.num_columns if spilled
+            else (None if pending else len(t.columns)),
             "allocated_under": meta.get("allocated_under", []),
         }
         if pending:
             rec["pending"] = t.label
+        if spilled:
+            # a spilled leak holds host RAM or a disk file, not HBM —
+            # say which tier so the postmortem reads correctly
+            rec["residency"] = t.state
+            rec["approx_bytes"] = int(t.nbytes)
         if meta.get("session"):
             rec["session"] = meta["session"]
         anchor = meta.get("age_anchor_ns")
         if anchor is not None:
             rec["age_s"] = round((now - anchor) / 1e9, 3)
-        if not pending:
+        if not pending and not spilled:
             try:
                 from .utils import hbm
 
@@ -1399,3 +1471,8 @@ def _leak_report_at_exit() -> None:  # pragma: no cover - atexit path
 atexit.register(_leak_report_at_exit)
 # the flight dump carries the same record, so a postmortem reads one file
 flight.register_exit_section("resident_leaks", leak_report)
+# the spill tier operates UNDER this registry's lock: one lock decides
+# eviction vs capture vs reclaim ordering (utils/spill.py)
+spill.bind_registry(
+    _RESIDENT_LOCK, _RESIDENT, _RESIDENT_READERS, _RESIDENT_ACTIVE_READS
+)

@@ -47,7 +47,7 @@ from collections import deque
 from typing import Optional
 
 from .. import pipeline, plan as plan_mod, runtime_bridge as rb
-from ..utils import config, faults, flight, hbm, metrics, profiler
+from ..utils import config, faults, flight, hbm, metrics, profiler, spill
 from . import frames
 from .scheduler import Busy, FairScheduler
 from .session import (
@@ -660,6 +660,7 @@ class Server:
             "sessions_live": len(sessions),
             "sessions_served": served,
             "resident_tables": rb.resident_table_count(),
+            "spill": spill.stats_doc(),
             "breaker": self.breaker.to_doc(),
             "sessions": sessions,
         }

@@ -31,7 +31,16 @@ from collections import deque
 from typing import Dict, Optional, Tuple
 
 from .. import runtime_bridge as rb
-from ..utils import buckets, faults, hbm, metrics
+from ..utils import buckets, faults, hbm, metrics, spill
+
+# Global reverse map rb_id -> (owning session, charged bytes): the spill
+# tier's residency events carry rb ids, and the owning session credits /
+# re-charges its budget from them (listener below). Guarded by its own
+# lock — never taken while a Session lock is held, only inside the
+# deferred-event flush (spill.flush_events) and the table bookkeeping
+# paths, so there is no ordering against Session._cv to get wrong.
+_OWNERS_LOCK = threading.Lock()
+_RB_OWNERS: Dict[int, Tuple["Session", int]] = {}
 
 
 class OverBudget(Exception):
@@ -81,6 +90,8 @@ class Session:
         self._next_local = itertools.count(1)
         self._resident_bytes = 0
         self._inflight_bytes = 0
+        self._spilled_bytes = 0         # charged bytes currently off-device
+        self._spilled_rb: set = set()   # rb ids of ours that are spilled
         self._waits = deque(maxlen=4096)  # queue-wait seconds
         self.stats = {
             "requests": 0,
@@ -100,13 +111,39 @@ class Session:
         :class:`SessionClosed` if torn down while waiting."""
         est = max(int(estimate), 0)
         faults.inject("hbm_admit")
-        with self._cv:
-            while True:
+        while True:
+            with self._cv:
                 if self.closed:
                     raise SessionClosed(
                         f"session {self.name} closed while admitting"
                     )
                 hard_remaining = self.budget_bytes - self._resident_bytes
+                free = hard_remaining - self._inflight_bytes
+                if est <= free:
+                    self._inflight_bytes += est
+                    return est
+                deficit = est - max(
+                    hard_remaining if est > hard_remaining else free, 0
+                )
+            # Blocked: before shedding or queueing, ask the spill tier
+            # to demote the coldest resident tables (any session's —
+            # global LRU) OUTSIDE the session lock. A freed victim of
+            # OURS credits _resident_bytes via the residency listener;
+            # re-evaluate either way. Terminates: each round either
+            # evicts something (the evictable set strictly shrinks) or
+            # frees nothing and falls through to the shed/queue verdict.
+            if spill.request_headroom(deficit, reason="admit"):
+                metrics.counter_add("serving.admit_spills")
+                continue
+            with self._cv:
+                if self.closed:
+                    raise SessionClosed(
+                        f"session {self.name} closed while admitting"
+                    )
+                hard_remaining = self.budget_bytes - self._resident_bytes
+                if est <= hard_remaining - self._inflight_bytes:
+                    self._inflight_bytes += est
+                    return est
                 if est > hard_remaining:
                     self.stats["over_budget"] += 1
                     metrics.counter_add("serving.over_budget")
@@ -116,9 +153,6 @@ class Session:
                         f"(session budget {self.budget_bytes} B, "
                         f"resident {self._resident_bytes} B)"
                     )
-                if est <= hard_remaining - self._inflight_bytes:
-                    self._inflight_bytes += est
-                    return est
                 if not wait:
                     self.stats["over_budget"] += 1
                     metrics.counter_add("serving.over_budget")
@@ -173,7 +207,40 @@ class Session:
             local = next(self._next_local)
             self._tables[local] = (int(rb_id), int(nbytes))
             self._resident_bytes += int(nbytes)
+        with _OWNERS_LOCK:
+            _RB_OWNERS[int(rb_id)] = (self, int(nbytes))
         return local
+
+    def _note_residency(self, event: str, rb_id: int,
+                        charged: int) -> None:
+        """Spill credit (residency listener): a table of ours that left
+        the device tier stops counting against the session HBM budget —
+        that is WHY admission spills instead of shedding — and
+        re-charges when a repage brings it back."""
+        with self._cv:
+            if event == "out":
+                if rb_id in self._spilled_rb:
+                    return
+                self._spilled_rb.add(rb_id)
+                self._spilled_bytes += charged
+                self._resident_bytes = max(
+                    self._resident_bytes - charged, 0
+                )
+                self._cv.notify_all()
+            else:
+                if rb_id not in self._spilled_rb:
+                    return
+                self._spilled_rb.discard(rb_id)
+                self._spilled_bytes = max(
+                    self._spilled_bytes - charged, 0
+                )
+                self._resident_bytes += charged
+
+    def _forget_owner(self, ent) -> None:
+        """Drop the reverse-owner entry for a (rb_id, bytes) table
+        entry leaving this session (no further residency credits)."""
+        with _OWNERS_LOCK:
+            _RB_OWNERS.pop(ent[0], None)
 
     def rb_id(self, local_id: int) -> int:
         """Global resident id for a session-local id; labeled KeyError
@@ -184,16 +251,25 @@ class Session:
             raise self._unknown_local_error(local_id)
         return ent[0]
 
+    def _uncharge_locked(self, ent) -> None:
+        """Remove a departing table's budget charge — from the spill
+        credit when it is currently off-device, from resident otherwise."""
+        if ent[0] in self._spilled_rb:
+            self._spilled_rb.discard(ent[0])
+            self._spilled_bytes = max(self._spilled_bytes - ent[1], 0)
+        else:
+            self._resident_bytes = max(self._resident_bytes - ent[1], 0)
+
     def drop_local(self, local_id: int) -> None:
         """Forget a local id whose global table was CONSUMED (donated
         into a plan) — no reclaim, the bytes moved into the result."""
         with self._cv:
             ent = self._tables.pop(int(local_id), None)
             if ent is not None:
-                self._resident_bytes = max(
-                    self._resident_bytes - ent[1], 0
-                )
+                self._uncharge_locked(ent)
                 self._cv.notify_all()
+        if ent is not None:
+            self._forget_owner(ent)
 
     def free_table(self, local_id: int) -> int:
         """Reclaim one table's HBM now (donate-barrier-settling free);
@@ -201,12 +277,11 @@ class Session:
         with self._cv:
             ent = self._tables.pop(int(local_id), None)
             if ent is not None:
-                self._resident_bytes = max(
-                    self._resident_bytes - ent[1], 0
-                )
+                self._uncharge_locked(ent)
                 self._cv.notify_all()
         if ent is None:
             raise self._unknown_local_error(local_id)
+        self._forget_owner(ent)
         try:
             return rb.table_reclaim(ent[0])
         except KeyError:
@@ -251,6 +326,8 @@ class Session:
                 "budget_bytes": self.budget_bytes,
                 "resident_bytes": self._resident_bytes,
                 "inflight_bytes": self._inflight_bytes,
+                "spilled_bytes": self._spilled_bytes,
+                "spilled_tables": len(self._spilled_rb),
                 "tables": len(self._tables),
                 "connections": self.connections,
                 **dict(self.stats),
@@ -269,7 +346,12 @@ class Session:
             tables = list(self._tables.values())
             self._tables.clear()
             self._resident_bytes = 0
+            self._spilled_bytes = 0
+            self._spilled_rb.clear()
             self._cv.notify_all()
+        with _OWNERS_LOCK:
+            for rb_id, _ in tables:
+                _RB_OWNERS.pop(rb_id, None)
         reclaimed = 0
         for rb_id, _ in tables:
             try:
@@ -314,3 +396,19 @@ def _donation_listener(nbytes: int) -> None:
 
 
 hbm.register_donation_listener(_donation_listener)
+
+
+def _residency_listener(event: str, rb_id: int, nbytes: int) -> None:
+    """Spill residency events -> session budget credit. Fired from
+    spill.flush_events with NO registry lock held (deferred queue), so
+    taking the owning session's lock here cannot invert against the
+    teardown path that holds a session lock while reclaiming."""
+    with _OWNERS_LOCK:
+        ent = _RB_OWNERS.get(int(rb_id))
+    if ent is None:
+        return  # not a serving-owned table (library embedder)
+    sess, charged = ent
+    sess._note_residency(event, int(rb_id), charged)
+
+
+spill.register_residency_listener(_residency_listener)

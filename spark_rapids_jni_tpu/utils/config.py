@@ -270,9 +270,31 @@ _FLAGS = {
             "FAULTS", "", _parse_fault_spec,
             "deterministic fault-injection plan (utils/faults.py): "
             "'[seed=N,]site:kind:prob[:count],...' — site in "
-            "dispatch|compile|serde|hbm_admit|serve_accept, kind in "
+            "dispatch|compile|serde|hbm_admit|serve_accept|spill, kind in "
             "transient|oom|permanent, prob in [0,1], count = max "
             "injections (0/absent = unlimited); '' (default) = off",
+        ),
+        Flag(
+            "SPILL", False, _as_bool,
+            "tiered memory hierarchy (utils/spill.py): on = resident "
+            "tables gain a device|host|disk residency state with "
+            "LRU-by-last-touch eviction under HBM pressure and "
+            "transparent repage-on-access, so admission and OOM degrade "
+            "to slower instead of shedding; off (default) costs one "
+            "cached generation compare per registry access",
+        ),
+        Flag(
+            "SPILL_DIR", "", str,
+            "directory for disk-tier spill files (utils/spill.py); '' "
+            "(default) = a per-process directory under the system temp "
+            "dir; files this process wrote are swept at exit either way",
+        ),
+        Flag(
+            "HOST_SPILL_BUDGET_GB", 4.0,
+            _parse_nonneg_float("HOST_SPILL_BUDGET_GB"),
+            "host-RAM spill tier budget in GiB (utils/spill.py); past "
+            "it the coldest host entries demote to the disk tier; 0 = "
+            "skip the host tier and spill straight to disk",
         ),
         Flag(
             "RETRY_MAX", 3, _parse_nonneg_int("RETRY_MAX"),

@@ -155,7 +155,9 @@ def retryable_class(cls: type) -> bool:
 #   serde        runtime_bridge._table_from_wire / _table_to_wire
 #   hbm_admit    serving session.Session.admit (HBM budget admission)
 #   serve_accept serving server._dispatch (per-command accept point)
-SITES = ("dispatch", "compile", "serde", "hbm_admit", "serve_accept")
+#   spill        utils/spill.py eviction copy-out + repage upload
+SITES = ("dispatch", "compile", "serde", "hbm_admit", "serve_accept",
+         "spill")
 
 KINDS = ("transient", "oom", "permanent")
 

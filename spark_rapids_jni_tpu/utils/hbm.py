@@ -145,10 +145,28 @@ def note_donation(nbytes: int) -> None:
         flight.record("C", "hbm.donated_bytes", total)
 
 
+# Pressure listeners: the spill tier (utils/spill.py) registers one so
+# a plan that does NOT fit the budget frees the deficit (coldest
+# resident tables demote to host/disk) BEFORE the launch OOMs. Fired
+# unconditionally — eviction can't depend on a telemetry flag — with
+# the byte deficit; listeners gate themselves and must not raise.
+_PRESSURE_LISTENERS: list = []
+
+
+def register_pressure_listener(fn) -> None:
+    """Register ``fn(deficit_bytes)`` to observe every over-budget plan."""
+    if fn not in _PRESSURE_LISTENERS:
+        _PRESSURE_LISTENERS.append(fn)
+
+
 def _record_plan(kind: str, plan: dict, planned_bytes: int) -> None:
     """Plan-vs-budget decisions on the metrics plane: how many plans ran,
     how many bytes they committed, and how often a shape failed to fit
     (the spill/chunk trigger)."""
+    if not plan["fits"]:
+        deficit = max(planned_bytes - plan["budget_bytes"], 1)
+        for fn in tuple(_PRESSURE_LISTENERS):
+            fn(deficit)
     if not metrics.enabled():
         return
     metrics.counter_add("hbm.plan." + kind)

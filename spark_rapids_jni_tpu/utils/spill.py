@@ -1,0 +1,764 @@
+"""Tiered memory hierarchy: HBM -> host RAM -> disk spill.
+
+The reference survives memory pressure because RMM pools plus the
+plugin's spill framework (RapidsBufferCatalog and its device/host/disk
+buffer stores) let a Spark task degrade to SLOWER instead of dying.
+Our fault plane classifies ``ResourceExhausted`` and chunk-replays
+row-local segments (utils/faults.py, plan.py), and the serving daemon
+sheds with typed ``OverBudget``/``Busy`` (serving/) — but until this
+module nothing ever moved a cold buffer off the device, so a tenant
+over budget was rejected and a working set larger than HBM died.
+
+Design:
+
+* Every device-resident table (runtime_bridge registry) has a residency
+  state: ``device`` (a live Table), ``host`` (numpy copies of its
+  storage buffers), or ``disk`` (an .npz file under ``SPILL_DIR``).
+  Storage buffers round-trip EXACTLY (FLOAT64 is already stored as its
+  uint64 bit pattern — column.storage_host_view), so spill/repage is
+  byte-identical by construction.
+* Eviction is LRU by last touch: every registry access stamps a
+  monotonic clock; pressure picks the coldest UNREFERENCED tables.
+  "Referenced" reuses the registry's own in-flight accounting — a
+  table with live pipelined readers (``_RESIDENT_READERS``), active
+  wire downloads (``_RESIDENT_ACTIVE_READS``), or an explicit pin
+  (sync dispatch paths) is never evicted: the pin wins.
+* Pressure sources: serving admission about to shed (session.admit),
+  a dispatch raising typed ``ResourceExhausted`` (plan.py's OOM ladder
+  rung 1), an hbm plan that does not fit (hbm pressure listeners), and
+  proactive demotion when the tracked device tier passes
+  ``hbm.budget_bytes()`` on a new put.
+* Host tier is bounded by ``HOST_SPILL_BUDGET_GB``; past it the
+  coldest host entries demote to disk, with the file write offloaded
+  to the pipeline's dedicated IO worker (pipeline.submit_io) so
+  compute overlaps eviction. Repage resolves any pending write first.
+* Observability by construction: ``spill.bytes_{out,in}`` /
+  ``spill.evictions`` / ``spill.demotions`` counters, per-tier byte
+  gauges with high-water marks, flight instants for every
+  eviction/repage, and repage stalls attributed to the profiler's
+  stall channel (utils/profiler.note_stall).
+
+Flag plane: ``SPARK_RAPIDS_TPU_SPILL`` (off by default — the shipped
+path costs one cached generation compare per registry access),
+``SPARK_RAPIDS_TPU_SPILL_DIR``, ``SPARK_RAPIDS_TPU_HOST_SPILL_BUDGET_GB``
+(utils/config.py). Leftover spill files are swept at exit.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time as _time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from . import config
+from . import faults
+from . import flight
+from . import hbm
+from . import log
+from . import metrics
+from . import profiler
+
+GIB = 1 << 30
+
+DEVICE = "device"
+HOST = "host"
+DISK = "disk"
+
+# ---------------------------------------------------------------------------
+# flag gates (the faults.py discipline: disabled costs one generation
+# compare, not an environ read per registry access)
+# ---------------------------------------------------------------------------
+
+_GATE = (None, False)
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def enabled() -> bool:
+    global _GATE
+    gen = config.generation()
+    if _GATE[0] != gen:
+        _GATE = (gen, _truthy(config.get_flag("SPILL")))
+    return _GATE[1]
+
+
+def spill_dir() -> str:
+    """Directory for disk-tier files; created lazily. The default is a
+    per-process directory under the system temp dir, removed at exit
+    when empty (no orphaned spill files)."""
+    d = str(config.get_flag("SPILL_DIR") or "").strip()
+    if not d:
+        d = os.path.join(
+            tempfile.gettempdir(), f"srt-spill-{os.getpid()}"
+        )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def host_budget_bytes() -> int:
+    """Host-RAM tier budget; past it the coldest host entries demote to
+    disk. 0 = skip the host tier (spill straight to disk)."""
+    return int(float(config.get_flag("HOST_SPILL_BUDGET_GB")) * GIB)
+
+
+# ---------------------------------------------------------------------------
+# registry binding: the spill tier operates UNDER the resident
+# registry's own lock (runtime_bridge binds its structures at import),
+# so eviction vs capture vs reclaim ordering is decided by exactly one
+# lock — the same one the donate barrier and active-read drain use.
+# ---------------------------------------------------------------------------
+
+_REG_LOCK = None            # runtime_bridge._RESIDENT_LOCK (RLock)
+_REG_TABLES: Optional[dict] = None   # id -> Table | Pending | SpilledTable
+_REG_READERS: Optional[dict] = None  # id -> [in-flight reader Pendings]
+_REG_ACTIVE_READS: Optional[dict] = None  # id -> wire-download count
+
+
+def bind_registry(lock, tables, readers, active_reads) -> None:
+    global _REG_LOCK, _REG_TABLES, _REG_READERS, _REG_ACTIVE_READS
+    _REG_LOCK = lock
+    _REG_TABLES = tables
+    _REG_READERS = readers
+    _REG_ACTIVE_READS = active_reads
+
+
+# ---------------------------------------------------------------------------
+# tracking state (guarded by the bound registry lock unless noted)
+# ---------------------------------------------------------------------------
+
+_CLOCK = itertools.count(1)
+_LAST_TOUCH: dict = {}      # id -> monotonic touch stamp (GIL-atomic)
+_TRACK: dict = {}           # id -> device bytes, for DEVICE-tier entries
+_PINS: dict = {}            # id -> explicit pin count (sync dispatches)
+
+_DEVICE_BYTES = 0           # tracked device-tier total
+_HOST_BYTES = 0             # host-tier total (actual numpy bytes)
+_DISK_BYTES = 0             # disk-tier total
+_HOST_HW = 0
+_DISK_HW = 0
+
+_FILE_SEQ = itertools.count(1)
+_FILES: set = {*()}         # disk paths this process created, for the sweep
+
+# Residency events for the serving tier (session budget credit on
+# spill-out, re-charge on repage). Fired DEFERRED — never while the
+# registry lock is held — because listeners take Session locks and a
+# teardown path holds a Session lock while taking the registry lock
+# (table_reclaim): firing inline would be a lock-order inversion.
+_EVENTS_LOCK = threading.Lock()
+_EVENTS: deque = deque()
+_RESIDENCY_LISTENERS: list = []
+
+
+def register_residency_listener(fn) -> None:
+    """Register ``fn(event, table_id, nbytes)`` with event ``"out"``
+    (table left the device tier) or ``"in"`` (repaged back). Fired from
+    ``flush_events()`` with no spill/registry lock held; listeners must
+    not raise."""
+    if fn not in _RESIDENCY_LISTENERS:
+        _RESIDENCY_LISTENERS.append(fn)
+
+
+def flush_events() -> None:
+    """Deliver queued residency events (see register_residency_listener).
+    Called by the bridge right after it releases the registry lock at
+    every repage site, and by request_headroom before returning."""
+    while _EVENTS:  # cheap empty check before any lock (hot paths)
+        with _EVENTS_LOCK:
+            if not _EVENTS:
+                return
+            ev = _EVENTS.popleft()
+        for fn in tuple(_RESIDENCY_LISTENERS):
+            fn(*ev)
+
+
+def _queue_event(event: str, tid: int, nbytes: int) -> None:
+    if not _RESIDENCY_LISTENERS:
+        return
+    with _EVENTS_LOCK:
+        _EVENTS.append((event, tid, nbytes))
+
+
+# ---------------------------------------------------------------------------
+# the spilled entry: what replaces a Table in the resident registry
+# ---------------------------------------------------------------------------
+
+
+class SpilledTable:
+    """Host/disk backing of one evicted resident table.
+
+    ``cols`` (host state) is a list of per-column tuples
+    ``(type_id, scale, data, validity, lengths)`` holding numpy copies
+    of the DEVICE storage buffers — already in storage layout, so
+    repage is a pure batched upload. On demotion the buffers move into
+    the disk-write closure (``_write``, a pipeline IO Pending returning
+    the path); repage resolves it first, so a demotion in flight is
+    never a correctness hazard, only a latency one."""
+
+    __slots__ = (
+        "tid", "state", "nbytes", "host_nbytes", "names", "rows",
+        "logical_rows", "cols", "path", "_write",
+    )
+
+    def __init__(self, tid, nbytes, host_nbytes, names, rows,
+                 logical_rows, cols):
+        self.tid = tid
+        self.state = HOST
+        self.nbytes = nbytes            # device bytes freed / re-added
+        self.host_nbytes = host_nbytes  # actual host payload bytes
+        self.names = names
+        self.rows = rows                # logical row count (leak report)
+        self.logical_rows = logical_rows
+        self.cols = cols
+        self.path = None
+        self._write = None
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.cols) if self.cols is not None else 0
+
+
+def _device_arrays(col) -> list:
+    out = []
+    for name in ("data", "validity", "lengths"):
+        a = getattr(col, name, None)
+        if a is not None and hasattr(a, "delete"):
+            out.append(a)
+    return out
+
+
+def _host_copy(a) -> np.ndarray:
+    # np.array(copy=True): on the CPU backend np.asarray can be a
+    # ZERO-COPY view of the device buffer we are about to delete
+    return np.array(a, copy=True)
+
+
+# ---------------------------------------------------------------------------
+# bookkeeping hooks called by the bridge (hot paths: one cached gate)
+# ---------------------------------------------------------------------------
+
+
+def note_put(tid: int, table) -> None:
+    """Track a device-resident table. Idempotent per id; also the
+    proactive pressure point — a put that carries the tracked device
+    tier past ``hbm.budget_bytes()`` evicts the coldest entries first,
+    which is how a stream whose working set exceeds HBM keeps running
+    instead of dying."""
+    global _DEVICE_BYTES
+    if not enabled() or _REG_LOCK is None:
+        return
+    tid = int(tid)
+    try:
+        nbytes = int(hbm.table_bytes(table))
+    except Exception:
+        return
+    with _REG_LOCK:
+        if tid not in _REG_TABLES:
+            return  # freed while we sized it
+        prev = _TRACK.get(tid)
+        _TRACK[tid] = nbytes
+        _DEVICE_BYTES += nbytes - (prev or 0)
+        _LAST_TOUCH[tid] = next(_CLOCK)
+        excess = _DEVICE_BYTES - hbm.budget_bytes()
+    if excess > 0:
+        request_headroom(excess, reason="put", exclude=(tid,))
+
+
+def touch(tid: int) -> None:
+    """LRU stamp on registry access (dict write; GIL-atomic — a stale
+    stamp only makes LRU slightly less exact, never incorrect)."""
+    if not enabled():
+        return
+    _LAST_TOUCH[int(tid)] = next(_CLOCK)
+
+
+def note_free(tid: int, entry=None) -> int:
+    """Drop all tracking for a freed/reclaimed/donated id; when the
+    popped registry entry was a ``SpilledTable``, release its host or
+    disk backing too (no orphaned spill files). Returns the device-tier
+    bytes the entry would have occupied (the reclaim credit for a
+    spilled table)."""
+    global _DEVICE_BYTES, _HOST_BYTES, _DISK_BYTES
+    if _REG_LOCK is None:
+        return 0
+    tid = int(tid)
+    write = path = None
+    nbytes = 0
+    with _REG_LOCK:
+        _LAST_TOUCH.pop(tid, None)
+        _PINS.pop(tid, None)
+        tracked = _TRACK.pop(tid, None)
+        if tracked:
+            _DEVICE_BYTES -= tracked
+        if isinstance(entry, SpilledTable):
+            nbytes = entry.nbytes
+            if entry.state == HOST:
+                _HOST_BYTES -= entry.host_nbytes
+            else:
+                _DISK_BYTES -= entry.host_nbytes
+            entry.cols = None
+            write, path = entry._write, entry.path
+            entry._write = None
+    if write is not None or path is not None:
+        _drop_backing(write, path)
+        _tier_gauges()
+    return int(nbytes)
+
+
+def _drop_backing(write, path) -> None:
+    """Release a disk entry's file, resolving an in-flight IO write
+    first (the write closure owns the buffers; waiting it out is the
+    simple way to guarantee no file lands after the unlink)."""
+    if write is not None:
+        try:
+            path = write.resolve()
+        except Exception:
+            path = None  # the write itself failed: nothing on disk
+    if path:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        _FILES.discard(path)
+
+
+def pin_ids(ids) -> tuple:
+    """Explicitly pin ids against eviction (sync dispatch paths, where
+    no reader Pending exists to reuse). Must be called under the
+    registry lock or before any concurrent evictor can see the ids.
+    Returns the pinned tuple for the matching ``unpin_ids``."""
+    if not enabled() or _REG_LOCK is None:
+        return ()
+    out = tuple(int(t) for t in ids)
+    with _REG_LOCK:
+        for t in out:
+            _PINS[t] = _PINS.get(t, 0) + 1
+    return out
+
+
+def unpin_ids(ids) -> None:
+    if _REG_LOCK is None:
+        return
+    with _REG_LOCK:
+        for t in ids:
+            n = _PINS.get(int(t), 0) - 1
+            if n > 0:
+                _PINS[int(t)] = n
+            else:
+                _PINS.pop(int(t), None)
+
+
+def residency_of(entry) -> str:
+    """Residency tier of one registry entry (for leak_report)."""
+    if isinstance(entry, SpilledTable):
+        return entry.state
+    return DEVICE
+
+
+# ---------------------------------------------------------------------------
+# eviction: device -> host (-> disk past the host budget)
+# ---------------------------------------------------------------------------
+
+
+def _buffer_counts_locked() -> dict:
+    """id(device buffer) -> number of live registry tables holding it.
+    A buffer seen by MORE than one table must never be deleted out from
+    under the other (aliasing op outputs) — such tables are simply not
+    eviction candidates this round."""
+    counts: dict = {}
+    for o in _REG_TABLES.values():
+        if hasattr(o, "value_nowait"):  # a pipeline.Pending
+            o = o.value_nowait()
+            if o is None:
+                continue
+        cols = getattr(o, "columns", None)
+        if cols is None:
+            continue
+        for c in cols:
+            for a in _device_arrays(c):
+                counts[id(a)] = counts.get(id(a), 0) + 1
+    return counts
+
+
+def _evictable_locked(tid, entry, exclude, counts) -> bool:
+    if tid in exclude or getattr(entry, "columns", None) is None:
+        return False  # Pending or already spilled
+    if _PINS.get(tid) or _REG_ACTIVE_READS.get(tid):
+        return False  # the pin wins
+    readers = _REG_READERS.get(tid)
+    if readers and any(not p.done() for p in readers):
+        return False
+    for c in entry.columns:
+        arrs = _device_arrays(c)
+        if not arrs:
+            return False
+        for a in arrs:
+            if counts.get(id(a), 0) > 1:
+                return False  # aliased buffer
+            try:
+                if a.is_deleted():
+                    return False  # consumed by a donated executable
+            except Exception:
+                pass
+    return True
+
+
+def _evict_one_locked(tid: int, table) -> int:
+    """Spill one device table to the host tier; returns device bytes
+    freed. Runs under the registry lock: the readback is a stall for
+    concurrent registry ops, but correctness needs the swap (copy out,
+    delete, replace with the SpilledTable) to be atomic vs capture."""
+    global _DEVICE_BYTES, _HOST_BYTES, _HOST_HW
+    faults.inject("spill")
+    nbytes = int(hbm.table_bytes(table))
+    cols = []
+    host_nbytes = 0
+    for c in table.columns:
+        data = _host_copy(c.data)
+        validity = None if c.validity is None else _host_copy(c.validity)
+        lengths = None if c.lengths is None else _host_copy(c.lengths)
+        host_nbytes += data.nbytes
+        host_nbytes += validity.nbytes if validity is not None else 0
+        host_nbytes += lengths.nbytes if lengths is not None else 0
+        cols.append(
+            (int(c.dtype.id), int(c.dtype.scale), data, validity, lengths)
+        )
+    entry = SpilledTable(
+        tid, nbytes, host_nbytes,
+        None if table.names is None else list(table.names),
+        int(table.logical_row_count), table.logical_rows, cols,
+    )
+    for c in table.columns:
+        for a in _device_arrays(c):
+            try:
+                a.delete()
+            except Exception:
+                pass
+    _REG_TABLES[tid] = entry
+    tracked = _TRACK.pop(tid, None)
+    if tracked:
+        _DEVICE_BYTES -= tracked
+    _HOST_BYTES += host_nbytes
+    _HOST_HW = max(_HOST_HW, _HOST_BYTES)
+    metrics.counter_add("spill.evictions")
+    metrics.bytes_add("spill.bytes_out", nbytes)
+    if flight.enabled():
+        flight.record("I", "spill.out", nbytes)
+    log.log("INFO", "spill", "evict", table_id=tid, bytes=nbytes,
+            host_bytes=_HOST_BYTES)
+    _queue_event("out", tid, nbytes)
+    return nbytes
+
+
+def _demote_one_locked(entry: SpilledTable) -> None:
+    """Move one host entry's payload to disk: the numpy buffers transfer
+    into a write closure run on the pipeline IO worker, so the file
+    write overlaps whatever compute triggered the pressure."""
+    global _HOST_BYTES, _DISK_BYTES, _DISK_HW
+    from .. import pipeline
+
+    path = os.path.join(
+        spill_dir(),
+        f"srt-spill-{os.getpid()}-{entry.tid}-{next(_FILE_SEQ)}.npz",
+    )
+    cols, entry.cols = entry.cols, None
+    meta = {
+        "type_ids": [c[0] for c in cols],
+        "scales": [c[1] for c in cols],
+        "names": entry.names,
+        "logical_rows": entry.logical_rows,
+    }
+
+    def write():
+        arrays = {
+            "meta": np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8
+            ),
+        }
+        for i, (_, _, data, validity, lengths) in enumerate(cols):
+            arrays[f"d{i}"] = data
+            if validity is not None:
+                arrays[f"v{i}"] = validity
+            if lengths is not None:
+                arrays[f"l{i}"] = lengths
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+        return path
+
+    entry.state = DISK
+    entry.path = path
+    entry._write = pipeline.submit_io(write, "spill.write")
+    _FILES.add(path)
+    _HOST_BYTES -= entry.host_nbytes
+    _DISK_BYTES += entry.host_nbytes
+    _DISK_HW = max(_DISK_HW, _DISK_BYTES)
+    metrics.counter_add("spill.demotions")
+    metrics.bytes_add("spill.disk_bytes_out", entry.host_nbytes)
+    if flight.enabled():
+        flight.record("I", "spill.demote", entry.host_nbytes)
+    log.log("INFO", "spill", "demote", table_id=entry.tid, path=path)
+
+
+def _rebalance_host_locked() -> None:
+    """Demote coldest host entries until the host tier fits its budget
+    (a 0 budget skips the host tier outright — everything demotes)."""
+    budget = host_budget_bytes()
+    while _HOST_BYTES > budget:
+        coldest = None
+        for tid, o in _REG_TABLES.items():
+            if isinstance(o, SpilledTable) and o.state == HOST:
+                stamp = _LAST_TOUCH.get(tid, 0)
+                if coldest is None or stamp < coldest[0]:
+                    coldest = (stamp, o)
+        if coldest is None:
+            return
+        _demote_one_locked(coldest[1])
+
+
+def request_headroom(
+    need_bytes: int, reason: str = "pressure", exclude=()
+) -> int:
+    """Evict the coldest unreferenced device tables until ``need_bytes``
+    of device-tier bytes are freed (or no candidates remain). Returns
+    the bytes actually freed. The pressure entry point for serving
+    admission (session.admit), the plan OOM ladder, hbm plan
+    listeners, and proactive puts."""
+    if not enabled() or _REG_TABLES is None:
+        return 0
+    need = max(int(need_bytes), 1)
+    freed = 0
+    exclude = {int(t) for t in exclude}
+    with _REG_LOCK:
+        counts = _buffer_counts_locked()
+        candidates = sorted(
+            (
+                (_LAST_TOUCH.get(tid, 0), tid, o)
+                for tid, o in _REG_TABLES.items()
+                if _evictable_locked(tid, o, exclude, counts)
+            ),
+        )
+        for _, tid, table in candidates:
+            if freed >= need:
+                break
+            try:
+                freed += _evict_one_locked(tid, table)
+            except faults.FaultError:
+                metrics.counter_add("spill.errors")
+                continue  # chaos: this victim failed, try the next
+        if freed:
+            _rebalance_host_locked()
+        host, disk = _HOST_BYTES, _DISK_BYTES
+    if freed:
+        _tier_gauges(host, disk)
+        log.log("INFO", "spill", "headroom", reason=reason,
+                need=int(need_bytes), freed=freed)
+    flush_events()
+    return freed
+
+
+# ---------------------------------------------------------------------------
+# repage: host/disk -> device, transparently on access
+# ---------------------------------------------------------------------------
+
+
+def _load_cols(entry: SpilledTable) -> list:
+    if entry.cols is not None:
+        return entry.cols
+    path = entry._write.resolve() if entry._write is not None else entry.path
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        cols = []
+        for i, (ti, sc) in enumerate(
+            zip(meta["type_ids"], meta["scales"])
+        ):
+            cols.append((
+                ti, sc, z[f"d{i}"],
+                z[f"v{i}"] if f"v{i}" in z else None,
+                z[f"l{i}"] if f"l{i}" in z else None,
+            ))
+    entry.names = meta["names"]
+    entry.logical_rows = meta["logical_rows"]
+    return cols
+
+
+def repage_locked(tid: int):
+    """Rebuild the device Table for a spilled id and swap it back into
+    the registry. MUST run under the registry lock (every bridge access
+    path holds it at the lookup); the caller flushes residency events
+    after releasing the lock. Retries under the fault plane — the
+    backing store is only released after a successful upload, so a
+    transient (or injected) failure is always retryable."""
+    global _DEVICE_BYTES, _HOST_BYTES, _DISK_BYTES
+    entry = _REG_TABLES.get(int(tid))
+    if not isinstance(entry, SpilledTable):
+        return entry
+    t0 = _time.perf_counter()
+
+    def attempt():
+        faults.inject("spill")
+        return _upload(entry)
+
+    with metrics.span("spill.repage"):
+        table = faults.run_with_retry(attempt, "spill.in")
+    _REG_TABLES[int(tid)] = table
+    _TRACK[int(tid)] = entry.nbytes
+    _DEVICE_BYTES += entry.nbytes
+    if entry.state == HOST:
+        _HOST_BYTES -= entry.host_nbytes
+    else:
+        _DISK_BYTES -= entry.host_nbytes
+    entry.cols = None
+    write, path = entry._write, entry.path
+    entry._write = None
+    _drop_backing(write, path)
+    _LAST_TOUCH[int(tid)] = next(_CLOCK)
+    dt_s = _time.perf_counter() - t0
+    metrics.counter_add("spill.repages")
+    metrics.bytes_add("spill.bytes_in", entry.nbytes)
+    profiler.note_stall(dt_s)  # repage stalls show in the 4-way split
+    if flight.enabled():
+        flight.record("I", "spill.in", entry.nbytes)
+    log.log("INFO", "spill", "repage", table_id=int(tid),
+            bytes=entry.nbytes, tier=entry.state,
+            stall_ms=round(dt_s * 1e3, 3))
+    _queue_event("in", int(tid), entry.nbytes)
+    _tier_gauges()
+    return table
+
+
+def _upload(entry: SpilledTable):
+    """Batched upload of a spilled entry's storage buffers — the
+    _upload_host_columns discipline: ONE jax.device_put over the flat
+    leaf list, then rebuild Columns/Table around the device arrays."""
+    import jax
+
+    from .. import dtype as dt
+    from ..column import Column, Table
+
+    cols = _load_cols(entry)
+    leaves = []
+    for _, _, data, validity, lengths in cols:
+        leaves.append(data)
+        if validity is not None:
+            leaves.append(validity)
+        if lengths is not None:
+            leaves.append(lengths)
+    dev = jax.device_put(leaves) if leaves else []
+    it = iter(dev)
+    out = []
+    for ti, sc, data, validity, lengths in cols:
+        d = next(it)
+        if d.dtype != data.dtype:
+            from ..column import x64_downgrade_error
+
+            raise x64_downgrade_error(d.dtype, data.dtype, "types")
+        v = next(it) if validity is not None else None
+        lens = next(it) if lengths is not None else None
+        out.append(
+            Column(d, dt.DType(dt.TypeId(ti), sc), v, lens)
+        )
+    return Table(out, entry.names, entry.logical_rows)
+
+
+# ---------------------------------------------------------------------------
+# stats / reset / exit sweep
+# ---------------------------------------------------------------------------
+
+
+def _tier_gauges(host: Optional[int] = None,
+                 disk: Optional[int] = None) -> None:
+    host = _HOST_BYTES if host is None else host
+    disk = _DISK_BYTES if disk is None else disk
+    metrics.gauge_set("spill.host_bytes", host)
+    metrics.gauge_set("spill.disk_bytes", disk)
+    metrics.gauge_set("spill.host_bytes_hw", _HOST_HW)
+    metrics.gauge_set("spill.disk_bytes_hw", _DISK_HW)
+    if flight.enabled():
+        flight.record("C", "spill.host_bytes", host)
+        flight.record("C", "spill.disk_bytes", disk)
+
+
+def stats_doc() -> dict:
+    """Per-tier bytes + high-water marks (served by server.stats)."""
+    with _EVENTS_LOCK:
+        pending_events = len(_EVENTS)
+    return {
+        "enabled": enabled(),
+        "device_bytes": int(_DEVICE_BYTES),
+        "host_bytes": int(_HOST_BYTES),
+        "disk_bytes": int(_DISK_BYTES),
+        "host_bytes_hw": int(_HOST_HW),
+        "disk_bytes_hw": int(_DISK_HW),
+        "files": len(_FILES),
+        "pending_events": pending_events,
+    }
+
+
+def spill_file_count() -> int:
+    """Disk-tier files currently on disk (0 after clean teardown)."""
+    return len(_FILES)
+
+
+def reset() -> None:
+    """Test hook: drop all tracking and remove every spill file."""
+    global _DEVICE_BYTES, _HOST_BYTES, _DISK_BYTES, _HOST_HW, _DISK_HW
+    if _REG_LOCK is not None:
+        with _REG_LOCK:
+            _LAST_TOUCH.clear()
+            _TRACK.clear()
+            _PINS.clear()
+            _DEVICE_BYTES = _HOST_BYTES = _DISK_BYTES = 0
+            _HOST_HW = _DISK_HW = 0
+    with _EVENTS_LOCK:
+        _EVENTS.clear()
+    for path in list(_FILES):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        _FILES.discard(path)
+
+
+def _sweep_at_exit() -> None:  # pragma: no cover - atexit path
+    """No orphaned spill files: remove anything this process wrote and
+    the per-process default directory when it is left empty."""
+    for path in list(_FILES):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        _FILES.discard(path)
+    default_dir = os.path.join(
+        tempfile.gettempdir(), f"srt-spill-{os.getpid()}"
+    )
+    try:
+        os.rmdir(default_dir)
+    except OSError:
+        pass
+
+
+atexit.register(_sweep_at_exit)
+flight.register_exit_section("spill", stats_doc)
+
+
+def _on_hbm_pressure(deficit: int) -> None:
+    """hbm plan listener: a shape that does not fit the budget is the
+    planner telling us the device tier is about to blow — free the
+    deficit before the launch instead of reacting to the OOM."""
+    if enabled():
+        request_headroom(deficit, reason="hbm_plan")
+
+
+hbm.register_pressure_listener(_on_hbm_pressure)
