@@ -2010,40 +2010,80 @@ _SUBPROCESS_CONFIGS = {
     "tpcds10": lambda p: bench_tpcds(p, scale=10.0),
 }
 
-# The on-chip ladder main()/the daemon walk, in TWO tiers (r04/r05
-# postmortem: both rounds ended rc=124 with parsed=null because the
-# flat cheap-first walk spent its whole budget on A/B arms before the
-# headline 100M groupby ever ran). Tier 1 is the HEADLINE set — the
-# cheapest arm of each workload that feeds the published line plus one
-# proof arm per subsystem — and walks first under the full budget.
-# Tier 2 EXTENDED arms are refinement A/Bs; each needs
-# _EXTENDED_FLOOR_S of budget left to start, so a slow extended arm
-# can no longer eat the flush/Arrow-baseline window at the end.
-_HEADLINE_LADDER = (
-    "groupby1m", "groupby16m_packed", "groupby16m_chunked",
+# Every arm declares its ladder tier HERE — one table, walk order
+# preserved by dict insertion order, statically verified by srt-check
+# SRT007 against _SUBPROCESS_CONFIGS (an un-tiered arm fails lint:
+# r04/r05 postmortem — both rounds ended rc=124 with parsed=null
+# because the flat cheap-first walk spent its whole budget on A/B arms
+# before the headline 100M groupby ever ran).
+#
+#   headline — tier 1: the cheapest arm of each workload that feeds
+#              the published line plus one proof arm per subsystem;
+#              walks first under the full budget.
+#   extended — tier 2: refinement A/Bs; each needs _EXTENDED_FLOOR_S
+#              of budget left to start, so a slow extended arm can no
+#              longer eat the flush/Arrow-baseline window at the end.
+#   manual   — runnable via `--config <arm>` only; never in the
+#              budgeted walk (superseded by a batched/packed variant
+#              but kept for one-off comparison runs).
+_ARM_TIERS = {
+    "groupby1m": "headline",
+    "groupby16m_packed": "headline",
+    "groupby16m_chunked": "headline",
     # the headline metric itself (cheapest winning 100M formulation)
-    "groupby100m_flat_gather",
+    "groupby100m_flat_gather": "headline",
     # one proof arm per subsystem: fusion, serving, tiered memory
-    "fused_plan", "serving_multiquery", "spill_stream",
-)
-_EXTENDED_LADDER = (
-    "groupby16m",
+    "fused_plan": "headline",
+    "serving_multiquery": "headline",
+    "spill_stream": "headline",
+    "groupby16m": "extended",
     # decisive cheap A/Bs first: plain-XLA gather arms compile fast,
     # the Pallas engines (slow Mosaic compiles) right after
-    "groupby16m_flat_gather", "groupby16m_flat_sort", "groupby16m_gather",
-    "groupby16m_packed_pallas32", "chunk_sort_ab",
-    "strings", "transpose", "transpose_pallas", "resident",
-    "bucketed_stream", "pipelined_stream",
-    "parquet", "parquet_device",
+    "groupby16m_flat_gather": "extended",
+    "groupby16m_flat_sort": "extended",
+    "groupby16m_gather": "extended",
+    "groupby16m_packed_pallas32": "extended",
+    "chunk_sort_ab": "extended",
+    "strings": "extended",
+    "transpose": "extended",
+    "transpose_pallas": "extended",
+    "resident": "extended",
+    "bucketed_stream": "extended",
+    "pipelined_stream": "extended",
+    "parquet": "extended",
+    "parquet_device": "extended",
     # 100M tier: likely winners first
-    "groupby100m_gather", "groupby100m",
-    "groupby100m_packed_pallas32", "groupby100m_packed",
-    "groupby100m_chunked",
-    "groupby_highcard", "sort",
-    "sort_packed_gather", "sort_packed", "sort_gather",
-    "join_batched", "join_batched_packed", "tpcds", "tpcds10",
+    "groupby100m_gather": "extended",
+    "groupby100m": "extended",
+    "groupby100m_packed_pallas32": "extended",
+    "groupby100m_packed": "extended",
+    "groupby100m_chunked": "extended",
+    "groupby_highcard": "extended",
+    "sort": "extended",
+    "sort_packed_gather": "extended",
+    "sort_packed": "extended",
+    "sort_gather": "extended",
+    "join_batched": "extended",
+    "join_batched_packed": "extended",
+    "tpcds": "extended",
+    "tpcds10": "extended",
+    # unbatched join: superseded in the walk by join_batched[_packed]
+    "join": "manual",
+}
+_HEADLINE_LADDER = tuple(
+    a for a, t in _ARM_TIERS.items() if t == "headline"
+)
+_EXTENDED_LADDER = tuple(
+    a for a, t in _ARM_TIERS.items() if t == "extended"
 )
 _LADDER = _HEADLINE_LADDER + _EXTENDED_LADDER
+
+# the static pass catches a missing tier at lint time; this catches it
+# the moment someone runs the bench instead
+assert set(_ARM_TIERS) == set(_SUBPROCESS_CONFIGS), (
+    "bench arms and _ARM_TIERS disagree: "
+    f"{set(_ARM_TIERS) ^ set(_SUBPROCESS_CONFIGS)}"
+)
 
 _CONFIG_TIMEOUT_S = 1800
 _EXTENDED_FLOOR_S = 300.0  # budget an extended arm needs left to start

@@ -21,6 +21,15 @@ fi
 
 build/dependency-check
 
+# Static analysis gate (the compute-sanitizer CI-discipline analog,
+# static half): repo-invariant AST passes — env reads outside the
+# config plane, broad excepts that bypass the faults taxonomy, hot-path
+# env reads, wall clocks in replay-critical modules, retry on donated
+# call sites, metric-name conventions, un-tiered bench arms. Exits
+# nonzero on any finding not grandfathered in
+# tools/srt_check_baseline.json; the one-line summary is the last line.
+python3 tools/srt_check.py
+
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).
 NATIVE_BUILD_CONFIGURE=true SRT_WERROR=ON \

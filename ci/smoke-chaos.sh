@@ -36,6 +36,11 @@ export SPARK_RAPIDS_TPU_FAULTS="seed=1,dispatch:transient:0.1,serde:transient:0.
 export SPARK_RAPIDS_TPU_RETRY_BASE_MS=1
 export SPARK_RAPIDS_TPU_BREAKER_THRESHOLD=2
 export SPARK_RAPIDS_TPU_BREAKER_PROBE_S=0.2
+# dynamic lock-order detector rides the whole smoke (the racecheck
+# half of the srt-check CI discipline): every tracked lock records the
+# acquisition-order graph; the driver fails on any cycle or inversion
+# of the sanctioned registry->session->scheduler->spill order
+export SPARK_RAPIDS_TPU_LOCKCHECK=on
 
 python3 - <<'PY'
 import json
@@ -118,6 +123,14 @@ with serving.serve() as srv:
 assert rb.resident_table_count() == 0, "daemon leaked resident tables"
 assert rb.leak_report() == [], rb.leak_report()
 
+# lock-order gate: the retrying, breaker-tripping, multi-threaded run
+# above is exactly the interleaving soup where an inversion would show
+from spark_rapids_jni_tpu.utils import lockcheck
+
+lockdoc = lockcheck.assert_clean()
+assert lockdoc["acquisitions"] > 0, "lockcheck saw no acquisitions"
+print(lockcheck.summary_line())
+
 c = metrics.snapshot()["counters"]
 assert c.get("retry.attempts", 0) > 0, c
 assert c.get("faults.injected", 0) > 0, c
@@ -134,12 +147,29 @@ PY
 # the analysis tools below import the package too — drop the dump envs
 # so THEIR atexit hooks can't clobber the artifacts under test
 unset SPARK_RAPIDS_TPU_PROFILE SPARK_RAPIDS_TPU_FLIGHT_DUMP \
-  SPARK_RAPIDS_TPU_METRICS_DUMP SPARK_RAPIDS_TPU_FAULTS
+  SPARK_RAPIDS_TPU_METRICS_DUMP SPARK_RAPIDS_TPU_FAULTS \
+  SPARK_RAPIDS_TPU_LOCKCHECK
 
 # both artifacts exist, parse, and the metrics dump carries the
 # fault-plane counters the driver asserted in-process
 test -s "$out/metrics.json"
 test -s "$out/flight.json"
+# the flight dump's lockcheck exit section is the crash postmortem a
+# hang-to-SIGKILL run would leave behind — it must carry the graph
+python3 - "$out/flight.json" <<'PY'
+import json
+import sys
+
+sec = json.load(open(sys.argv[1]))["sections"]["lockcheck"]
+assert sec["enabled"] is True, sec
+assert sec["acquisitions"] > 0, sec
+assert sec["cycles"] == [], sec
+assert sec["order_violations"] == [], sec
+print(
+    f"lockcheck flight section OK: {sec['acquisitions']} acquisitions, "
+    f"{len(sec['edges'])} edges, 0 cycles, 0 order violations"
+)
+PY
 python3 - "$out/metrics.json" <<'PY'
 import json
 import sys

@@ -14,12 +14,14 @@ Layer map (mirrors SURVEY.md §1, re-architected):
   XLA/Pallas kernels              — the compute path on TPU
 """
 
-import os
-
 # Spark's data model is int64/float64-centric; enable 64-bit types unless the
 # embedder opts out. (TPU executes f64 via software emulation — ops that care
-# about throughput should cast to f32/bf16 explicitly.)
-if os.environ.get("SPARK_RAPIDS_TPU_DISABLE_X64", "0") != "1":
+# about throughput should cast to f32/bf16 explicitly.) The flag rides the
+# config plane like every other knob — srt-check (SRT001) keeps raw
+# SPARK_RAPIDS_TPU_* environ reads out of everything but utils/config.py.
+from .utils import config as _config
+
+if not _config.get_flag("DISABLE_X64"):
     import jax
 
     jax.config.update("jax_enable_x64", True)

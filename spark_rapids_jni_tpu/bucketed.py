@@ -66,6 +66,7 @@ def dispatch_bucketed(
         except _Decline:
             metrics.counter_add("bucket.declined")
             return None
+        # srt: allow-broad-except(semantics-preserving fallback: the exact path re-runs the op and raises the real error)
         except Exception as e:
             # bucketing must never change semantics: any runner failure
             # falls back to the exact path, which raises the real error

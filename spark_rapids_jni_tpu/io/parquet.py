@@ -140,7 +140,8 @@ def _prefetch_iter(gen: Iterator, depth: int) -> Iterator:
                         continue
                 if stop.is_set():
                     break
-        except BaseException as e:  # re-raised on the consumer side
+        # srt: allow-broad-except(captured verbatim and re-raised on the consumer side — a relocation, not a swallow)
+        except BaseException as e:
             failure.append(e)
         finally:
             gen.close()

@@ -576,6 +576,7 @@ def decode_row_group(path: str, pf, rg: int, columns) -> tuple[dict, list]:
                 decoded[name] = decode_column_chunk(
                     f, rgmeta.column(ci), fdt, field.nullable
                 )
+            # srt: allow-broad-except(transparent per-column fallback to the Arrow decoder — never a crashed scan)
             except Exception:
                 # the contract is transparent per-column fallback:
                 # truncated chunks (IndexError), short payloads

@@ -25,6 +25,7 @@ def on_tpu() -> bool:
     try:
         d = jax.devices()[0]
         return "tpu" in (d.platform + " " + d.device_kind).lower()
+    # srt: allow-broad-except(no usable backend means not-TPU; capability probing must never raise at import)
     except Exception:
         return False
 

@@ -46,7 +46,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
-from .utils import config, faults, flight, log, metrics, profiler
+from .utils import config, faults, flight, lockcheck, log, metrics, profiler
 
 DEFAULT_DEPTH = 2
 MAX_DEPTH = 64
@@ -105,7 +105,7 @@ def _parse_depth(raw) -> int:
 # pattern: a dispatch-path check costs an int compare)
 _DEPTH = 0
 _DEPTH_GEN = -1
-_DEPTH_LOCK = threading.Lock()
+_DEPTH_LOCK = lockcheck.make_lock("pipeline.depth")
 
 
 def depth() -> int:
@@ -171,7 +171,7 @@ class Pending:
         # DependencyFailed and stays replayable: nothing was consumed.)
         self._replayable = replayable
         self._orphaned = False
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("pipeline.pending")
 
     # -- worker side ------------------------------------------------------
     def _run(self) -> None:
@@ -270,6 +270,7 @@ class Pending:
         if self._error is not None and self._replayable:
             try:
                 self._replay_locked()
+            # srt: allow-broad-except(replay outcome is stored as terminal state; the true blocking point raises it)
             except BaseException:
                 pass  # stored as terminal; the blocking point raises it
 
@@ -450,7 +451,7 @@ class _Pool:
 # pool cache keyed on the configured depth; rebuilt (and the old pool
 # drained) when the flag changes mid-process (tests flip it freely)
 _POOL: Optional[_Pool] = None
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = lockcheck.make_lock("pipeline.pool")
 
 
 def _pool() -> _Pool:
@@ -513,7 +514,7 @@ def drain() -> None:
 # ---------------------------------------------------------------------------
 
 _IO_Q: "queue.SimpleQueue" = queue.SimpleQueue()
-_IO_LOCK = threading.Lock()
+_IO_LOCK = lockcheck.make_lock("pipeline.io")
 _IO_THREAD: Optional[threading.Thread] = None
 
 

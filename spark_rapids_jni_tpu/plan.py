@@ -413,6 +413,7 @@ def _run_fused_tolerant(
             ):
                 try:
                     return _run_chunked(seg_ops, table)
+                # srt: allow-broad-except(chunked-fallback failure defers to the exact path, which owns the original typed error)
                 except Exception:
                     raise e  # exact-path fallback owns it from here
             if (
@@ -572,6 +573,7 @@ def run_plan(
                         try:
                             ro = int(table.logical_row_count)
                             ob = int(hbm.table_bytes(table))
+                        # srt: allow-broad-except(donated-and-failed input has no sizeable buffers; profiling must not mask the real error)
                         except Exception:  # donated-and-failed input
                             ro, ob = 0, 0
                         profiler.segment_end(
@@ -602,5 +604,6 @@ def _input_consumed(table: Table) -> bool:
     buffers (replaying it is impossible)."""
     try:
         return bool(table.columns) and table.columns[0].data.is_deleted()
-    except Exception:  # backends without is_deleted: assume replayable
+    # srt: allow-broad-except(backends without is_deleted assume replayable — the conservative donation answer)
+    except Exception:
         return False

@@ -39,7 +39,7 @@ if os.environ.get("SRT_JAX_PLATFORMS"):
 from . import dtype as dt
 from . import pipeline
 from .column import Column, Table
-from .utils import buckets, faults, flight, log, metrics, profiler, spill
+from .utils import buckets, faults, flight, lockcheck, log, metrics, profiler, spill
 
 
 def _wire_np(d: dt.DType) -> np.dtype:
@@ -442,6 +442,9 @@ def _dispatch(op: dict, table: Table, rest: Sequence[Table] = ()) -> Table:
 def _dispatch_once(
     op: dict, table: Table, rest: Sequence[Table], name: str
 ) -> Table:
+    # a tracked lock held across a device launch serializes every other
+    # dispatcher behind the chip — the lockcheck shim reports it
+    lockcheck.note_blocking("device_dispatch")
     with metrics.span("dispatch." + name):
         out = None
         if buckets.enabled():
@@ -820,8 +823,9 @@ _RESIDENT_META: dict = {}
 # a read-increment pair — an unsynchronized counter could hand two
 # threads the same table id. RLock because the SIGTERM-handler flush
 # path reaches leak_report() (a flight-dump exit section) on the main
-# thread and must not self-deadlock mid-_resident_put.
-_RESIDENT_LOCK = threading.RLock()
+# thread and must not self-deadlock mid-_resident_put. Tracked: rank 0
+# of the sanctioned registry->session->scheduler->spill order.
+_RESIDENT_LOCK = lockcheck.make_rlock("registry.resident")
 _NEXT_TABLE_ID = itertools.count(1)
 
 
@@ -1161,7 +1165,7 @@ def table_plan_resident(
 # barrier. Registered atomically with the registry lookup so a reclaim
 # that popped the id either sees this read or ordered itself first.
 _RESIDENT_ACTIVE_READS: dict = {}
-_RESIDENT_READS_CV = threading.Condition(_RESIDENT_LOCK)
+_RESIDENT_READS_CV = lockcheck.make_condition(_RESIDENT_LOCK)
 
 
 def table_download_wire(table_id: int):
@@ -1340,6 +1344,7 @@ def table_reclaim(table_id: int) -> int:
 
     try:
         nbytes = int(hbm.table_bytes(t))
+    # srt: allow-broad-except(diagnostic sizing only; reclaim proceeds with nbytes=0)
     except Exception:
         nbytes = 0
     # never delete a buffer another live table can still see: an op
@@ -1364,10 +1369,8 @@ def table_reclaim(table_id: int) -> int:
                 continue
             try:
                 a.delete()
+            # srt: allow-broad-except(already consumed by a donated executable or no explicit delete; the reference drop reclaims it)
             except Exception:
-                # already consumed by a donated executable, or a
-                # backend without explicit delete — the reference drop
-                # below reclaims it either way
                 pass
     log.log("DEBUG", "handles", "table_reclaim", table_id=tid,
             live=live, nbytes=nbytes)
@@ -1435,6 +1438,7 @@ def leak_report() -> list:
                 from .utils import hbm
 
                 rec["approx_bytes"] = int(hbm.table_bytes(t))
+            # srt: allow-broad-except(best-effort sizing for the leak report; listing tables must never fail)
             except Exception:
                 pass
         out.append(rec)

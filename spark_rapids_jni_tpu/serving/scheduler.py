@@ -33,7 +33,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
-from ..utils import faults, flight, metrics, profiler
+from ..utils import faults, flight, lockcheck, metrics, profiler
 from .session import Session, SessionClosed, executing
 
 # deficit credited to a backlogged session per sweep, in rows, before
@@ -92,8 +92,8 @@ class FairScheduler:
         self.workers = max(int(workers), 1)
         self.queue_depth = max(int(queue_depth), 1)
         self.quantum_rows = max(int(quantum_rows), 1)
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = lockcheck.make_lock("scheduler.queues")
+        self._cv = lockcheck.make_condition(self._lock)
         self._queues: Dict[str, deque] = {}
         self._deficit: Dict[str, float] = {}
         self._sessions: Dict[str, Session] = {}
@@ -179,6 +179,7 @@ class FairScheduler:
         checkpoints observe it) and settles an already-cancelled
         ticket without running it at all."""
         t = Ticket(session, fn, cost, label, charge, prof, token)
+        shed_now = False
         with self._cv:
             while True:
                 if self._stopping:
@@ -193,19 +194,29 @@ class FairScheduler:
                 if len(q) < self.queue_depth:
                     break
                 if shed:
-                    session.note_shed()
-                    metrics.counter_add("serving.shed")
-                    if flight.enabled():
-                        flight.record("I", "serving.shed", session.name)
-                    raise Busy(
-                        f"session {session.name}: queue depth "
-                        f"{self.queue_depth} reached — request shed, "
-                        "retry later"
-                    )
+                    # bookkeeping happens OUTSIDE this block:
+                    # Session.note_shed takes the session lock, and
+                    # session orders BEFORE scheduler in the sanctioned
+                    # lock order (lockcheck.LOCK_ORDER) — taking it
+                    # here was the inversion srt-check's dynamic shim
+                    # flagged across test_serving.py
+                    shed_now = True
+                    break
                 self._cv.wait()
-            t.submit_t = time.perf_counter()
-            q.append(t)
-            self._cv.notify_all()
+            if not shed_now:
+                t.submit_t = time.perf_counter()
+                q.append(t)
+                self._cv.notify_all()
+        if shed_now:
+            session.note_shed()
+            metrics.counter_add("serving.shed")
+            if flight.enabled():
+                flight.record("I", "serving.shed", session.name)
+            raise Busy(
+                f"session {session.name}: queue depth "
+                f"{self.queue_depth} reached — request shed, "
+                "retry later"
+            )
         metrics.counter_add("serving.requests")
         return t
 

@@ -47,7 +47,7 @@ from collections import deque
 from typing import Optional
 
 from .. import pipeline, plan as plan_mod, runtime_bridge as rb
-from ..utils import config, faults, flight, hbm, metrics, profiler, spill
+from ..utils import config, faults, flight, hbm, lockcheck, metrics, profiler, spill
 from . import frames
 from .scheduler import Busy, FairScheduler
 from .session import (
@@ -140,7 +140,7 @@ class Server:
         self.port: Optional[int] = None
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("session.server")
         self._sessions: dict = {}
         self._conns: set = set()
         self._conn_threads: list = []
@@ -287,6 +287,7 @@ class Server:
                     self._dispatch(sock, sess, cmd, header, payload)
                 except (BrokenPipeError, ConnectionError, OSError):
                     raise
+                # srt: allow-broad-except(every failure becomes a typed error frame via _error_header; the client always gets an answer, never a hang)
                 except BaseException as e:
                     frames.send_frame(sock, _error_header(e))
         except (ConnectionError, OSError, frames.ProtocolError):

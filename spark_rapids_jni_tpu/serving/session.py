@@ -31,7 +31,7 @@ from collections import deque
 from typing import Dict, Optional, Tuple
 
 from .. import runtime_bridge as rb
-from ..utils import buckets, faults, hbm, metrics, spill
+from ..utils import buckets, faults, hbm, lockcheck, metrics, spill
 
 # Global reverse map rb_id -> (owning session, charged bytes): the spill
 # tier's residency events carry rb ids, and the owning session credits /
@@ -39,7 +39,7 @@ from ..utils import buckets, faults, hbm, metrics, spill
 # lock — never taken while a Session lock is held, only inside the
 # deferred-event flush (spill.flush_events) and the table bookkeeping
 # paths, so there is no ordering against Session._cv to get wrong.
-_OWNERS_LOCK = threading.Lock()
+_OWNERS_LOCK = lockcheck.make_lock("session.owners")
 _RB_OWNERS: Dict[int, Tuple["Session", int]] = {}
 
 
@@ -84,8 +84,8 @@ class Session:
         self.created = time.time()
         self.connections = 0
         self.closed = False
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = lockcheck.make_lock("session.state")
+        self._cv = lockcheck.make_condition(self._lock)
         self._tables: Dict[int, Tuple[int, int]] = {}  # local -> (rb, B)
         self._next_local = itertools.count(1)
         self._resident_bytes = 0

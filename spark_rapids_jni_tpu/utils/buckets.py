@@ -41,6 +41,7 @@ from typing import Any, Callable, Optional, Tuple
 
 from . import config
 from . import flight
+from . import lockcheck
 from . import log
 from . import metrics
 from . import profiler
@@ -127,7 +128,7 @@ def _parse_spec(raw: str) -> BucketPolicy:
 # read per call)
 _POLICY: BucketPolicy = _OFF
 _POLICY_GEN = -1
-_POLICY_LOCK = threading.Lock()
+_POLICY_LOCK = lockcheck.make_lock("buckets.policy")
 
 
 def policy() -> BucketPolicy:
@@ -216,7 +217,7 @@ def pad_column(col, target: int):
 # running pad-waste total for the flight counter track: kept locally so
 # the track survives flight-only mode (metrics off => bytes_add no-ops)
 # and isn't zeroed by the bench's per-config metrics.reset()
-_PAD_WASTE_LOCK = threading.Lock()
+_PAD_WASTE_LOCK = lockcheck.make_lock("buckets.pad_waste")
 _PAD_WASTE_TOTAL = 0
 
 
@@ -348,7 +349,7 @@ def cache_key(kind: str, payload, tables, extra: tuple = ()) -> tuple:
 # reused — hit/miss counters are honest compile counters.
 CACHE_CAPACITY = 256
 
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = lockcheck.make_lock("buckets.cache")
 _CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
 
 

@@ -328,6 +328,16 @@ _FLAGS = {
             "serving circuit breaker: seconds an OPEN breaker waits "
             "before letting one half-open probe through",
         ),
+        Flag(
+            "LOCKCHECK", False, _as_bool,
+            "dynamic lock-order detector (utils/lockcheck.py): on = "
+            "every tracked package lock records per-thread held sets "
+            "and a global acquisition-order graph, reporting cycles "
+            "(potential deadlocks), inversions of the sanctioned "
+            "registry->session->scheduler->spill order, and locks held "
+            "across device dispatch / blocking IO; off (default) costs "
+            "one cached generation compare per acquisition",
+        ),
     ]
 }
 

@@ -49,7 +49,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from . import config, flight, log, metrics
+from . import config, flight, lockcheck, log, metrics
 
 # ---------------------------------------------------------------------------
 # typed error taxonomy
@@ -192,7 +192,7 @@ class FaultPlan:
     def __init__(self, seed: int, rules):
         self.seed = seed
         self._by_site = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("faults.plan")
         for r in rules:
             self._by_site.setdefault(r.site, []).append(r)
 
@@ -308,7 +308,7 @@ def parse_spec(spec: str, _env="SPARK_RAPIDS_TPU_FAULTS") -> FaultPlan:
 # inject() — the metrics._refresh_gate discipline
 _PLAN: Optional[FaultPlan] = None
 _PLAN_GEN = -1
-_PLAN_LOCK = threading.Lock()
+_PLAN_LOCK = lockcheck.make_lock("faults.plan_cache")
 
 
 def _plan() -> Optional[FaultPlan]:
@@ -568,7 +568,7 @@ class CircuitBreaker:
         )
         self.name = name
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("faults.breaker")
         self._state = CLOSED
         self._failures = 0
         self._opened_at = 0.0

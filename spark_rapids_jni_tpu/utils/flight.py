@@ -256,7 +256,8 @@ def snapshot(limit: Optional[int] = None) -> dict:
     for name, fn in _EXIT_SECTIONS.items():
         try:
             sections[name] = fn()
-        except Exception as e:  # a broken provider must not eat the dump
+        # srt: allow-broad-except(a broken exit-section provider must not eat the dump; its error is embedded instead)
+        except Exception as e:
             sections[name] = {"error": f"{type(e).__name__}: {e}"}
     if sections:
         doc["sections"] = sections

@@ -26,6 +26,7 @@ import numpy as np
 
 from . import config
 from . import flight
+from . import lockcheck
 from . import log
 from . import metrics
 from . import profiler
@@ -48,6 +49,7 @@ def backend_hbm_gb(platform: Optional[str] = None) -> float:
             import jax
 
             platform = jax.default_backend()
+        # srt: allow-broad-except(no backend at all degrades to cpu sizing; planning shapes still work)
         except Exception:  # pragma: no cover - no backend at all
             platform = "cpu"
     # CPU: pretend a v5e so planning behaves identically under the
@@ -104,7 +106,7 @@ def key_word_count(cols: Sequence) -> int:
 # cumulative donated bytes for the flight counter track (the
 # bucket.pad_waste_bytes discipline: kept locally so the track survives
 # flight-only mode and per-config metrics resets)
-_DONATED_LOCK = threading.Lock()
+_DONATED_LOCK = lockcheck.make_lock("hbm.donated")
 _DONATED_TOTAL = 0
 
 # Donation listeners: the serving tier registers one so a tenant whose

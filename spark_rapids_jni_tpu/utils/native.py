@@ -22,11 +22,12 @@ from typing import Optional, Sequence
 import numpy as np
 
 from . import config
+from . import lockcheck
 
 # status codes (src/include/spark_rapids_tpu/c_api.h)
 SRT_OK = 0
 
-_lock = threading.Lock()
+_lock = lockcheck.make_lock("native.load")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from . import config
 from . import flight
+from . import lockcheck
 
 _HOST = socket.gethostname()
 
@@ -98,7 +99,7 @@ def enabled() -> bool:
 # for notes arriving on threads with no thread-local session (pipeline
 # workers decoding for a stream session on the caller thread)
 _OPEN: List["ProfileSession"] = []
-_OPEN_LOCK = threading.Lock()
+_OPEN_LOCK = lockcheck.make_lock("profiler.open")
 
 # THE hot-path gate: True iff any session is open anywhere. Every
 # note_* hook reads this one bool first, so the no-session cost is a
@@ -111,7 +112,7 @@ _TLS = threading.local()  # .sessions: list, .seg: (session, _Seg) or None
 # not grow a profile registry without bound)
 _SESSIONS_KEEP = 64
 _SESSIONS: "collections.deque" = collections.deque(maxlen=_SESSIONS_KEEP)
-_SESSIONS_LOCK = threading.Lock()
+_SESSIONS_LOCK = lockcheck.make_lock("profiler.sessions")
 
 _BOUNDARY_KEYS = (
     "compile_s", "serde_s", "serde_bytes_in", "serde_bytes_out",
@@ -192,7 +193,7 @@ class ProfileSession:
         self.batches = batches
         self.wall_s = 0.0
         self._t0 = time.perf_counter()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("profiler.session")
         self._segs: Dict[tuple, _Seg] = {}
         self._order: List[tuple] = []
         self.boundary: Dict[str, Any] = {k: 0 for k in _BOUNDARY_KEYS}
@@ -250,6 +251,7 @@ def _plan_ops(plan) -> Optional[list]:
     if isinstance(plan, str):
         try:
             plan = json.loads(plan)
+        # srt: allow-broad-except(unparsable plan degrades to None; the profiler must never fail the query it observes)
         except Exception:
             return None
     if isinstance(plan, (list, tuple)):

@@ -62,6 +62,7 @@ from . import config
 from . import faults
 from . import flight
 from . import hbm
+from . import lockcheck
 from . import log
 from . import metrics
 from . import profiler
@@ -157,7 +158,7 @@ _FILES: set = {*()}         # disk paths this process created, for the sweep
 # registry lock is held — because listeners take Session locks and a
 # teardown path holds a Session lock while taking the registry lock
 # (table_reclaim): firing inline would be a lock-order inversion.
-_EVENTS_LOCK = threading.Lock()
+_EVENTS_LOCK = lockcheck.make_lock("spill.events")
 _EVENTS: deque = deque()
 _RESIDENCY_LISTENERS: list = []
 
@@ -262,6 +263,7 @@ def note_put(tid: int, table) -> None:
     tid = int(tid)
     try:
         nbytes = int(hbm.table_bytes(table))
+    # srt: allow-broad-except(an unsizeable table is untrackable, not an error; the exact path still owns it)
     except Exception:
         return
     with _REG_LOCK:
@@ -324,6 +326,7 @@ def _drop_backing(write, path) -> None:
     if write is not None:
         try:
             path = write.resolve()
+        # srt: allow-broad-except(a failed IO write left nothing on disk; there is no file to unlink)
         except Exception:
             path = None  # the write itself failed: nothing on disk
     if path:
@@ -410,6 +413,7 @@ def _evictable_locked(tid, entry, exclude, counts) -> bool:
             try:
                 if a.is_deleted():
                     return False  # consumed by a donated executable
+            # srt: allow-broad-except(backends without is_deleted: assume live and evictable)
             except Exception:
                 pass
     return True
@@ -444,6 +448,7 @@ def _evict_one_locked(tid: int, table) -> int:
         for a in _device_arrays(c):
             try:
                 a.delete()
+            # srt: allow-broad-except(aliased or already-deleted device buffer; the host copy is authoritative now)
             except Exception:
                 pass
     _REG_TABLES[tid] = entry
@@ -576,6 +581,10 @@ def request_headroom(
 def _load_cols(entry: SpilledTable) -> list:
     if entry.cols is not None:
         return entry.cols
+    # blocking disk read: the lockcheck shim reports any tracked lock
+    # held across it (holding the registry lock here is deliberate —
+    # the table must not be freeable mid-load — but it must be VISIBLE)
+    lockcheck.note_blocking("spill_disk_read")
     path = entry._write.resolve() if entry._write is not None else entry.path
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(bytes(z["meta"]).decode())

@@ -1,0 +1,302 @@
+"""Dynamic lock-order detector: cycles, sanctioned order, overhead.
+
+The contract (the compute-sanitizer --tool racecheck analog of this
+repo's CI discipline): under ``SPARK_RAPIDS_TPU_LOCKCHECK=on`` every
+tracked package lock records per-thread held sets and a global
+acquisition-order graph; cycles and inversions of the sanctioned
+``registry -> session -> scheduler -> spill`` order are reported
+through the flight/metrics exit planes; and with the flag off an
+acquisition costs one cached generation compare (< 5 µs, the
+metrics-gate overhead class).
+"""
+
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.utils import config, flight, lockcheck
+
+
+@pytest.fixture
+def lockcheck_on():
+    config.set_flag("LOCKCHECK", "1")
+    lockcheck.reset()
+    try:
+        yield
+    finally:
+        config.clear_flag("LOCKCHECK")
+        lockcheck.reset()
+
+
+class TestCycleDetection:
+    def test_two_thread_opposite_order_cycle(self, lockcheck_on):
+        """The canonical deadlock shape: thread 1 takes A then B,
+        thread 2 takes B then A. Serialized by an event so the test
+        never actually deadlocks — the GRAPH still shows the cycle."""
+        a = lockcheck.make_lock("alpha.a")
+        b = lockcheck.make_lock("beta.b")
+        first_done = threading.Event()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+            first_done.set()
+
+        def t2():
+            first_done.wait(5)
+            with b:
+                with a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start(), th2.start()
+        th1.join(5), th2.join(5)
+
+        doc = lockcheck.report()
+        assert "alpha.a->beta.b" in doc["edges"]
+        assert "beta.b->alpha.a" in doc["edges"]
+        assert doc["cycles"], doc
+        with pytest.raises(AssertionError, match="cycles"):
+            lockcheck.assert_clean()
+
+    def test_consistent_order_no_cycle(self, lockcheck_on):
+        a = lockcheck.make_lock("alpha.a")
+        b = lockcheck.make_lock("beta.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        doc = lockcheck.assert_clean()
+        assert doc["edges"]["alpha.a->beta.b"]["count"] == 3
+        assert doc["cycles"] == []
+
+
+class TestSanctionedOrder:
+    def test_inversion_reported(self, lockcheck_on):
+        spill = lockcheck.make_lock("spill.events")
+        registry = lockcheck.make_lock("registry.resident")
+        with spill:
+            with registry:  # spill (rank 3) held while taking rank 0
+                pass
+        doc = lockcheck.report()
+        assert len(doc["order_violations"]) == 1
+        v = doc["order_violations"][0]
+        assert v["held"] == "spill.events"
+        assert v["acquiring"] == "registry.resident"
+        assert v["order"] == "registry->session->scheduler->spill"
+        with pytest.raises(AssertionError, match="order_violations"):
+            lockcheck.assert_clean()
+
+    def test_sanctioned_direction_clean(self, lockcheck_on):
+        registry = lockcheck.make_lock("registry.resident")
+        session = lockcheck.make_lock("session.state")
+        sched = lockcheck.make_lock("scheduler.queues")
+        spill = lockcheck.make_lock("spill.events")
+        with registry, session, sched, spill:
+            pass
+        doc = lockcheck.assert_clean()
+        assert doc["order_violations"] == []
+
+    def test_unranked_names_never_inversions(self, lockcheck_on):
+        # names outside LOCK_ORDER contribute edges (cycle detection)
+        # but no rank facts
+        z = lockcheck.make_lock("zeta.z")
+        registry = lockcheck.make_lock("registry.r")
+        with z:
+            with registry:
+                pass
+        assert lockcheck.report()["order_violations"] == []
+
+    def test_same_name_instances_not_an_order_fact(self, lockcheck_on):
+        # two sessions each have a session.state lock; holding one
+        # while taking the other is instance layering, not lock order
+        s1 = lockcheck.make_lock("session.state")
+        s2 = lockcheck.make_lock("session.state")
+        with s1:
+            with s2:
+                pass
+        doc = lockcheck.report()
+        assert doc["edges"] == {}
+
+
+class TestPrimitives:
+    def test_rlock_reentry_no_self_edge(self, lockcheck_on):
+        rl = lockcheck.make_rlock("registry.resident")
+        with rl:
+            with rl:  # re-entry: no edge, no violation
+                pass
+        doc = lockcheck.assert_clean()
+        assert doc["edges"] == {}
+
+    def test_held_set_balanced_after_condition_wait(self, lockcheck_on):
+        """A timed-out wait must re-add exactly one held entry — an
+        unbalanced held set would fabricate edges from the CV lock to
+        everything the thread touches afterwards."""
+        lk = lockcheck.make_lock("session.state")
+        cv = lockcheck.make_condition(lk)
+        other = lockcheck.make_lock("alpha.x")
+        with cv:
+            cv.wait(0.01)  # times out; held entry released + re-added
+        with other:
+            pass
+        doc = lockcheck.report()
+        assert "session.state->alpha.x" not in doc["edges"]
+
+    def test_condition_wait_for_wakes(self, lockcheck_on):
+        lk = lockcheck.make_lock("session.state")
+        cv = lockcheck.make_condition(lk)
+        ready = []
+
+        def waker():
+            time.sleep(0.02)
+            with cv:
+                ready.append(1)
+                cv.notify_all()
+
+        th = threading.Thread(target=waker)
+        th.start()
+        with cv:
+            assert cv.wait_for(lambda: ready, timeout=5)
+        th.join(5)
+        lockcheck.assert_clean()
+
+    def test_condition_over_rlock(self, lockcheck_on):
+        rl = lockcheck.make_rlock("registry.resident")
+        cv = lockcheck.make_condition(rl)
+        other = lockcheck.make_lock("alpha.x")
+        with cv:
+            cv.wait(0.01)
+        with other:
+            pass
+        doc = lockcheck.report()
+        assert "registry.resident->alpha.x" not in doc["edges"]
+
+    def test_make_condition_rejects_raw_locks(self):
+        with pytest.raises(TypeError, match="tracked"):
+            lockcheck.make_condition(threading.Lock())
+
+    def test_try_acquire_nonblocking(self, lockcheck_on):
+        lk = lockcheck.make_lock("alpha.a")
+        assert lk.acquire(blocking=False)
+        assert not lk.acquire(blocking=False)
+        lk.release()
+
+
+class TestBlocking:
+    def test_lock_held_across_dispatch_reported(self, lockcheck_on):
+        registry = lockcheck.make_lock("registry.resident")
+        with registry:
+            lockcheck.note_blocking("device_dispatch")
+        doc = lockcheck.report()
+        assert len(doc["held_across_blocking"]) == 1
+        v = doc["held_across_blocking"][0]
+        assert v["kind"] == "device_dispatch"
+        assert v["held"] == ["registry.resident"]
+        # informational by default (the repage-under-registry-lock path
+        # is deliberate) — strict mode fails on it
+        lockcheck.assert_clean()
+        with pytest.raises(AssertionError, match="held_across_blocking"):
+            lockcheck.assert_clean(strict_blocking=True)
+
+    def test_no_held_locks_no_report(self, lockcheck_on):
+        lockcheck.note_blocking("device_dispatch")
+        assert lockcheck.report()["held_across_blocking"] == []
+
+
+class TestOverheadAndGating:
+    def test_disabled_acquisition_under_5us(self):
+        """The acceptance bound: flag off, an acquisition is one cached
+        generation compare — budget < 5 µs each (measured as an
+        acquire+release pair to keep the clock read out of the loop)."""
+        assert not lockcheck.enabled()
+        lk = lockcheck.make_lock("alpha.bench")
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            lk.acquire()
+            lk.release()
+        per_acquisition = (time.perf_counter() - t0) / (2 * n)
+        assert per_acquisition < 5e-6, f"{per_acquisition * 1e6:.2f}us"
+
+    def test_disabled_records_nothing(self):
+        lockcheck.reset()
+        a = lockcheck.make_lock("spill.x")
+        b = lockcheck.make_lock("registry.y")
+        with a:
+            with b:  # would be an inversion if recording
+                pass
+        doc = lockcheck.report()
+        assert doc["edges"] == {} and doc["order_violations"] == []
+
+    def test_flag_flip_takes_effect_via_generation(self, lockcheck_on):
+        lk = lockcheck.make_lock("alpha.a")
+        with lk:
+            pass
+        assert lockcheck.report()["acquisitions"] >= 1
+        config.clear_flag("LOCKCHECK")
+        lockcheck.reset()
+        with lk:
+            pass
+        assert lockcheck.report()["acquisitions"] == 0
+
+
+class TestReporting:
+    def test_exit_section_rides_flight_dump(self, lockcheck_on):
+        config.set_flag("FLIGHT", True)
+        try:
+            with lockcheck.make_lock("alpha.a"):
+                pass
+            snap = flight.snapshot()
+        finally:
+            config.clear_flag("FLIGHT")
+        sec = snap["sections"]["lockcheck"]
+        assert sec["enabled"] is True
+        assert sec["acquisitions"] >= 1
+
+    def test_summary_line_shape(self, lockcheck_on):
+        with lockcheck.make_lock("alpha.a"):
+            pass
+        line = lockcheck.summary_line()
+        assert line.startswith("lockcheck:")
+        assert "cycles" in line and "order violations" in line
+
+    def test_report_folds_lock_metrics(self, lockcheck_on):
+        from spark_rapids_jni_tpu.utils import metrics
+
+        config.set_flag("METRICS", "1")
+        try:
+            s = lockcheck.make_lock("spill.s")
+            r = lockcheck.make_lock("registry.r")
+            with s:
+                with r:
+                    pass
+            lockcheck.report()
+            snap = metrics.snapshot()
+        finally:
+            config.clear_flag("METRICS")
+        assert snap["counters"].get("lock.order_violations") == 1
+        assert snap["gauges"]["lock.tracked_edges"]["value"] == 1
+
+
+class TestRealModuleWiring:
+    """The conversions satellite: the runtime's own locks are tracked
+    under their sanctioned dotted names."""
+
+    def test_registry_and_serving_locks_are_tracked(self):
+        from spark_rapids_jni_tpu import runtime_bridge as rb
+        from spark_rapids_jni_tpu.serving import scheduler as sched_mod
+
+        assert isinstance(rb._RESIDENT_LOCK, lockcheck.TrackedRLock)
+        assert rb._RESIDENT_LOCK.name == "registry.resident"
+        s = sched_mod.FairScheduler(workers=1)
+        assert isinstance(s._lock, lockcheck.TrackedLock)
+        assert s._lock.name == "scheduler.queues"
+
+    def test_session_lock_named(self):
+        from spark_rapids_jni_tpu.serving.session import Session
+
+        sess = Session("sid", "t", 1.0, 1 << 20)
+        assert sess._lock.name == "session.state"

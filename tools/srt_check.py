@@ -1,0 +1,788 @@
+#!/usr/bin/env python3
+"""srt-check — repo-invariant static analyzer for the TPU runtime.
+
+Eleven PRs of CONTRIBUTING prose turned into machine-checked passes:
+the invariants below used to live in reviewers' heads and each of them
+has been violated (or nearly) by a landed PR. This is the repo's
+``compute-sanitizer``/``cuda-memcheck`` CI lane analog (see the README
+parity table) — the static half; the dynamic half is the lock-order
+detector in ``spark_rapids_jni_tpu/utils/lockcheck.py``.
+
+Passes (each emits ``file:line:col`` findings):
+
+* **SRT001 env-outside-config** — ``SPARK_RAPIDS_TPU_*`` environment
+  reads anywhere but ``utils/config.py``. Every knob rides the flag
+  plane (loud-fail parsers, generation-counter cache invalidation); a
+  raw read is invisible to ``set_flag`` and silently un-parsed.
+* **SRT002 broad-except** — ``except Exception``/``BaseException``
+  handlers that swallow or reclassify without routing through the
+  ``faults`` taxonomy and without a bare re-``raise``. Retrying an
+  unclassified failure is how retry storms start (PR 10). Justified
+  sites carry ``# srt: allow-broad-except(<reason>)``.
+* **SRT003 hot-env-read** — any ``os.environ``/``os.getenv`` access
+  inside a function body in the package. Module-level one-time reads
+  are fine; per-call reads are the ~6 µs/op mistake the cached-gate
+  pattern (``config.generation()``) exists to prevent.
+* **SRT004 wallclock-in-replay** — ``time.time``/``datetime.now``/
+  stdlib ``random`` in the determinism-critical modules (fault
+  injection, compile-cache keys, plan fusion): seeded chaos replay and
+  cache-key stability both break the moment a wall clock leaks in.
+* **SRT005 retry-on-donated** — ``run_with_retry`` wrapping a call
+  site that passes ``donate=True``: a donated segment consumed its
+  input buffers, so a replay reads deleted memory. Retry is at-most-
+  once for donated work (PR 5's doomed-replay rule).
+* **SRT006 metric-name** — metric/flight event name literals that
+  don't match the dotted-name convention (``^[a-z0-9_]+(\\.[a-z0-9_]+
+  )*$``) or whose first segment isn't a registered namespace. One
+  typo'd namespace splits a counter across two dashboard rows forever.
+* **SRT007 bench-arm-tier** — every ``bench.py`` arm in
+  ``_SUBPROCESS_CONFIGS`` must declare a tier (headline | extended |
+  manual) in ``_ARM_TIERS``: un-tiered arms are how bench rounds
+  r04/r05 silently blew the ``SRT_BENCH_BUDGET_S`` wall budget
+  (rc=124, headline parsed=null).
+* **SRT000 bad-pragma** — a suppression pragma with a missing reason
+  or an unknown pass name is itself a finding: silent suppression
+  grows back the prose problem this tool replaces.
+
+Pragma grammar (the finding line or the line directly above)::
+
+    # srt: allow-<pass-slug>(<non-empty reason>)
+
+Baseline workflow: ``tools/srt_check_baseline.json`` holds
+fingerprints of grandfathered findings. New findings FAIL (exit 1);
+baselined ones report and burn down (a fixed finding leaves a stale
+baseline entry, listed so it can be pruned with ``--write-baseline``).
+Fingerprints hash (pass, path, enclosing scope, normalized source
+line) — not line numbers — so unrelated edits don't churn the file.
+
+Usage::
+
+    python tools/srt_check.py                  # scan repo, gate on new
+    python tools/srt_check.py --json           # machine-readable
+    python tools/srt_check.py --write-baseline # re-grandfather all
+    python tools/srt_check.py path.py ...      # scan specific files
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "srt_check_baseline.json"
+)
+
+# scan roots relative to the repo root (tests are exempt: test code
+# legitimately monkeypatches environs and provokes broad failures)
+DEFAULT_ROOTS = ("spark_rapids_jni_tpu", "tools", "bench.py")
+
+ENV_PREFIX = "SPARK_RAPIDS_TPU_"
+CONFIG_MODULE = os.path.join("spark_rapids_jni_tpu", "utils", "config.py")
+
+# SRT004 scope: the modules where wall-clock / unseeded randomness
+# breaks seeded replay or cache-key stability
+DETERMINISM_MODULES = (
+    os.path.join("spark_rapids_jni_tpu", "utils", "faults.py"),
+    os.path.join("spark_rapids_jni_tpu", "utils", "buckets.py"),
+    os.path.join("spark_rapids_jni_tpu", "plan.py"),
+)
+
+# the faults-taxonomy vocabulary whose presence in a broad handler
+# counts as "routed through the taxonomy" (SRT002)
+FAULTS_NAMES = frozenset({
+    "faults", "classify", "classify_text", "run_with_retry",
+    "FaultError", "TransientDeviceError", "PermanentError",
+    "ResourceExhausted", "Cancelled", "DeadlineExceeded", "Degraded",
+    "DependencyFailed",
+    # taxonomy entry points: feeding a breaker / the error-class
+    # counters IS routing the failure through the fault plane
+    "note_failure", "note_success", "note_error_class",
+})
+
+# SRT006: registered metric/flight namespace roots. A NEW subsystem
+# registers its namespace here (one line, reviewed) — that is what
+# makes the dotted names "registered" instead of folklore.
+METRIC_NAMESPACES = frozenset({
+    "op", "wire", "resident", "dispatch", "plan", "bucket",
+    "compile_cache", "pipeline", "hbm", "span", "span_ms", "serving",
+    "session", "retry", "faults", "breaker", "fault", "spill", "lock",
+    "shuffle", "distributed", "io", "probe", "bench", "groupby",
+    "join", "sort", "profile", "stream",
+})
+METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+# metrics-registry entry points whose FIRST string arg is a metric
+# name; flight.record's name is its SECOND arg
+METRIC_FNS = frozenset({
+    "counter_add", "bytes_add", "timer_record", "gauge_set",
+    "hist_observe", "self_time_record", "span",
+})
+
+BENCH_TIERS = frozenset({"headline", "extended", "manual"})
+
+# pass -> pragma slug; a suppression comment is "srt:" then
+# "allow-" + slug + "(reason)" (see the module docstring)
+PASS_PRAGMAS = {
+    "SRT001": "env-read",
+    "SRT002": "broad-except",
+    "SRT003": "hot-env",
+    "SRT004": "wallclock",
+    "SRT005": "retry-donated",
+    "SRT006": "metric-name",
+    "SRT007": "untiered-arm",
+}
+PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
+LOOSE_PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-")
+KNOWN_PRAGMAS = frozenset(PASS_PRAGMAS.values())
+
+
+class Finding:
+    __slots__ = ("pass_id", "path", "line", "col", "message",
+                 "fingerprint", "baselined")
+
+    def __init__(self, pass_id: str, path: str, line: int, col: int,
+                 message: str):
+        self.pass_id = pass_id
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.fingerprint = ""
+        self.baselined = False
+
+    def to_doc(self) -> dict:
+        return {
+            "pass": self.pass_id,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "baselined": self.baselined,
+        }
+
+    def render(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.pass_id} {self.message}{tag}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# pragma handling
+# ---------------------------------------------------------------------------
+
+
+class _Pragmas:
+    """Suppression pragmas of one file: line -> (slug, reason).
+
+    Scans REAL comment tokens (via ``tokenize``), not raw line text —
+    a docstring or string literal that happens to quote the pragma
+    grammar (this file's own docs, error messages) is not a pragma.
+    """
+
+    def __init__(self, source: str, relpath: str):
+        self.by_line: Dict[int, Tuple[str, str]] = {}
+        self.bad: List[Finding] = []
+        self.used: set = set()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline
+            ))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # scan_file already reports the syntax error
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            i, col = tok.start
+            text = tok.string
+            m = PRAGMA_RE.search(text)
+            if not m:
+                # a pragma-looking comment that doesn't parse (e.g. no
+                # parens, a typo'd slug shape) is a silent no-op — flag
+                if LOOSE_PRAGMA_RE.search(text):
+                    self.bad.append(Finding(
+                        "SRT000", relpath, i, col,
+                        "malformed srt pragma: expected "
+                        "'# srt: allow-<pass>(<reason>)'",
+                    ))
+                continue
+            slug, reason = m.group(1), m.group(2).strip()
+            if slug not in KNOWN_PRAGMAS:
+                self.bad.append(Finding(
+                    "SRT000", relpath, i, col,
+                    f"unknown srt pragma 'allow-{slug}' (known: "
+                    + ", ".join(
+                        f"allow-{s}" for s in sorted(KNOWN_PRAGMAS)
+                    ) + ")",
+                ))
+                continue
+            if not reason:
+                self.bad.append(Finding(
+                    "SRT000", relpath, i, col,
+                    f"srt pragma 'allow-{slug}' requires a non-empty "
+                    "reason: the justification IS the point",
+                ))
+                continue
+            self.by_line[i] = (slug, reason)
+
+    def suppresses(self, pass_id: str, line: int) -> bool:
+        slug = PASS_PRAGMAS[pass_id]
+        for ln in (line, line - 1):
+            got = self.by_line.get(ln)
+            if got is not None and got[0] == slug:
+                self.used.add(ln)
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """True for the expression ``os.environ``."""
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
+def _env_read_key(node: ast.AST) -> Optional[Tuple[ast.AST, Optional[str]]]:
+    """If ``node`` reads an environment variable, return (node, key or
+    None-when-dynamic); else None. Writes (``os.environ[k] = v``) pass."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        # os.environ.get(...) / os.environ.setdefault(...)
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("get", "setdefault")
+            and _is_environ(f.value)
+        ) or (
+            # os.getenv(...)
+            isinstance(f, ast.Attribute)
+            and f.attr == "getenv"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "os"
+        ):
+            key = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                key = node.args[0].value
+            return node, key
+    if isinstance(node, ast.Subscript) and _is_environ(node.value):
+        if isinstance(node.ctx, ast.Load):
+            key = None
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                key = sl.value
+            return node, key
+    if isinstance(node, ast.Compare) and any(
+        isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+    ):
+        for cand in node.comparators:
+            if _is_environ(cand):
+                key = None
+                if isinstance(node.left, ast.Constant) and isinstance(
+                    node.left.value, str
+                ):
+                    key = node.left.value
+                return node, key
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    """Trailing name of the called function (``a.b.c()`` -> ``c``)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _names_in(tree: ast.AST):
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+            if isinstance(sub.value, ast.Name):
+                yield sub.value.id
+
+
+# ---------------------------------------------------------------------------
+# per-file analysis
+# ---------------------------------------------------------------------------
+
+
+class _FileChecker(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str, pragmas: _Pragmas):
+        self.relpath = relpath
+        self.pragmas = pragmas
+        self.findings: List[Finding] = []
+        self.scope: List[str] = []
+        self.func_depth = 0
+        norm = relpath.replace("/", os.sep)
+        self.in_package = norm.startswith("spark_rapids_jni_tpu" + os.sep)
+        self.is_config = norm == CONFIG_MODULE
+        self.determinism = norm in DETERMINISM_MODULES
+
+    # -- bookkeeping ------------------------------------------------------
+    def _emit(self, pass_id: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if self.pragmas.suppresses(pass_id, line):
+            return
+        self.findings.append(
+            Finding(pass_id, self.relpath, line, col, message)
+        )
+
+    def _scoped(self, name: str, node, is_func: bool):
+        self.scope.append(name)
+        if is_func:
+            self.func_depth += 1
+        self.generic_visit(node)
+        if is_func:
+            self.func_depth -= 1
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._scoped(node.name, node, True)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._scoped(node.name, node, True)
+
+    def visit_Lambda(self, node):
+        self.func_depth += 1
+        self.generic_visit(node)
+        self.func_depth -= 1
+
+    def visit_ClassDef(self, node):
+        self._scoped(node.name, node, False)
+
+    # -- SRT001 / SRT003: env reads ---------------------------------------
+    def _check_env(self, node) -> None:
+        got = _env_read_key(node)
+        if got is None:
+            return
+        _, key = got
+        if key is not None and key.startswith(ENV_PREFIX) \
+                and not self.is_config:
+            self._emit(
+                "SRT001", node,
+                f"{key} read outside utils/config.py — declare a Flag "
+                "and use config.get_flag (loud-fail parse + generation-"
+                "cached gates)",
+            )
+            return  # one finding per site; SRT003 would double-report
+        if self.in_package and not self.is_config and self.func_depth > 0:
+            self._emit(
+                "SRT003", node,
+                "environ read inside a function body — per-call env "
+                "reads cost ~6us each; cache on config.generation() "
+                "(the metrics-gate pattern) or read once at module "
+                "scope",
+            )
+
+    def visit_Subscript(self, node):
+        self._check_env(node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        self._check_env(node)
+        self.generic_visit(node)
+
+    # -- SRT002: broad excepts --------------------------------------------
+    def _broad_types(self, node: ast.ExceptHandler) -> List[str]:
+        out = []
+        t = node.type
+        cands = t.elts if isinstance(t, ast.Tuple) else [t]
+        for c in cands:
+            if isinstance(c, ast.Name) and c.id in (
+                "Exception", "BaseException"
+            ):
+                out.append(c.id)
+        return out
+
+    def visit_ExceptHandler(self, node):
+        # SRT002 applies to the runtime package, where the faults
+        # taxonomy lives; bench.py / tools are offline drivers whose
+        # broad excepts are best-effort harness resilience by design
+        broad = (
+            self._broad_types(node)
+            if node.type is not None and self.in_package else []
+        )
+        if broad:
+            body_names = set()
+            reraises = False
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Raise) and sub.exc is None:
+                        reraises = True
+                body_names.update(
+                    n for stmt2 in [stmt] for n in _names_in(stmt2)
+                )
+            if not reraises and not (body_names & FAULTS_NAMES):
+                self._emit(
+                    "SRT002", node,
+                    f"broad 'except {'/'.join(broad)}' neither "
+                    "re-raises nor routes through the faults taxonomy "
+                    "(classify / typed FaultError) — add "
+                    "'# srt: allow-broad-except(<reason>)' if the "
+                    "swallow is deliberate",
+                )
+        self.generic_visit(node)
+
+    # -- SRT004/005/006: calls --------------------------------------------
+    def visit_Call(self, node):
+        self._check_env(node)
+        name = _call_name(node)
+
+        if self.determinism:
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ):
+                mod, attr = f.value.id, f.attr
+                if (mod == "time" and attr in ("time", "time_ns")) or (
+                    mod == "random"
+                ) or (
+                    mod in ("datetime", "date") and attr in (
+                        "now", "utcnow", "today"
+                    )
+                ):
+                    self._emit(
+                        "SRT004", node,
+                        f"{mod}.{attr}() in a determinism-critical "
+                        "module (cache keys / fault-injection "
+                        "decisions): wall clocks and unseeded "
+                        "randomness break seeded chaos replay — hash "
+                        "the (seed, site, index) tuple or use "
+                        "time.monotonic/perf_counter for intervals",
+                    )
+
+        if name == "run_with_retry":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.keyword) and sub.arg in (
+                    "donate", "donate_input", "donate_args"
+                ):
+                    v = sub.value
+                    if not (
+                        isinstance(v, ast.Constant)
+                        and v.value in (False, None)
+                    ):
+                        self._emit(
+                            "SRT005", node,
+                            "run_with_retry wraps a donated call site "
+                            f"({sub.arg}=...): donated segments consume "
+                            "their input buffers, so a replay reads "
+                            "deleted memory — retry must stay at-most-"
+                            "once (gate on the consumed-input check "
+                            "BEFORE the retry loop)",
+                        )
+                        break
+
+        metric_arg = None
+        if name in METRIC_FNS and node.args:
+            metric_arg = node.args[0]
+        elif name == "record" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "flight" and len(node.args) >= 2:
+            metric_arg = node.args[1]
+        if (
+            metric_arg is not None
+            and isinstance(metric_arg, ast.Constant)
+            and isinstance(metric_arg.value, str)
+        ):
+            mname = metric_arg.value
+            if not METRIC_NAME_RE.match(mname):
+                self._emit(
+                    "SRT006", node,
+                    f"metric/flight name {mname!r} is not "
+                    "dotted-lowercase ([a-z0-9_] segments joined "
+                    "by '.')",
+                )
+            elif mname.split(".", 1)[0] not in METRIC_NAMESPACES:
+                self._emit(
+                    "SRT006", node,
+                    f"metric/flight name {mname!r} uses unregistered "
+                    f"namespace {mname.split('.', 1)[0]!r} — register "
+                    "it in tools/srt_check.py METRIC_NAMESPACES (one "
+                    "reviewed line) or reuse an existing namespace",
+                )
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# SRT007: bench arm tier table
+# ---------------------------------------------------------------------------
+
+
+def _dict_str_keys(node: ast.Dict) -> List[Tuple[str, ast.AST]]:
+    out = []
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            out.append((k.value, v))
+    return out
+
+
+def check_bench_tiers(relpath: str, tree: ast.Module,
+                      pragmas: _Pragmas) -> List[Finding]:
+    configs: Optional[ast.Dict] = None
+    tiers: Optional[ast.Dict] = None
+    configs_line = 1
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            if tgt == "_SUBPROCESS_CONFIGS" and isinstance(
+                node.value, ast.Dict
+            ):
+                configs = node.value
+                configs_line = node.lineno
+            elif tgt == "_ARM_TIERS" and isinstance(node.value, ast.Dict):
+                tiers = node.value
+    if configs is None:
+        return []  # not a bench module
+    findings: List[Finding] = []
+
+    def emit(pass_id, node, msg):
+        line = getattr(node, "lineno", configs_line)
+        if not pragmas.suppresses(pass_id, line):
+            findings.append(Finding(
+                pass_id, relpath, line,
+                getattr(node, "col_offset", 0), msg,
+            ))
+
+    if tiers is None:
+        emit(
+            "SRT007", configs,
+            "_SUBPROCESS_CONFIGS has no _ARM_TIERS table: every arm "
+            "must declare headline|extended|manual so the ladder walk "
+            "can budget (r04/r05 rc=124 postmortem)",
+        )
+        return findings
+    arm_names = {k for k, _ in _dict_str_keys(configs)}
+    tier_entries = _dict_str_keys(tiers)
+    tier_names = set()
+    for arm, v in tier_entries:
+        tier_names.add(arm)
+        tier = v.value if isinstance(v, ast.Constant) else None
+        if tier not in BENCH_TIERS:
+            emit(
+                "SRT007", v,
+                f"arm {arm!r} declares invalid tier {tier!r} "
+                f"(must be one of {sorted(BENCH_TIERS)})",
+            )
+        if arm not in arm_names:
+            emit(
+                "SRT007", v,
+                f"_ARM_TIERS names unknown arm {arm!r} (not in "
+                "_SUBPROCESS_CONFIGS) — stale entry?",
+            )
+    for k, v in _dict_str_keys(configs):
+        if k not in tier_names:
+            emit(
+                "SRT007", v,
+                f"bench arm {k!r} missing from _ARM_TIERS: un-tiered "
+                "arms silently eat the SRT_BENCH_BUDGET_S wall budget "
+                "— declare headline|extended|manual",
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def scan_file(path: str, repo_root: str = REPO_ROOT) -> List[Finding]:
+    relpath = os.path.relpath(os.path.abspath(path), repo_root)
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            "SRT000", relpath, e.lineno or 1, e.offset or 0,
+            f"syntax error: {e.msg}",
+        )]
+    lines = source.splitlines()
+    pragmas = _Pragmas(source, relpath)
+    checker = _FileChecker(relpath, source, pragmas)
+    checker.visit(tree)
+    findings = checker.findings
+    findings.extend(check_bench_tiers(relpath, tree, pragmas))
+    findings.extend(pragmas.bad)
+    # fingerprints: (pass, path, scope-less normalized line, occurrence)
+    seen: Dict[str, int] = {}
+    for fd in findings:
+        text = lines[fd.line - 1].strip() if fd.line - 1 < len(lines) else ""
+        base = f"{fd.pass_id}|{fd.path}|{text}"
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        fd.fingerprint = hashlib.sha1(
+            f"{base}|{n}".encode()
+        ).hexdigest()[:16]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.pass_id))
+    return findings
+
+
+def iter_sources(roots: Sequence[str], repo_root: str = REPO_ROOT):
+    for root in roots:
+        full = os.path.join(repo_root, root)
+        if os.path.isfile(full):
+            yield full
+        else:
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def scan_repo(roots: Sequence[str] = DEFAULT_ROOTS,
+              repo_root: str = REPO_ROOT) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_sources(roots, repo_root):
+        findings.extend(scan_file(path, repo_root))
+    return findings
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "fingerprints" not in doc:
+        raise ValueError(
+            f"baseline {path!r} is not a srt-check baseline "
+            "(missing 'fingerprints')"
+        )
+    return dict(doc["fingerprints"])
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    doc = {
+        "version": 1,
+        "tool": "srt-check",
+        "note": (
+            "grandfathered findings: new violations fail CI while "
+            "these burn down. Regenerate with --write-baseline; an "
+            "EMPTY table is the goal state."
+        ),
+        "fingerprints": {
+            f.fingerprint: {
+                "pass": f.pass_id,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+            }
+            for f in findings
+        },
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="srt-check", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to scan "
+                    "(default: the repo's standard roots)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding fails")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-grandfather every current finding and exit")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root for relative paths")
+    args = ap.parse_args(argv)
+
+    if args.paths:
+        findings: List[Finding] = []
+        for p in args.paths:
+            full = p if os.path.isabs(p) else os.path.join(args.root, p)
+            findings.extend(scan_repo([os.path.relpath(full, args.root)],
+                                      args.root)
+                            if os.path.isdir(full)
+                            else scan_file(full, args.root))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.pass_id))
+    else:
+        findings = scan_repo(repo_root=args.root)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(
+            f"srt-check: baseline written to {args.baseline} "
+            f"({len(findings)} findings grandfathered)"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new = 0
+    for f in findings:
+        if f.fingerprint in baseline:
+            f.baselined = True
+        else:
+            new += 1
+    live_fps = {f.fingerprint for f in findings}
+    stale = [fp for fp in baseline if fp not in live_fps]
+
+    files_scanned = len({f.path for f in findings}) if findings else 0
+    summary = (
+        f"srt-check: {len(findings)} finding(s) ({new} new, "
+        f"{len(findings) - new} baselined, {len(stale)} stale baseline "
+        "entr(y/ies))"
+    )
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.to_doc() for f in findings],
+            "counts": {
+                "total": len(findings),
+                "new": new,
+                "baselined": len(findings) - new,
+                "stale_baseline": len(stale),
+                "files_with_findings": files_scanned,
+            },
+            "stale_baseline": stale,
+            "summary": summary,
+        }, indent=1, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        if stale:
+            print(
+                f"srt-check: {len(stale)} baseline entr(y/ies) no "
+                "longer match (fixed or moved) — prune with "
+                "--write-baseline"
+            )
+        print(summary)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
