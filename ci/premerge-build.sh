@@ -28,7 +28,14 @@ build/dependency-check
 # call sites, metric-name conventions, un-tiered bench arms. Exits
 # nonzero on any finding not grandfathered in
 # tools/srt_check_baseline.json; the one-line summary is the last line.
+# SRT008 (dispatch-table/plancheck registry parity) and SRT009 (implicit
+# host-sync hazards in hot paths) ride the same gate.
 python3 tools/srt_check.py
+
+# Plan-literal gate: every plan literal in the bench arms and smoke
+# scripts must tag clean under the plan-time analyzer (the GpuOverrides
+# analog) — a driver must never ship a plan the runtime would reject.
+python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh ci/smoke-spill.sh
 
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).

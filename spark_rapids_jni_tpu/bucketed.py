@@ -218,6 +218,7 @@ def _r_filter(op: dict, table: Table, rest) -> Table:
         _key("filter", op, pt), build, "srt_bucketed_filter"
     )
     out, count = fn(_strip(pt), _n_dev(pt))
+    # srt: allow-host-sync(bucketed-runner boundary: the compiled launch is done; one count read sizes the logical rows of the padded result)
     return _finish(out, int(count))
 
 
@@ -271,6 +272,7 @@ def _r_groupby(op: dict, table: Table, rest) -> Table:
         _key("groupby", op, pt), build, "srt_bucketed_groupby"
     )
     out, num_groups = fn(_strip(pt), _n_dev(pt))
+    # srt: allow-host-sync(bucketed-runner boundary: the compiled launch is done; one count read sizes the logical rows of the padded result)
     return _finish(out, int(num_groups))
 
 
@@ -293,6 +295,7 @@ def _r_distinct(op: dict, table: Table, rest) -> Table:
         _key("distinct", op, pt), build, "srt_bucketed_distinct"
     )
     out, count = fn(_strip(pt), _n_dev(pt))
+    # srt: allow-host-sync(bucketed-runner boundary: the compiled launch is done; one count read sizes the logical rows of the padded result)
     return _finish(out, int(count))
 
 
@@ -321,6 +324,7 @@ def _r_rlike(op: dict, table: Table, rest) -> Table:
         _key("rlike", op, pt), build, "srt_bucketed_rlike"
     )
     out, count = fn(_strip(pt), _n_dev(pt))
+    # srt: allow-host-sync(bucketed-runner boundary: the compiled launch is done; one count read sizes the logical rows of the padded result)
     return _finish(out, int(count))
 
 
@@ -366,6 +370,7 @@ def _r_join(op: dict, table: Table, rest) -> Table:
             "srt_bucketed_join_" + how,
         )
         out, count = fn(_strip(lt), _strip(rt), _n_dev(lt), _n_dev(rt))
+        # srt: allow-host-sync(bucketed-runner boundary: the compiled launch is done; one count read sizes the logical rows of the padded result)
         return _finish(out, int(count))
 
     # inner/left: two-phase sizing. Phase 1 (probe) compiles per input
@@ -394,6 +399,7 @@ def _r_join(op: dict, table: Table, rest) -> Table:
     perm_r, lo, counts, inner_total, left_total = p1(
         _strip(lt), _strip(rt), _n_dev(lt), _n_dev(rt)
     )
+    # srt: allow-host-sync(bucketed-runner boundary: the compiled launch is done; one count read sizes the logical rows of the padded result)
     total = int(left_total if how == "left" else inner_total)
     cap = buckets.bucket_for(total)
     if cap is None:

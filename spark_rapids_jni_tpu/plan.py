@@ -312,6 +312,7 @@ def _run_fused(
         # counted AFTER the launch: a trace/compile failure falls back
         # to per-op replay with the input intact — nothing was donated
         hbm.note_donation(donated)
+    # srt: allow-host-sync(segment boundary: the fused launch is done; the count read is the one sync that sizes the unpadded result)
     return bucketed._finish(out, int(count))
 
 

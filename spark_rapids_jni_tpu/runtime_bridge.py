@@ -582,6 +582,32 @@ def _dispatch_impl(
     raise ValueError(f"unknown table op {name!r}")
 
 
+# Every op key the dispatch chain above accepts. This literal is the
+# dispatch-plane side of the SRT008 registry-parity pair: srt_check
+# verifies (statically) that it matches both the ``name == "..."`` arms
+# of _dispatch_impl and plancheck's inference-rule table, so an op added
+# to one registry without the others fails CI before it can ship.
+DISPATCH_OPS = frozenset(
+    {
+        "join",
+        "concat",
+        "groupby",
+        "sort_by",
+        "filter",
+        "distinct",
+        "cast",
+        "explode",
+        "rlike",
+        "cross_join",
+        "slice",
+        "repeat",
+        "sample",
+        "to_rows",
+        "from_rows",
+    }
+)
+
+
 def _table_from_wire(
     type_ids: Sequence[int],
     scales: Sequence[int],
@@ -733,6 +759,16 @@ def table_plan_wire(
     ops = json.loads(plan_json)
     if not isinstance(ops, list):
         raise TypeError("table_plan_wire: plan must be a JSON list of ops")
+    # static analysis BEFORE the upload: a plan that cannot run costs
+    # zero wire bytes, zero compiles (plancheck.PlanCheckError names the
+    # op index + reason and subclasses ValueError)
+    from . import plancheck
+
+    plancheck.check_plan(
+        ops,
+        schema=plancheck.schema_from_wire(type_ids, scales),
+        rows=int(num_rows),
+    )
     with profiler.maybe_session(ops, label="plan_wire"):
         tbl = _table_from_wire(
             type_ids, scales, datas, valids, num_rows,
@@ -764,6 +800,21 @@ def table_stream_wire(plan_json: str, batches: Sequence) -> list:
         raise TypeError(
             "table_stream_wire: plan must be a JSON list of ops"
         )
+    # static analysis against the first batch's wire schema before any
+    # batch decodes or the pipeline spins up; an empty stream still gets
+    # the structural walk
+    from . import plancheck
+
+    batches = list(batches)
+    if batches:
+        first = batches[0]
+        plancheck.check_plan(
+            ops,
+            schema=plancheck.schema_from_wire(first[0], first[1]),
+            rows=int(first[4]),
+        )
+    else:
+        plancheck.check_plan(ops)
 
     def decode(batch):
         type_ids, scales, datas, valids, num_rows = batch
@@ -775,7 +826,6 @@ def table_stream_wire(plan_json: str, batches: Sequence) -> list:
     def compute(tbl):
         return plan_mod.run_plan(ops, tbl, donate_input=True)
 
-    batches = list(batches)
     with profiler.maybe_session(
         ops, label="stream", batches=len(batches)
     ):
@@ -1106,6 +1156,36 @@ def table_op_resident(
         spill.unpin_ids(table_ids[1:] if donate else table_ids)
 
 
+def _static_check_resident_plan(ops, table_ids: Sequence[int]) -> None:
+    """Plan-time analysis for the resident entry: schemas come from the
+    registry (a peek — no Pending resolution, so an in-flight input
+    degrades the walk to structural validation instead of blocking the
+    enqueue). Raises plancheck.PlanCheckError before any input capture,
+    pin, or pipeline enqueue."""
+    from . import plancheck
+
+    def settled(tid):
+        t = _resident_peek(int(tid))
+        return None if isinstance(t, pipeline.Pending) else t
+
+    head = settled(table_ids[0])
+    rest = []
+    for tid in table_ids[1:]:
+        t = settled(tid)
+        rest.append(
+            (plancheck.schema_of_table(t), int(t.logical_row_count))
+            if t is not None
+            else (None, None)
+        )
+    plancheck.check_plan(
+        ops,
+        schema=plancheck.schema_of_table(head) if head is not None else None,
+        rows=int(head.logical_row_count) if head is not None else None,
+        rest=rest,
+        names=head.names if head is not None else None,
+    )
+
+
 def table_plan_resident(
     plan_json: str, table_ids: Sequence[int], donate: bool = False
 ) -> int:
@@ -1126,6 +1206,11 @@ def table_plan_resident(
     from . import plan as plan_mod
 
     ops = json.loads(plan_json)
+    if not isinstance(ops, list):
+        raise TypeError(
+            "table_plan_resident: plan must be a JSON list of ops"
+        )
+    _static_check_resident_plan(ops, table_ids)
     cell: dict = {}
 
     def work():

@@ -84,8 +84,13 @@ _ERROR_CLASSES = {
 def _raise_error(err: dict) -> None:
     type_ = str(err.get("type", "internal"))
     cls = _ERROR_CLASSES.get(type_, ServingError)
-    raise cls(type_, str(err.get("message", "")),
+    exc = cls(type_, str(err.get("message", "")),
               str(err.get("exception", "")))
+    # a pre-admission static rejection ships its tagged plan report
+    # (plancheck.analyze shape) alongside the message
+    if "plan_report" in err:
+        exc.plan_report = err["plan_report"]
+    raise exc
 
 
 class Client:

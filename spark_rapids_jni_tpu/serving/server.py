@@ -46,7 +46,7 @@ import uuid
 from collections import deque
 from typing import Optional
 
-from .. import pipeline, plan as plan_mod, runtime_bridge as rb
+from .. import pipeline, plan as plan_mod, plancheck, runtime_bridge as rb
 from ..utils import config, faults, flight, hbm, lockcheck, metrics, profiler, spill
 from . import frames
 from .scheduler import Busy, FairScheduler
@@ -92,14 +92,18 @@ def _error_header(exc: BaseException) -> dict:
     msg = str(exc)
     if isinstance(exc, KeyError) and exc.args:
         msg = str(exc.args[0])  # un-repr the KeyError message
-    return {
-        "ok": False,
-        "error": {
-            "type": _error_type(exc),
-            "exception": type(exc).__name__,
-            "message": msg,
-        },
+    err = {
+        "type": _error_type(exc),
+        "exception": type(exc).__name__,
+        "message": msg,
     }
+    # a plancheck rejection carries the full tagged report (per-op tier +
+    # reason, GpuOverrides-style) — ship it so the client learns *why*
+    # before paying upload or queue wait
+    report = getattr(exc, "plan_report", None)
+    if report is not None:
+        err["plan_report"] = report
+    return {"ok": False, "error": err}
 
 
 class Server:
@@ -488,6 +492,20 @@ class Server:
         batches = frames.batches_from_parts(
             header.get("batches") or [], payload
         )
+        # pre-admission static analysis against the first batch's wire
+        # schema: a plan that statically cannot run answers a typed
+        # bad_request (tagged report attached) BEFORE any scheduler
+        # admission, HBM charge, or upload
+        if batches:
+            plancheck.check_plan(
+                ops,
+                schema=plancheck.schema_from_wire(
+                    batches[0][0], batches[0][1]
+                ),
+                rows=int(batches[0][4]),
+            )
+        else:
+            plancheck.check_plan(ops)
         n = len(batches)
         sess.stats["bytes_in"] += len(payload)
         scope = profiler.profile_session(
@@ -611,9 +629,33 @@ class Server:
         # charged) approximates the result; charge it as in-flight
         # until the result's actual size lands as resident
         try:
-            est = int(hbm.table_bytes(rb._resident_get(rb_ids[0])))
+            head = rb._resident_get(rb_ids[0])
         except KeyError:
             raise sess._unknown_local_error(locals_[0])
+        # pre-admission static analysis against the resident schemas: a
+        # statically-invalid plan answers bad_request before admit() or
+        # the scheduler queue. Rest inputs degrade to structural checks
+        # when pending or missing (the runtime surfaces those exactly as
+        # before).
+        rest_sigs = []
+        for rid in rb_ids[1:]:
+            try:
+                t = rb._resident_peek(rid)
+            except KeyError:
+                t = None
+            rest_sigs.append(
+                (plancheck.schema_of_table(t), int(t.logical_row_count))
+                if t is not None and not isinstance(t, pipeline.Pending)
+                else (None, None)
+            )
+        plancheck.check_plan(
+            ops,
+            schema=plancheck.schema_of_table(head),
+            rows=int(head.logical_row_count),
+            rest=rest_sigs,
+            names=head.names,
+        )
+        est = int(hbm.table_bytes(head))
         sess.admit(est)
         plan_json = json.dumps(ops)
         try:

@@ -131,6 +131,58 @@ class TestSigtermTelemetryFlush:
         )
 
 
+class TestBudgetExhaustedRun:
+    def test_zero_budget_run_exits_clean_with_parseable_headline(self):
+        """A fully budget-starved run must still exit 0 with the
+        headline JSON as the final stdout line, every ladder arm
+        recorded as a skipped BudgetExceeded, and the mesh/Arrow tail
+        skipped by its floors instead of starting unbounded work —
+        the repair for the rc=124, parsed=null rounds."""
+        env = dict(os.environ)
+        env.update({
+            "SRT_BENCH_BUDGET_S": "0",
+            "JAX_PLATFORMS": "cpu",
+            "SRT_JAX_PLATFORMS": "cpu",
+        })
+        proc = subprocess.run(
+            [sys.executable, "bench.py"], capture_output=True,
+            text=True, timeout=280, env=env, cwd=_ROOT,
+        )
+        assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+        last = proc.stdout.strip().splitlines()[-1]
+        doc = json.loads(last)
+        assert doc["metric"] == "groupby_sum_100M_int64"
+        by_name = {c["name"]: c for c in doc["configs"]}
+        # every budgeted arm is present as a structured skip record
+        assert set(by_name) == set(bench._LADDER)
+        for c in by_name.values():
+            assert c["failure"]["type"] == "BudgetExceeded"
+            assert c["failure"]["skipped"] is True
+        # the tail floors declined to start the unbounded stages
+        assert "skipping arrow baseline" in proc.stderr
+
+    def test_walk_reserves_a_tail_window(self):
+        # the walk must end early enough that both mesh stages and the
+        # Arrow baseline can still start inside the budget deadline
+        assert bench._TAIL_RESERVE_S > (
+            2 * bench._MESH_STAGE_FLOOR_S + bench._ARROW_FLOOR_S
+        )
+
+    def test_superseded_slow_arms_are_manual(self):
+        # losers of the packed/chunked A/Bs no longer walk: each alone
+        # could eat the whole tail window
+        for arm in (
+            "groupby16m_packed_pallas32",
+            "groupby100m_packed_pallas32",
+            "groupby100m_packed",
+            "groupby100m_chunked",
+        ):
+            assert bench._ARM_TIERS[arm] == "manual"
+            assert arm not in bench._LADDER
+            # still runnable one-off
+            assert arm in bench._SUBPROCESS_CONFIGS
+
+
 class TestEmitGuarantee:
     def test_emit_stores_last_line_parseable(self, capsys):
         bench._emit([{"name": "x", "error": "boom",

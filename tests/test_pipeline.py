@@ -365,18 +365,36 @@ class TestDonationSafety:
         for t in (r1, r2):
             rb.table_free(t)
 
-    def test_donated_async_failure_surfaces_op_error(self):
+    def test_donated_async_failure_surfaces_op_error(self, monkeypatch):
         # non-replayable donated work: the worker's own (genuine) op
         # error is what the blocking point raises — no deleted-buffer
-        # error from a doomed replay
+        # error from a doomed replay. The fault must be injected
+        # mid-flight: a statically-bad plan never reaches the worker —
+        # plancheck rejects it at submit and the donated input survives
+        from spark_rapids_jni_tpu import plan as plan_mod
+
         b, _ = _sync_want(1024)
         config.set_flag("PIPELINE", "2")
         tid = rb.table_upload_wire(*b)
         plan = [
             {"op": "filter", "mask": 2},
             {"op": "cast", "column": 1, "type_id": int(dt.TypeId.FLOAT64)},
-            {"op": "nope_not_an_op"},
         ]
+        with pytest.raises(ValueError, match="plancheck: op\\[2\\]"):
+            rb.table_plan_resident(
+                json.dumps(plan + [{"op": "nope_not_an_op"}]), [tid],
+                donate=True,
+            )
+        assert rb.table_num_rows(tid) == 1024  # static reject kept it
+
+        real = plan_mod.run_plan
+
+        def boom(ops, table, rest=(), **kw):
+            if threading.current_thread().name.startswith("srt-pipeline"):
+                raise ValueError("unknown table op (injected mid-flight)")
+            return real(ops, table, rest, **kw)
+
+        monkeypatch.setattr(plan_mod, "run_plan", boom)
         out = rb.table_plan_resident(json.dumps(plan), [tid], donate=True)
         with pytest.raises(ValueError, match="unknown table op"):
             rb.table_download_wire(out)

@@ -597,3 +597,88 @@ def test_server_reads_flags_from_config(monkeypatch):
     srv = serving.Server()
     assert srv.max_sessions == 1
     assert srv.queue_depth == 5
+
+
+# ---------------------------------------------------------------------------
+# pre-admission static plan analysis (plancheck): a statically-invalid
+# or malformed plan answers a typed bad_request BEFORE the scheduler
+# queue, with zero uploads/compiles and the tagged report attached
+# ---------------------------------------------------------------------------
+
+
+def test_statically_invalid_stream_is_bad_request_before_queue():
+    config.set_flag("METRICS", True)
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="static") as c:
+            metrics.reset()
+            with pytest.raises(serving.ServingError) as ei:
+                c.stream([{"op": "frobnicate"}], [_batch(64)])
+            assert ei.value.type == "bad_request"
+            assert "plancheck: op[0]" in str(ei.value)
+            assert "unknown table op" in str(ei.value)
+            # the tagged report rides the error frame back to the client
+            rep = getattr(ei.value, "plan_report", None)
+            assert rep is not None and rep["ok"] is False
+            assert rep["ops"][0]["tier"] == "unsupported"
+            # zero scheduler admissions, uploads, or compiles happened
+            counters = metrics.snapshot()["counters"]
+            assert counters.get("serving.requests", 0) == 0
+            assert not any(
+                k.startswith(("wire.", "compile_cache.")) for k in counters
+            )
+            # the session survives the rejection: a clean plan runs
+            got = c.stream(CHAIN, [_batch(64)])
+            assert len(got) == 1
+    assert rb.resident_table_count() == 0
+
+
+def test_wire_schema_aware_stream_rejection():
+    # the check runs against the FIRST BATCH's wire schema: a filter
+    # whose mask column is INT64 (not BOOL8) is statically invalid
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="schema") as c:
+            with pytest.raises(serving.ServingError) as ei:
+                c.stream([{"op": "filter", "mask": 0}], [_batch(32)])
+            assert ei.value.type == "bad_request"
+            assert "BOOL8" in str(ei.value)
+    assert rb.resident_table_count() == 0
+
+
+def test_statically_invalid_plan_cmd_is_bad_request_before_queue():
+    config.set_flag("METRICS", True)
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="resident") as c:
+            tid = c.upload(_batch(64))
+            metrics.reset()
+            with pytest.raises(serving.ServingError) as ei:
+                c.plan([{"op": "groupby", "by": [17],
+                         "aggs": [{"column": 0, "agg": "sum"}]}], [tid])
+            assert ei.value.type == "bad_request"
+            assert "plancheck: op[0]" in str(ei.value)
+            assert "out of range" in str(ei.value)
+            counters = metrics.snapshot()["counters"]
+            assert counters.get("serving.requests", 0) == 0
+            c.free(tid)
+    assert rb.resident_table_count() == 0
+
+
+def test_malformed_plan_frame_is_typed_bad_request():
+    # a raw frame whose plan is not a JSON list (the Client API cannot
+    # even send this shape) must answer bad_request, not kill the conn
+    from spark_rapids_jni_tpu.serving import frames
+
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="mal") as c:
+            for bad in ({"op": "cast"}, "nope", 17):
+                frames.send_frame(
+                    c._sock,
+                    {"cmd": "stream", "plan": bad, "batches": []}, [],
+                )
+                resp, _ = frames.recv_frame(c._sock)
+                assert resp["ok"] is False
+                assert resp["error"]["type"] == "bad_request"
+                assert "JSON list" in resp["error"]["message"]
+            # connection still usable after all three rejections
+            got = c.stream(CHAIN, [_batch(32)])
+            assert len(got) == 1
+    assert rb.resident_table_count() == 0

@@ -437,3 +437,254 @@ class TestRepoClean:
             os.path.join(REPO_ROOT, "bench.py"), REPO_ROOT
         )
         assert [f for f in findings if f.pass_id == "SRT007"] == []
+
+
+class TestHostSync:
+    def test_item_flagged_in_hot_module(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/plan.py", """
+            def f(col):
+                return col.data.item()
+        """)
+        assert passes_of(got) == ["SRT009"]
+        assert "sync" in got[0].message
+
+    def test_int_over_device_local_flagged(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/bucketed.py", """
+            import jax.numpy as jnp
+
+            def f(a):
+                count = jnp.sum(a)
+                return int(count)
+        """)
+        assert passes_of(got) == ["SRT009"]
+        assert "int()" in got[0].message
+
+    def test_np_asarray_flagged(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/plan.py", """
+            import numpy as np
+
+            def f(x):
+                return np.asarray(x)
+        """)
+        assert passes_of(got) == ["SRT009"]
+
+    def test_host_attr_reads_are_clean(self, tmp_path):
+        # Table/Column bookkeeping is host data — int() over it is free
+        got = scan(tmp_path, f"{PKG}/plan.py", """
+            def f(table):
+                n = int(table.row_count)
+                m = int(table.logical_row_count)
+                return n + m
+        """)
+        assert got == []
+
+    def test_host_call_results_are_clean(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/plan.py", """
+            def f(xs, table):
+                n = len(xs)
+                b = int(table_bytes(table))
+                return int(n) + b
+        """)
+        assert got == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/plan.py", """
+            import jax.numpy as jnp
+
+            def f(a):
+                count = jnp.sum(a)
+                # srt: allow-host-sync(segment boundary: one sizing read)
+                return int(count)
+        """)
+        assert got == []
+
+    def test_only_hot_modules_in_scope(self, tmp_path):
+        # outside plan.py/bucketed.py a sync is someone else's problem
+        got = scan(tmp_path, f"{PKG}/ops/foo.py", """
+            def f(col):
+                return col.data.item()
+        """)
+        assert got == []
+
+    def test_rebound_host_local_is_clean(self, tmp_path):
+        # a name rebound from device to host drops out of the taint set
+        got = scan(tmp_path, f"{PKG}/plan.py", """
+            import jax.numpy as jnp
+
+            def f(a):
+                x = jnp.sum(a)
+                x = len([1])
+                return int(x)
+        """)
+        assert got == []
+
+
+class TestDispatchParity:
+    PLANCHECK_OK = """
+        _RULES = {
+            "cast": None,
+            "filter": None,
+        }
+    """
+    DISPATCH_OK = """
+        DISPATCH_OPS = frozenset({"cast", "filter"})
+
+        def _dispatch_impl(name, op):
+            if name == "cast":
+                return 1
+            if name == "filter":
+                return 2
+            raise ValueError(f"unknown table op {name!r}")
+    """
+
+    def _plancheck(self, tmp_path, src=None):
+        full = tmp_path / PKG / "plancheck.py"
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src or self.PLANCHECK_OK))
+
+    def test_three_way_parity_clean(self, tmp_path):
+        self._plancheck(tmp_path)
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", self.DISPATCH_OK)
+        assert got == []
+
+    def test_arm_missing_from_dispatch_ops(self, tmp_path):
+        self._plancheck(tmp_path)
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", """
+            DISPATCH_OPS = frozenset({"cast", "filter"})
+
+            def _dispatch_impl(name, op):
+                if name == "cast":
+                    return 1
+                if name == "filter":
+                    return 2
+                if name == "explode":
+                    return 3
+                raise ValueError(f"unknown table op {name!r}")
+        """)
+        msgs = [f.message for f in got]
+        assert passes_of(got) == ["SRT008"]
+        assert "dispatch arm 'explode' missing from DISPATCH_OPS" in msgs[0]
+
+    def test_stale_dispatch_ops_entry(self, tmp_path):
+        self._plancheck(tmp_path, """
+            _RULES = {"cast": None, "filter": None, "repeat": None}
+        """)
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", """
+            DISPATCH_OPS = frozenset({"cast", "filter", "repeat"})
+
+            def _dispatch_impl(name, op):
+                if name == "cast":
+                    return 1
+                if name == "filter":
+                    return 2
+                raise ValueError(f"unknown table op {name!r}")
+        """)
+        assert passes_of(got) == ["SRT008"]
+        assert "stale" in got[0].message
+
+    def test_dispatch_op_without_plancheck_rule(self, tmp_path):
+        self._plancheck(tmp_path, """
+            _RULES = {"cast": None}
+        """)
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", self.DISPATCH_OK)
+        assert passes_of(got) == ["SRT008"]
+        assert "no plancheck inference rule" in got[0].message
+
+    def test_plancheck_rule_without_dispatch_arm(self, tmp_path):
+        self._plancheck(tmp_path, """
+            _RULES = {"cast": None, "filter": None, "ghost": None}
+        """)
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", self.DISPATCH_OK)
+        assert passes_of(got) == ["SRT008"]
+        assert "plancheck rule 'ghost' has no dispatch arm" \
+            in got[0].message
+
+    def test_missing_plancheck_module(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", self.DISPATCH_OK)
+        assert passes_of(got) == ["SRT008"]
+        assert "no sibling plancheck.py" in got[0].message
+
+    def test_non_literal_dispatch_ops(self, tmp_path):
+        self._plancheck(tmp_path)
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", """
+            _OPS = ["cast"]
+            DISPATCH_OPS = frozenset(_OPS)
+
+            def _dispatch_impl(name, op):
+                if name == "cast":
+                    return 1
+                raise ValueError(f"unknown table op {name!r}")
+        """)
+        assert passes_of(got) == ["SRT008"]
+        assert "pure string-literal" in got[0].message
+
+    def test_pragma_suppresses(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/runtime_bridge.py", """
+            # srt: allow-dispatch-parity(migration window: rules land next)
+            DISPATCH_OPS = frozenset({"cast"})
+
+            def _dispatch_impl(name, op):
+                if name == "cast":
+                    return 1
+                raise ValueError(f"unknown table op {name!r}")
+        """)
+        assert got == []
+
+    def test_non_dispatch_modules_exempt(self, tmp_path):
+        # a module with only one of the two anchors is not the dispatch
+        # plane; the pass stays quiet
+        got = scan(tmp_path, f"{PKG}/other.py", """
+            DISPATCH_OPS = frozenset({"cast"})
+        """)
+        assert got == []
+
+    def test_real_repo_three_way_parity_holds(self):
+        findings = srt.scan_file(
+            os.path.join(REPO_ROOT, PKG, "runtime_bridge.py"), REPO_ROOT
+        )
+        assert [f for f in findings if f.pass_id == "SRT008"] == []
+
+
+class TestPruneBaseline:
+    def test_prune_drops_only_stale_entries(self, tmp_path, capsys):
+        full = tmp_path / PKG / "foo.py"
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent("""
+            import os
+            A = os.environ.get("SPARK_RAPIDS_TPU_A")
+            B = os.environ.get("SPARK_RAPIDS_TPU_B")
+        """))
+        bl = tmp_path / "baseline.json"
+        argv = [f"{PKG}/foo.py", "--root", str(tmp_path),
+                "--baseline", str(bl)]
+        assert srt.main(argv + ["--write-baseline"]) == 0
+        assert len(json.loads(bl.read_text())["fingerprints"]) == 2
+        # fix ONE violation: its fingerprint goes stale
+        full.write_text(textwrap.dedent("""
+            import os
+            A = os.environ.get("SPARK_RAPIDS_TPU_A")
+        """))
+        assert srt.main(argv + ["--prune-baseline"]) == 0
+        out = capsys.readouterr().out
+        assert "pruned 1 stale" in out
+        doc = json.loads(bl.read_text())
+        # the still-live grandfathered entry survives the prune
+        assert len(doc["fingerprints"]) == 1
+        assert srt.main(argv) == 0  # gate still green afterwards
+
+    def test_prune_without_stale_is_a_noop(self, tmp_path):
+        full = tmp_path / PKG / "foo.py"
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(
+            'import os\nV = os.environ.get("SPARK_RAPIDS_TPU_V")\n'
+        )
+        bl = tmp_path / "baseline.json"
+        argv = [f"{PKG}/foo.py", "--root", str(tmp_path),
+                "--baseline", str(bl)]
+        srt.main(argv + ["--write-baseline"])
+        before = bl.read_text()
+        assert srt.main(argv + ["--prune-baseline"]) == 0
+        assert bl.read_text() == before
+
+    def test_prune_missing_baseline_is_safe(self, tmp_path):
+        assert srt.prune_baseline(str(tmp_path / "none.json"), set()) == 0

@@ -20,10 +20,20 @@ report ordered on the shared wall clock (profiler.merge_sessions) and
 trace with a process track per dump (tracing.merge_chrome_traces),
 written to ``-o`` (default: merged.trace.json).
 
+``--static`` switches to plan-time analysis: the input is a plan JSON
+file (a list of op objects) rendered as a tagged report — per-op
+support tier + reason, inferred output schema, predicted segmentation
+and the static HBM footprint bound — without executing anything
+(spark_rapids_jni_tpu/plancheck.py, the GpuOverrides tagging analog).
+``--schema`` supplies the input column signature as comma-separated
+tokens (``int64``, ``decimal64:-2``, ``list<int32>``, ``string``...);
+without it the walk is structural only.
+
 Usage:
     python tools/explain.py profile.json
     python tools/explain.py --json profile.json
     python tools/explain.py --merge worker0.json worker1.json -o m.json
+    python tools/explain.py --static plan.json --schema int64,bool8 --rows 4096
 """
 
 from __future__ import annotations
@@ -66,6 +76,53 @@ def load_doc(path: str):
         if doc is None:
             raise
         return doc
+
+
+def parse_schema_tokens(spec: str):
+    """``int64,decimal64:-2,list<int32>,string`` -> [ColType, ...]."""
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import plancheck
+
+    cols = []
+    for raw in spec.split(","):
+        tok = raw.strip()
+        if not tok:
+            continue
+        scale = 0
+        child = None
+        if tok.lower().startswith("list<") and tok.endswith(">"):
+            child = dt.TypeId[tok[5:-1].strip().upper()]
+            tid = dt.TypeId.LIST
+        else:
+            if ":" in tok:
+                tok, scale_s = tok.split(":", 1)
+                scale = int(scale_s)
+            tid = dt.TypeId[tok.strip().upper()]
+        cols.append(plancheck.ColType(tid, scale, child))
+    return cols
+
+
+def run_static(args) -> int:
+    """--static: tag a plan file without executing it."""
+    from spark_rapids_jni_tpu import plancheck
+
+    rc = 0
+    out = []
+    for path in args.inputs:
+        with open(path) as f:
+            ops = json.load(f)
+        schema = (
+            parse_schema_tokens(args.schema) if args.schema else None
+        )
+        report = plancheck.analyze(ops, schema=schema, rows=args.rows)
+        if args.as_json:
+            out.append(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            out.append(f"== {path} ==\n" + plancheck.render_report(report))
+        if not report["ok"]:
+            rc = 1
+    print("\n\n".join(out))
+    return rc
 
 
 def _ms(seconds) -> str:
@@ -225,7 +282,25 @@ def main(argv=None) -> int:
         help="merged Perfetto trace path (with --merge; default: "
         "merged.trace.json)",
     )
+    ap.add_argument(
+        "--static", action="store_true",
+        help="inputs are plan JSON files: render the plancheck tagged "
+        "report (tiers, inferred schemas, predicted segments, HBM "
+        "bound) without executing; exit 1 if any plan is rejected",
+    )
+    ap.add_argument(
+        "--schema",
+        help="with --static: input column signature, comma-separated "
+        "(int64, decimal64:-2, list<int32>, string, ...)",
+    )
+    ap.add_argument(
+        "--rows", type=int,
+        help="with --static: input row-count bound for the footprint "
+        "estimate",
+    )
     args = ap.parse_args(argv)
+    if args.static:
+        return run_static(args)
     if len(args.inputs) > 1 and not args.merge:
         args.merge = True
     docs = [load_doc(p) for p in args.inputs]

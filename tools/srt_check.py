@@ -40,6 +40,20 @@ Passes (each emits ``file:line:col`` findings):
   manual) in ``_ARM_TIERS``: un-tiered arms are how bench rounds
   r04/r05 silently blew the ``SRT_BENCH_BUDGET_S`` wall budget
   (rc=124, headline parsed=null).
+* **SRT008 dispatch-parity** — the three op registries of the dispatch
+  plane (``runtime_bridge.DISPATCH_OPS``, the ``name == "..."`` arms
+  of ``_dispatch_impl``, and ``plancheck._RULES``) must hold exactly
+  the same op keys: an op added to the dispatcher without a plancheck
+  inference rule would make the plan-time analyzer reject (or
+  mis-infer) a runnable plan — the GpuOverrides-tag/exec drift bug
+  class, caught statically.
+* **SRT009 host-sync** — implicit device->host synchronizations in the
+  hot dispatch modules (``plan.py``, ``bucketed.py``): ``bool()``/
+  ``int()``/``float()`` over device values (``.data``/``.validity``/
+  ``.lengths`` attributes, locals bound from device-producing calls),
+  ``.item()``, and ``np.asarray`` on non-constants. Each sync stalls
+  the launch pipeline; deliberate ones (the exact path's row-count
+  reads) carry ``# srt: allow-host-sync(<reason>)``.
 * **SRT000 bad-pragma** — a suppression pragma with a missing reason
   or an unknown pass name is itself a finding: silent suppression
   grows back the prose problem this tool replaces.
@@ -96,6 +110,37 @@ DETERMINISM_MODULES = (
     os.path.join("spark_rapids_jni_tpu", "plan.py"),
 )
 
+# SRT009 scope: the hot dispatch modules where an implicit host sync
+# stalls the launch pipeline (each one blocks until the device drains)
+HOT_SYNC_MODULES = (
+    os.path.join("spark_rapids_jni_tpu", "plan.py"),
+    os.path.join("spark_rapids_jni_tpu", "bucketed.py"),
+)
+
+# attribute names that denote DEVICE buffers on a Column/Table — an
+# int()/bool()/float() over an expression touching one is a sync
+DEVICE_ATTRS = frozenset({"data", "validity", "lengths", "offsets"})
+
+# attribute reads that are HOST scalars even on device-holding objects
+# (Table/Column bookkeeping) — reading one is not a sync
+HOST_ATTRS = frozenset({
+    "row_count", "logical_row_count", "logical_rows", "names",
+    "dtype", "scale", "id", "shape", "ndim", "size",
+})
+
+# call names whose result is a HOST value: assigning a local from one
+# of these does NOT mark it device (everything else conservatively
+# does — in the hot modules most call results are jax arrays)
+HOST_CALLS = frozenset({
+    "int", "float", "bool", "str", "len", "range", "enumerate", "zip",
+    "list", "tuple", "dict", "set", "sorted", "min", "max", "sum",
+    "abs", "get", "isinstance", "getattr", "hasattr", "repr", "format",
+    "join", "split", "append", "pop", "keys", "values", "items",
+    "perf_counter", "monotonic", "bucket_for", "enabled", "get_flag",
+    "generation", "segment_plan", "op_fusable", "is_bucketable",
+    "table_bytes", "dumps", "loads",
+})
+
 # the faults-taxonomy vocabulary whose presence in a broad handler
 # counts as "routed through the taxonomy" (SRT002)
 FAULTS_NAMES = frozenset({
@@ -139,6 +184,8 @@ PASS_PRAGMAS = {
     "SRT005": "retry-donated",
     "SRT006": "metric-name",
     "SRT007": "untiered-arm",
+    "SRT008": "dispatch-parity",
+    "SRT009": "host-sync",
 }
 PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
 LOOSE_PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-")
@@ -340,6 +387,11 @@ class _FileChecker(ast.NodeVisitor):
         self.in_package = norm.startswith("spark_rapids_jni_tpu" + os.sep)
         self.is_config = norm == CONFIG_MODULE
         self.determinism = norm in DETERMINISM_MODULES
+        self.hot_sync = norm in HOT_SYNC_MODULES
+        # SRT009: per-function sets of local names bound from
+        # device-producing calls (conservative: any call not in
+        # HOST_CALLS and not itself flagged as a sync)
+        self._device_locals: List[set] = []
 
     # -- bookkeeping ------------------------------------------------------
     def _emit(self, pass_id: str, node: ast.AST, message: str) -> None:
@@ -361,10 +413,14 @@ class _FileChecker(ast.NodeVisitor):
         self.scope.pop()
 
     def visit_FunctionDef(self, node):
+        self._device_locals.append(set())
         self._scoped(node.name, node, True)
+        self._device_locals.pop()
 
     def visit_AsyncFunctionDef(self, node):
+        self._device_locals.append(set())
         self._scoped(node.name, node, True)
+        self._device_locals.pop()
 
     def visit_Lambda(self, node):
         self.func_depth += 1
@@ -447,10 +503,124 @@ class _FileChecker(ast.NodeVisitor):
                 )
         self.generic_visit(node)
 
+    # -- SRT009: implicit host syncs in the hot dispatch modules ----------
+    def _is_device_expr(self, expr: ast.AST) -> bool:
+        """Could ``expr`` hold a device value? Attribute reads of device
+        buffers, locals bound from device-producing calls, and direct
+        jnp/jax calls count; host-scalar attribute reads (row counts,
+        dtypes) and HOST_CALLS results don't."""
+        locals_ = self._device_locals[-1] if self._device_locals else set()
+
+        def dev(n: ast.AST) -> bool:
+            if isinstance(n, ast.Attribute):
+                if n.attr in DEVICE_ATTRS:
+                    return True
+                if n.attr in HOST_ATTRS:
+                    return False  # host bookkeeping on a device object
+                return dev(n.value)
+            if isinstance(n, ast.Name):
+                return n.id in locals_
+            if isinstance(n, ast.Call):
+                root = n.func
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in (
+                    "jnp", "jax", "lax"
+                ):
+                    return True
+                if _call_name(n) in HOST_CALLS:
+                    return False  # host-valued helper
+                return any(dev(a) for a in n.args)
+            return any(dev(c) for c in ast.iter_child_nodes(n))
+
+        return dev(expr)
+
+    def _classify_assign(self, node: ast.Assign) -> None:
+        if not (self.hot_sync and self._device_locals):
+            return
+        v = node.value
+        is_device = False
+        if isinstance(v, ast.Call):
+            root = v.func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in (
+                "jnp", "jax", "lax"
+            ):
+                # jnp.sum/jnp.max/... produce device arrays even though
+                # the bare names shadow HOST_CALLS entries
+                is_device = True
+            else:
+                is_device = _call_name(v) not in HOST_CALLS
+        elif isinstance(v, (ast.Name, ast.Attribute, ast.Subscript,
+                            ast.IfExp, ast.BinOp)):
+            is_device = self._is_device_expr(v)
+        targets: List[str] = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t.id)
+            elif isinstance(t, ast.Tuple):
+                targets.extend(
+                    e.id for e in t.elts if isinstance(e, ast.Name)
+                )
+        locals_ = self._device_locals[-1]
+        for name in targets:
+            if is_device:
+                locals_.add(name)
+            else:
+                locals_.discard(name)
+
+    def visit_Assign(self, node):
+        self._classify_assign(node)
+        self.generic_visit(node)
+
+    def _check_host_sync(self, node: ast.Call, name: str) -> None:
+        if not self.hot_sync or self.func_depth == 0:
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args:
+            self._emit(
+                "SRT009", node,
+                ".item() is an implicit device->host sync (blocks until "
+                "the device drains) — keep the value on device or mark "
+                "a deliberate sync with '# srt: allow-host-sync(<why>)'",
+            )
+            return
+        if (
+            name == "asarray"
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "np"
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            self._emit(
+                "SRT009", node,
+                "np.asarray on a (potentially device) value is an "
+                "implicit transfer+sync in a hot dispatch module — use "
+                "jnp ops, or mark with '# srt: allow-host-sync(<why>)'",
+            )
+            return
+        if (
+            isinstance(f, ast.Name)
+            and f.id in ("bool", "int", "float")
+            and node.args
+            and self._is_device_expr(node.args[0])
+        ):
+            self._emit(
+                "SRT009", node,
+                f"{f.id}() over a device value is an implicit "
+                "device->host sync (stalls the launch pipeline) — "
+                "deliberate syncs carry "
+                "'# srt: allow-host-sync(<why>)'",
+            )
+
     # -- SRT004/005/006: calls --------------------------------------------
     def visit_Call(self, node):
         self._check_env(node)
         name = _call_name(node)
+        self._check_host_sync(node, name)
 
         if self.determinism:
             f = node.func
@@ -606,6 +776,144 @@ def check_bench_tiers(relpath: str, tree: ast.Module,
 
 
 # ---------------------------------------------------------------------------
+# SRT008: dispatch-plane / plancheck registry parity
+# ---------------------------------------------------------------------------
+
+
+def _str_set_literal(node: ast.AST) -> Optional[set]:
+    """``{'a', 'b'}`` / ``frozenset({'a', 'b'})`` / list / tuple of str
+    constants -> the set of strings; None when not a pure literal."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "frozenset" and len(node.args) == 1 \
+            and not node.keywords:
+        node = node.args[0]
+    if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def check_dispatch_parity(relpath: str, tree: ast.Module,
+                          pragmas: _Pragmas,
+                          src_dir: str) -> List[Finding]:
+    """Runs when the scanned module IS the dispatch plane (it defines
+    both ``DISPATCH_OPS`` and ``_dispatch_impl``): the three op
+    registries — the DISPATCH_OPS literal, the ``name == "..."`` arms
+    inside _dispatch_impl, and the sibling ``plancheck.py``'s _RULES
+    table — must hold exactly the same keys. Adding an op to one
+    without the others fails CI here, before the analyzer can reject
+    (or mis-tag) a runnable plan."""
+    ops_assign: Optional[ast.Assign] = None
+    declared: Optional[set] = None
+    impl: Optional[ast.FunctionDef] = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "DISPATCH_OPS":
+            ops_assign = node
+            declared = _str_set_literal(node.value)
+        elif isinstance(node, ast.FunctionDef) \
+                and node.name == "_dispatch_impl":
+            impl = node
+    if ops_assign is None or impl is None:
+        return []  # not the dispatch-plane module
+    findings: List[Finding] = []
+
+    def emit(node, msg):
+        line = getattr(node, "lineno", 1)
+        if not pragmas.suppresses("SRT008", line):
+            findings.append(Finding(
+                "SRT008", relpath, line,
+                getattr(node, "col_offset", 0), msg,
+            ))
+
+    if declared is None:
+        emit(
+            ops_assign,
+            "DISPATCH_OPS must be a pure string-literal frozenset — "
+            "the registry-parity pass reads it statically",
+        )
+        return findings
+
+    # the dispatch arms: `if name == "<op>":` comparisons in the chain
+    arms: set = set()
+    for sub in ast.walk(impl):
+        if (
+            isinstance(sub, ast.Compare)
+            and isinstance(sub.left, ast.Name)
+            and sub.left.id == "name"
+            and len(sub.ops) == 1
+            and isinstance(sub.ops[0], ast.Eq)
+            and isinstance(sub.comparators[0], ast.Constant)
+            and isinstance(sub.comparators[0].value, str)
+        ):
+            arms.add(sub.comparators[0].value)
+
+    for op in sorted(arms - declared):
+        emit(ops_assign,
+             f"dispatch arm {op!r} missing from DISPATCH_OPS")
+    for op in sorted(declared - arms):
+        emit(ops_assign,
+             f"DISPATCH_OPS entry {op!r} has no `name == ...` arm in "
+             "_dispatch_impl — stale entry?")
+
+    # the analyzer side: plancheck._RULES in the sibling module
+    pc_path = os.path.join(src_dir, "plancheck.py")
+    if not os.path.exists(pc_path):
+        emit(
+            ops_assign,
+            "no sibling plancheck.py next to the dispatch plane — "
+            "every dispatch op needs a plan-time inference rule",
+        )
+        return findings
+    try:
+        with open(pc_path, "r", encoding="utf-8") as f:
+            pc_tree = ast.parse(f.read(), filename=pc_path)
+    except SyntaxError:
+        return findings  # plancheck.py's own scan reports the error
+    rules: Optional[set] = None
+    rules_line = 1
+    for node in pc_tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "_RULES" \
+                and isinstance(node.value, ast.Dict):
+            rules_line = node.lineno
+            rules = set()
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                    k.value, str
+                ):
+                    rules.add(k.value)
+    if rules is None:
+        emit(
+            ops_assign,
+            "plancheck.py has no literal _RULES table — the parity "
+            "pass (and the analyzer) need one rule per dispatch op",
+        )
+        return findings
+    for op in sorted(declared - rules):
+        emit(
+            ops_assign,
+            f"dispatch op {op!r} has no plancheck inference rule "
+            f"(plancheck.py _RULES, line {rules_line}) — teach the "
+            "analyzer before (or with) the dispatcher",
+        )
+    for op in sorted(rules - declared):
+        emit(
+            ops_assign,
+            f"plancheck rule {op!r} has no dispatch arm — the analyzer "
+            "would tag an op the runtime cannot execute",
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -627,6 +935,10 @@ def scan_file(path: str, repo_root: str = REPO_ROOT) -> List[Finding]:
     checker.visit(tree)
     findings = checker.findings
     findings.extend(check_bench_tiers(relpath, tree, pragmas))
+    findings.extend(check_dispatch_parity(
+        relpath, tree, pragmas,
+        os.path.dirname(os.path.abspath(path)),
+    ))
     findings.extend(pragmas.bad)
     # fingerprints: (pass, path, scope-less normalized line, occurrence)
     seen: Dict[str, int] = {}
@@ -703,6 +1015,26 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
         f.write("\n")
 
 
+def prune_baseline(path: str, live_fps) -> int:
+    """Drop baseline fingerprints that no longer match any finding;
+    returns how many were removed. The doc is rewritten in place with
+    everything else (version, note) preserved."""
+    if not os.path.exists(path):
+        return 0
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    fps = doc.get("fingerprints", {})
+    stale = [fp for fp in fps if fp not in live_fps]
+    if not stale:
+        return 0
+    for fp in stale:
+        del fps[fp]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(stale)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="srt-check", description=__doc__.splitlines()[0]
@@ -717,6 +1049,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="ignore the baseline: every finding fails")
     ap.add_argument("--write-baseline", action="store_true",
                     help="re-grandfather every current finding and exit")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop stale fingerprints from the baseline in "
+                    "place (keeps grandfathered entries that still "
+                    "match) and continue the normal gate")
     ap.add_argument("--root", default=REPO_ROOT,
                     help="repo root for relative paths")
     args = ap.parse_args(argv)
@@ -750,6 +1086,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             new += 1
     live_fps = {f.fingerprint for f in findings}
     stale = [fp for fp in baseline if fp not in live_fps]
+    if args.prune_baseline and stale:
+        removed = prune_baseline(args.baseline, live_fps)
+        print(
+            f"srt-check: pruned {removed} stale baseline entr(y/ies) "
+            f"from {args.baseline}"
+        )
+        stale = []
 
     files_scanned = len({f.path for f in findings}) if findings else 0
     summary = (
@@ -778,7 +1121,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(
                 f"srt-check: {len(stale)} baseline entr(y/ies) no "
                 "longer match (fixed or moved) — prune with "
-                "--write-baseline"
+                "--prune-baseline"
             )
         print(summary)
     return 1 if new else 0
