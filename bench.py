@@ -1303,7 +1303,9 @@ def bench_serving_multiquery(platform, n_sessions=3, n_batches=5):
             for s in srv._sessions.values()
             for wt in list(s._waits)
         )
-        docs = srv.stats()["sessions"]
+        stats_doc = srv.stats()
+        docs = stats_doc["sessions"]
+        durability = stats_doc.get("durability", {})
         for c in clients:
             c.close()
     if errs:
@@ -1359,6 +1361,10 @@ def bench_serving_multiquery(platform, n_sessions=3, n_batches=5):
                 for d in docs
             ],
             "leaked_tables": leaked,
+            # the durable-plane doc (ISSUE 14): checkpoint/restore
+            # counters when SPARK_RAPIDS_TPU_DURABLE=on, and proof the
+            # default run carries no journaling cost (enabled: False)
+            "durability": durability,
         },
         "platform": platform,
     }

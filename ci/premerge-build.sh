@@ -35,7 +35,8 @@ python3 tools/srt_check.py
 # Plan-literal gate: every plan literal in the bench arms and smoke
 # scripts must tag clean under the plan-time analyzer (the GpuOverrides
 # analog) — a driver must never ship a plan the runtime would reject.
-python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh ci/smoke-spill.sh
+python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh \
+  ci/smoke-spill.sh ci/smoke-restart.sh
 
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).
@@ -78,6 +79,13 @@ bash ci/smoke-chaos.sh
 # tables host->disk (zero sheds), re-promote them on re-access, and
 # leak zero tables and zero spill files.
 bash ci/smoke-spill.sh
+
+# Restart smoke: a durable daemon SIGKILLed mid-stream must restore
+# every session from its journals before accepting traffic — clients
+# reconnect with resume tokens to byte-identical tables, replayed
+# request ids apply nothing new, and replayed plans land on the
+# manifest-warmed compile cache with zero misses.
+bash ci/smoke-restart.sh
 
 # Bench smoke on whatever device this node has.
 python3 bench.py

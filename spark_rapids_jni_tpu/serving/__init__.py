@@ -16,14 +16,24 @@ from .client import (  # noqa: F401
     Client,
     ServingBusy,
     ServingCancelled,
+    ServingCheckpointCorrupt,
     ServingDeadlineExceeded,
     ServingDegraded,
+    ServingDraining,
     ServingError,
     ServingOverBudget,
+    ServingQuarantined,
     ServingResourceExhausted,
+    ServingResumeDenied,
     ServingSessionLimit,
     ServingTableError,
     ServingTransientError,
+)
+from .durable import (  # noqa: F401
+    CheckpointCorrupt,
+    Draining,
+    ResumeDenied,
+    SessionQuarantined,
 )
 from .scheduler import Busy, FairScheduler, Ticket  # noqa: F401
 from .server import Server, SessionLimit, serve  # noqa: F401

@@ -68,6 +68,24 @@ class ServingTransientError(ServingError):
     safe to retry client-side."""
 
 
+class ServingResumeDenied(ServingError):
+    """A reconnect hello carried a missing or wrong resume token."""
+
+
+class ServingQuarantined(ServingError):
+    """The session's durable state was quarantined during restore —
+    its tables are unrecoverable; open a fresh session."""
+
+
+class ServingDraining(ServingError):
+    """The daemon is draining for a rolling restart: reconnect to its
+    replacement (or retry after the restart)."""
+
+
+class ServingCheckpointCorrupt(ServingError):
+    """Durable state failed an integrity check server-side."""
+
+
 _ERROR_CLASSES = {
     "busy": ServingBusy,
     "over_budget": ServingOverBudget,
@@ -78,6 +96,10 @@ _ERROR_CLASSES = {
     "deadline_exceeded": ServingDeadlineExceeded,
     "resource_exhausted": ServingResourceExhausted,
     "transient_device": ServingTransientError,
+    "resume_denied": ServingResumeDenied,
+    "session_quarantined": ServingQuarantined,
+    "draining": ServingDraining,
+    "checkpoint_corrupt": ServingCheckpointCorrupt,
 }
 
 
@@ -101,12 +123,13 @@ class Client:
     def __init__(self, port: int, host: str = "127.0.0.1",
                  name: Optional[str] = None, weight: float = 1.0,
                  session: Optional[str] = None, timeout: float = 60.0,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 resume: Optional[str] = None):
         self._addr = (host, int(port))
         self._hello = {
             k: v for k, v in (
                 ("name", name), ("weight", weight), ("session", session),
-                ("deadline_s", deadline_s),
+                ("deadline_s", deadline_s), ("resume", resume),
             ) if v is not None
         }
         self._timeout = timeout
@@ -115,6 +138,9 @@ class Client:
         self.name: Optional[str] = None
         self.budget_bytes: Optional[int] = None
         self.queue_depth: Optional[int] = None
+        # durable daemons hand out a resume token at open: the secret
+        # a reconnect presents to re-attach to this session
+        self.resume_token: Optional[str] = resume
 
     # -- lifecycle --------------------------------------------------------
     def connect(self) -> "Client":
@@ -126,7 +152,21 @@ class Client:
         self.name = resp.get("name")
         self.budget_bytes = resp.get("budget_bytes")
         self.queue_depth = resp.get("queue_depth")
+        if resp.get("resume_token") is not None:
+            self.resume_token = resp["resume_token"]
         return self
+
+    def reconnect(self) -> "Client":
+        """Re-attach to the SAME session after a socket loss (or a
+        daemon restart): fresh connection, hello carrying the session
+        id + resume token. Pair with per-request ids (``req=``) on
+        mutating commands for at-most-once semantics across the gap."""
+        self.kill()
+        if self.session is not None:
+            self._hello["session"] = self.session
+            if self.resume_token is not None:
+                self._hello["resume"] = self.resume_token
+        return self.connect()
 
     def close(self) -> None:
         """Graceful detach: bye + socket close (idempotent)."""
@@ -185,20 +225,26 @@ class Client:
             resp.get("results") or [], resp["_payload"]
         )
 
-    def upload(self, batch) -> int:
+    def upload(self, batch, req: Optional[str] = None) -> int:
         meta, buffers = frames.batch_to_parts(batch)
-        resp = self._rpc({"cmd": "upload", "batch": meta}, buffers)
+        header = {"cmd": "upload", "batch": meta}
+        if req is not None:
+            header["req"] = str(req)
+        resp = self._rpc(header, buffers)
         return int(resp["table"])
 
     def plan(self, ops: list, tables: Sequence[int],
              donate: bool = False,
-             deadline_s: Optional[float] = None) -> int:
+             deadline_s: Optional[float] = None,
+             req: Optional[str] = None) -> int:
         header = {
             "cmd": "plan", "plan": list(ops),
             "tables": [int(t) for t in tables], "donate": bool(donate),
         }
         if deadline_s is not None:
             header["deadline_s"] = float(deadline_s)
+        if req is not None:
+            header["req"] = str(req)
         resp = self._rpc(header)
         return int(resp["table"])
 
@@ -209,9 +255,21 @@ class Client:
         )
         return batch
 
-    def free(self, table: int) -> int:
-        resp = self._rpc({"cmd": "free", "table": int(table)})
+    def free(self, table: int, req: Optional[str] = None) -> int:
+        header = {"cmd": "free", "table": int(table)}
+        if req is not None:
+            header["req"] = str(req)
+        resp = self._rpc(header)
         return int(resp.get("bytes", 0))
 
     def stats(self) -> dict:
         return self._rpc({"cmd": "stats"})["stats"]
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Rolling-restart drain: the daemon stops admitting, finishes
+        in-flight work, checkpoints, answers, and exits. Returns the
+        response (``drained`` False = deadline hit with work left)."""
+        header = {"cmd": "drain"}
+        if deadline_s is not None:
+            header["deadline_s"] = float(deadline_s)
+        return self._rpc(header)

@@ -297,3 +297,25 @@ class FairScheduler:
         with self._lock:
             q = self._queues.get(session.id)
             return len(q) if q else 0
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until no queued or in-flight work remains across every
+        session — the drain barrier for rolling restarts. Returns False
+        if ``timeout`` (seconds) elapsed with work still pending."""
+        deadline = (
+            None if timeout is None else time.monotonic() + float(timeout)
+        )
+        with self._cv:
+            while True:
+                busy = any(self._queues.values()) or any(
+                    n > 0 for n in self._inflight.values()
+                )
+                if not busy:
+                    return True
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)

@@ -39,16 +39,28 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import select
 import socket
 import threading
+import time
 import uuid
 from collections import deque
 from typing import Optional
 
 from .. import pipeline, plan as plan_mod, plancheck, runtime_bridge as rb
-from ..utils import config, faults, flight, hbm, lockcheck, metrics, profiler, spill
-from . import frames
+from ..utils import (
+    config,
+    faults,
+    flight,
+    hbm,
+    lockcheck,
+    log,
+    metrics,
+    profiler,
+    spill,
+)
+from . import durable, frames
 from .scheduler import Busy, FairScheduler
 from .session import (
     OverBudget,
@@ -65,6 +77,10 @@ class SessionLimit(Exception):
 # ordered most-specific first: the fault taxonomy entries must win
 # over any generic base class they might share
 _ERROR_TYPES = {
+    durable.CheckpointCorrupt: "checkpoint_corrupt",
+    durable.ResumeDenied: "resume_denied",
+    durable.SessionQuarantined: "session_quarantined",
+    durable.Draining: "draining",
     faults.Degraded: "degraded",
     faults.Cancelled: "cancelled",
     faults.DeadlineExceeded: "deadline_exceeded",
@@ -149,11 +165,22 @@ class Server:
         self._conns: set = set()
         self._conn_threads: list = []
         self._stopping = False
+        self._stopped = threading.Event()
         self._sessions_served = 0
+        # durable serving plane (serving/durable.py)
+        self._draining = False
+        self._durable_logs: dict = {}   # sid -> durable.SessionLog
+        self._quarantined: dict = {}    # sid -> quarantine reason
+        self._manifest: Optional[durable.Manifest] = None
+        self._restore_doc: Optional[dict] = None
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Server":
         self.scheduler.start()
+        if durable.enabled():
+            # recover BEFORE the listener opens: the first client to
+            # connect sees restored sessions and a warm compile cache
+            self._restore()
         s = socket.create_server(("127.0.0.1", self._port_req))
         self.port = s.getsockname()[1]
         self._listener = s
@@ -177,10 +204,18 @@ class Server:
         pipelined plane."""
         with self._lock:
             if self._stopping:
-                return
-            self._stopping = True
-            conns = list(self._conns)
-            threads = list(self._conn_threads)
+                already = True
+            else:
+                already = False
+                self._stopping = True
+                conns = list(self._conns)
+                threads = list(self._conn_threads)
+        if already:
+            # another stopper (e.g. the drain command's background
+            # shutdown thread) is mid-teardown: wait for it so callers
+            # see a fully-stopped daemon, not a racing one
+            self._stopped.wait(timeout=30)
+            return
         self._probe_stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10)
@@ -211,10 +246,20 @@ class Server:
         for sess in leftovers:
             self.scheduler.unregister(sess)
             sess.teardown()
+        # release journal handles; the files STAY — a stopped (or
+        # drained) durable daemon restores them on its next start
+        with self._lock:
+            dlogs = list(self._durable_logs.values())
+            self._durable_logs.clear()
+        for dlog in dlogs:
+            dlog.close()
+        if self._manifest is not None:
+            self._manifest.close()
         self.scheduler.stop()
         pipeline.drain()
         if flight.enabled():
             flight.record("I", "serving.stop", self.port)
+        self._stopped.set()
 
     def __enter__(self) -> "Server":
         if self.port is None:
@@ -224,6 +269,99 @@ class Server:
     def __exit__(self, exc_type, exc, tb) -> bool:
         self.stop()
         return False
+
+    # -- durable restore --------------------------------------------------
+    def _restore(self) -> None:
+        """Crash recovery, before the listener opens: replay every
+        session journal into a live session (tables repaged from their
+        checkpoint payloads, budgets and HBM accounting re-charged),
+        then warm-start the compile cache from the manifest — the
+        restarted daemon's first request lands on recovered state with
+        zero compiles for previously-served plans. A session whose
+        journal or payloads fail integrity checks is quarantined and
+        skipped; restore itself never crashes the daemon."""
+        t0 = time.perf_counter()
+        with metrics.span("restore"):
+            sessions, quarantined = durable.restore_scan()
+            self._quarantined.update(quarantined)
+            restored = 0
+            for rs in sessions:
+                try:
+                    self._restore_session(rs)
+                    restored += 1
+                except (durable.CheckpointCorrupt, faults.FaultError,
+                        OSError) as e:
+                    durable.quarantine(rs.sid, str(e))
+                    self._quarantined[rs.sid] = str(e)
+            self._manifest = durable.Manifest()
+            compiled, failed = self._manifest.warm_start()
+        self._restore_doc = {
+            "sessions": restored,
+            "quarantined": dict(self._quarantined),
+            "warm_compiles": compiled,
+            "warm_failures": failed,
+            "took_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        }
+        if flight.enabled():
+            flight.record("I", "restore.done", restored)
+        if restored or compiled or self._quarantined:
+            log.log("INFO", "serving", "restore", **self._restore_doc)
+
+    def _restore_session(self, rs: "durable.RestoredSession") -> None:
+        budget = rs.budget or max(
+            int(self.session_hbm_fraction * hbm.budget_bytes()), 1
+        )
+        sess = Session(rs.sid, rs.name, rs.weight, budget)
+        sess.resume_token = rs.token
+        sess.connections = 0
+        total = 0
+        try:
+            for local in sorted(rs.tables):
+                fname, nbytes = rs.tables[local]
+                path = os.path.join(durable.checkpoint_dir(), fname)
+                tbl = durable.load_payload(path)
+                rb_id = rb._resident_put(tbl)
+                sess.restore_table(local, rb_id, nbytes)
+                total += nbytes
+        except BaseException:
+            sess.teardown()  # unwind the partially-restored namespace
+            raise
+        for req, resp in rs.dedup.items():
+            sess.dedup_put(req, resp, cap=durable.DEDUP_CAP)
+        sess.advance_locals(rs.next_local)
+        with self._lock:
+            self._sessions[rs.sid] = sess
+            self._sessions_served += 1
+            self._durable_logs[rs.sid] = durable.SessionLog(rs.sid)
+            live = len(self._sessions)
+        self.scheduler.register(sess)
+        durable.count("restore.sessions")
+        durable.count("restore.tables", len(rs.tables))
+        durable.count("restore.bytes", total, as_bytes=True)
+        metrics.gauge_set("serving.sessions_live", live)
+        if flight.enabled():
+            flight.record("I", "restore.session", rs.name)
+
+    def _dlog(self, sess) -> Optional["durable.SessionLog"]:
+        if not durable.enabled():
+            return None
+        with self._lock:
+            return self._durable_logs.get(sess.id)
+
+    @staticmethod
+    def _journal_safe(dlog, method: str, *args, **kwargs) -> None:
+        """Apply one journal mutation, degrading durability (counted,
+        logged) instead of failing the live request — the in-memory
+        state is authoritative; the journal self-heals on the next
+        append (Journal tail recovery)."""
+        if dlog is None:
+            return
+        try:
+            getattr(dlog, method)(*args, **kwargs)
+        except (faults.FaultError, OSError) as e:
+            durable.count("checkpoint.errors")
+            log.log("WARN", "serving", "journal_degraded",
+                    session=dlog.sid, record=method, reason=str(e))
 
     # -- accept / connection plumbing ------------------------------------
     def _accept_loop(self) -> None:
@@ -269,6 +407,7 @@ class Server:
 
     def _handle_conn(self, sock: socket.socket) -> None:
         sess: Optional[Session] = None
+        clean = False
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while True:
@@ -279,6 +418,7 @@ class Server:
                     continue
                 if cmd == "bye":
                     frames.send_frame(sock, {"ok": True})
+                    clean = True
                     break
                 if sess is None:
                     frames.send_frame(sock, _error_header(
@@ -305,25 +445,31 @@ class Server:
             with self._lock:
                 self._conns.discard(sock)
             if sess is not None:
-                self._detach(sess)
+                self._detach(sess, clean=clean)
 
     # -- session lifecycle ------------------------------------------------
     def _cmd_hello(self, sock, header, prev: Optional[Session]):
         try:
             sess = self._attach(header)
-        except (SessionLimit, SessionClosed, ValueError, TypeError) as e:
+        except (SessionLimit, SessionClosed, ValueError, TypeError,
+                durable.ResumeDenied, durable.SessionQuarantined,
+                durable.Draining) as e:
             frames.send_frame(sock, _error_header(e))
             return prev
         if prev is not None and prev is not sess:
             self._detach(prev)
-        frames.send_frame(sock, {
+        doc = {
             "ok": True,
             "session": sess.id,
             "name": sess.name,
             "weight": sess.weight,
             "budget_bytes": sess.budget_bytes,
             "queue_depth": self.queue_depth,
-        })
+        }
+        if sess.resume_token is not None:
+            doc["resume_token"] = sess.resume_token
+            doc["tables"] = sess.table_count()
+        frames.send_frame(sock, doc)
         return sess
 
     def _attach(self, header) -> Session:
@@ -334,12 +480,29 @@ class Server:
             raise ValueError(
                 f"hello: deadline_s must be >= 0, got {deadline_s}"
             )
+        dur = durable.enabled()
         with self._lock:
+            if self._draining:
+                raise durable.Draining(
+                    "daemon is draining for restart; no new sessions"
+                )
             if sid is not None:
                 sess = self._sessions.get(sid)
                 if sess is None:
+                    reason = self._quarantined.get(sid)
+                    if reason is not None:
+                        raise durable.SessionQuarantined(
+                            f"session {sid!r}: durable state quarantined"
+                            f" ({reason}); open a fresh session"
+                        )
                     raise SessionClosed(
                         f"unknown or already-closed session {sid!r}"
+                    )
+                if (dur and sess.resume_token is not None
+                        and header.get("resume") != sess.resume_token):
+                    raise durable.ResumeDenied(
+                        f"session {sid!r}: missing or wrong resume "
+                        "token"
                     )
                 sess.connections += 1
                 if deadline_s:
@@ -361,6 +524,17 @@ class Server:
             self._sessions[new_id] = sess
             self._sessions_served += 1
             live = len(self._sessions)
+        if dur:
+            # the session's durable birth record: resume token handed
+            # to the client, journal opened before any mutation lands
+            sess.resume_token = durable.new_resume_token()
+            dlog = durable.SessionLog(new_id)
+            self._journal_safe(
+                dlog, "log_open", name, weight, budget,
+                sess.resume_token,
+            )
+            with self._lock:
+                self._durable_logs[new_id] = dlog
         self.scheduler.register(sess)
         metrics.counter_add("serving.sessions_opened")
         metrics.gauge_set("serving.sessions_live", live)
@@ -368,14 +542,28 @@ class Server:
             flight.record("I", "serving.session_open", sess.name)
         return sess
 
-    def _detach(self, sess: Session) -> None:
+    def _detach(self, sess: Session, clean: bool = False) -> None:
         with self._lock:
             sess.connections -= 1
             last = sess.connections <= 0
-            if last:
+            # a durable session survives connection loss: the client
+            # reconnects with its resume token (or the next daemon
+            # life restores it). Only a clean bye — or server stop,
+            # via the leftover sweep — ends it.
+            linger = (
+                last and not clean and not self._stopping
+                and durable.enabled()
+                and sess.resume_token is not None
+            )
+            if last and not linger:
                 self._sessions.pop(sess.id, None)
+                dlog = self._durable_logs.pop(sess.id, None)
+            else:
+                dlog = None
             live = len(self._sessions)
-        if not last:
+        if not last or linger:
+            if linger and flight.enabled():
+                flight.record("I", "serving.session_linger", sess.name)
             return
         # order matters: unregister drains the session's queued AND
         # in-flight work first, so teardown reclaims tables no executor
@@ -383,6 +571,11 @@ class Server:
         # pipelined reader beyond that)
         self.scheduler.unregister(sess)
         reclaimed = sess.teardown()
+        if dlog is not None:
+            if clean:
+                dlog.log_bye()  # cleanly closed: erase durable state
+            else:
+                dlog.close()    # crash/stop: keep state for restore
         metrics.counter_add("serving.sessions_closed")
         metrics.bytes_add("serving.reclaimed_bytes", reclaimed)
         metrics.gauge_set("serving.sessions_live", live)
@@ -391,8 +584,31 @@ class Server:
 
     # -- request dispatch -------------------------------------------------
     _DEVICE_CMDS = frozenset({"stream", "upload", "plan", "download"})
+    _MUTATING_CMDS = frozenset({"upload", "plan", "free"})
 
     def _dispatch(self, sock, sess, cmd, header, payload) -> None:
+        if cmd == "drain":
+            self._cmd_drain(sock, header)
+            return
+        if self._draining and cmd in self._DEVICE_CMDS:
+            raise durable.Draining(
+                "daemon is draining for restart; no new device work"
+            )
+        req = header.get("req")
+        if (req is not None and cmd in self._MUTATING_CMDS
+                and durable.enabled()):
+            # at-most-once: a request id this session already applied
+            # re-sends the recorded response without re-applying — the
+            # reconnect-after-crash-mid-reply path
+            hit = sess.dedup_get(req)
+            if hit is not None:
+                metrics.counter_add("serving.idempotent_replays")
+                if flight.enabled():
+                    flight.record("I", "serving.replay", str(req))
+                frames.send_frame(
+                    sock, {"ok": True, "replayed": True, **hit}
+                )
+                return
         if cmd in self._DEVICE_CMDS:
             # breaker gate: an OPEN breaker sheds with typed Degraded
             # before any device work; a True return marks this request
@@ -415,8 +631,17 @@ class Server:
             else:
                 self.breaker.note_success()
         elif cmd == "free":
-            nbytes = sess.free_table(header.get("table"))
-            frames.send_frame(sock, {"ok": True, "bytes": nbytes})
+            local = int(header.get("table"))
+            nbytes = sess.free_table(local)
+            resp = {"bytes": nbytes}
+            dlog = self._dlog(sess)
+            if dlog is not None:
+                self._journal_safe(
+                    dlog, "log_free", local, nbytes, req=req, resp=resp
+                )
+            if req is not None and durable.enabled():
+                sess.dedup_put(req, resp, cap=durable.DEDUP_CAP)
+            frames.send_frame(sock, {"ok": True, **resp})
         elif cmd == "stats":
             frames.send_frame(sock, {"ok": True, "stats": self.stats()})
         else:
@@ -532,6 +757,8 @@ class Server:
             if flight.enabled():
                 flight.record("I", "serving.stream", f"{sess.name}:{n}")
 
+            man = self._manifest if durable.enabled() else None
+
             def make_work(b):
                 def work():
                     type_ids, scales, datas, valids, rows = b
@@ -539,6 +766,10 @@ class Server:
                         type_ids, scales, datas, valids, rows,
                         rb._plan_pad_to(ops, rows),
                     )
+                    if man is not None:
+                        # warm-start manifest: the decoded (padded)
+                        # table carries the exact compile signature
+                        man.note(ops, [tbl], True)
                     out = plan_mod.run_plan(ops, tbl, donate_input=True)
                     return rb._table_to_wire(out)
 
@@ -611,11 +842,19 @@ class Server:
             sess.release(est)
             raise
         rb_id = t.result()
-        actual = int(hbm.table_bytes(rb._resident_peek(rb_id)))
+        tbl = rb._resident_peek(rb_id)
+        actual = int(hbm.table_bytes(tbl))
         local = sess.put_table(rb_id, actual)
-        frames.send_frame(
-            sock, {"ok": True, "table": local, "bytes": actual}
-        )
+        resp = {"table": local, "bytes": actual}
+        req = header.get("req")
+        dlog = self._dlog(sess)
+        if dlog is not None:
+            self._journal_safe(
+                dlog, "log_put", local, tbl, actual, req=req, resp=resp
+            )
+        if req is not None and durable.enabled():
+            sess.dedup_put(req, resp, cap=durable.DEDUP_CAP)
+        frames.send_frame(sock, {"ok": True, **resp})
 
     def _cmd_plan(self, sock, sess, header) -> None:
         ops = self._plan_ops(header)
@@ -638,15 +877,20 @@ class Server:
         # when pending or missing (the runtime surfaces those exactly as
         # before).
         rest_sigs = []
+        rest_tabs = []
         for rid in rb_ids[1:]:
             try:
                 t = rb._resident_peek(rid)
             except KeyError:
                 t = None
+            resolved = (
+                t is not None and not isinstance(t, pipeline.Pending)
+            )
+            if resolved:
+                rest_tabs.append(t)
             rest_sigs.append(
                 (plancheck.schema_of_table(t), int(t.logical_row_count))
-                if t is not None and not isinstance(t, pipeline.Pending)
-                else (None, None)
+                if resolved else (None, None)
             )
         plancheck.check_plan(
             ops,
@@ -655,6 +899,11 @@ class Server:
             rest=rest_sigs,
             names=head.names,
         )
+        if (self._manifest is not None and durable.enabled()
+                and len(rest_tabs) == len(rb_ids) - 1):
+            # every input resolved: record the compile signature for
+            # the next life's warm start
+            self._manifest.note(ops, [head] + rest_tabs, donate)
         est = int(hbm.table_bytes(head))
         sess.admit(est)
         plan_json = json.dumps(ops)
@@ -672,12 +921,27 @@ class Server:
         if donate:
             sess.drop_local(locals_[0])
         out = rb._resident_peek(out_id)
+        dlog = self._dlog(sess)
+        if dlog is not None and isinstance(out, pipeline.Pending):
+            # durability needs the real table to checkpoint: resolve
+            # the pipelined result now (the documented durable-on cost)
+            out = rb._resident_get(out_id)
         actual = (
             est if isinstance(out, pipeline.Pending)
             else int(hbm.table_bytes(out))
         )
         local = sess.put_table(out_id, actual)
-        frames.send_frame(sock, {"ok": True, "table": local})
+        resp = {"table": local}
+        req = header.get("req")
+        if dlog is not None:
+            self._journal_safe(
+                dlog, "log_put", local, out, actual,
+                drop=locals_[0] if donate else None,
+                req=req, resp=resp,
+            )
+        if req is not None and durable.enabled():
+            sess.dedup_put(req, resp, cap=durable.DEDUP_CAP)
+        frames.send_frame(sock, {"ok": True, **resp})
 
     def _cmd_download(self, sock, sess, header) -> None:
         rb_id = sess.rb_id(header.get("table"))
@@ -689,6 +953,30 @@ class Server:
         meta, buffers = frames.batch_to_parts(result)
         sess.stats["bytes_out"] += sum(len(b) for b in buffers)
         frames.send_frame(sock, {"ok": True, "result": meta}, buffers)
+
+    def _cmd_drain(self, sock, header) -> None:
+        """Rolling restart: stop admitting (new sessions AND device
+        work shed with typed ``draining``), finish in-flight work under
+        the existing deadline/cancel machinery, checkpoint (every
+        mutation was journaled at apply time — the drain barrier just
+        guarantees nothing is mid-flight), answer, then exit. The
+        optional ``deadline_s`` bounds the wait; a daemon that cannot
+        drain in time answers ``drained: false`` and still exits."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+        metrics.counter_add("serving.drains")
+        if flight.enabled():
+            flight.record("I", "serving.drain", self.port)
+        timeout = header.get("deadline_s")
+        drained = self.scheduler.wait_idle(
+            None if timeout is None else float(timeout)
+        )
+        frames.send_frame(sock, {"ok": True, "drained": bool(drained)})
+        if not already:
+            threading.Thread(
+                target=self.stop, name="srt-serve-drain", daemon=True
+            ).start()
 
     # -- introspection ----------------------------------------------------
     def stats(self) -> dict:
@@ -705,6 +993,12 @@ class Server:
             "resident_tables": rb.resident_table_count(),
             "spill": spill.stats_doc(),
             "breaker": self.breaker.to_doc(),
+            "durability": {
+                **durable.stats_doc(),
+                "draining": self._draining,
+                "quarantined_sessions": len(self._quarantined),
+                "restore": self._restore_doc,
+            },
             "sessions": sessions,
         }
 

@@ -27,7 +27,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, Optional, Tuple
 
 from .. import runtime_bridge as rb
@@ -84,6 +84,12 @@ class Session:
         self.created = time.time()
         self.connections = 0
         self.closed = False
+        # durable serving (serving/durable.py): the reconnect secret
+        # handed out at open (None when durability is off) and the
+        # idempotency window mapping request ids of applied mutations
+        # to their recorded responses
+        self.resume_token: Optional[str] = None
+        self._dedup: "OrderedDict[str, dict]" = OrderedDict()
         self._lock = lockcheck.make_lock("session.state")
         self._cv = lockcheck.make_condition(self._lock)
         self._tables: Dict[int, Tuple[int, int]] = {}  # local -> (rb, B)
@@ -290,6 +296,38 @@ class Session:
     def table_count(self) -> int:
         with self._lock:
             return len(self._tables)
+
+    # -- durability (serving/durable.py) ----------------------------------
+    def dedup_get(self, req) -> Optional[dict]:
+        """Recorded response for an already-applied request id, or
+        None — the at-most-once check for reconnecting clients."""
+        with self._lock:
+            hit = self._dedup.get(str(req))
+            return None if hit is None else dict(hit)
+
+    def dedup_put(self, req, resp: dict, cap: int = 512) -> None:
+        with self._lock:
+            self._dedup[str(req)] = dict(resp)
+            while len(self._dedup) > cap:
+                self._dedup.popitem(last=False)
+
+    def restore_table(self, local: int, rb_id: int,
+                      nbytes: int) -> None:
+        """Re-register a journal-recovered table under its ORIGINAL
+        session-local id, re-charging its bytes as resident (the HBM
+        accounting the journal's budget record expects)."""
+        local = int(local)
+        with self._cv:
+            self._tables[local] = (int(rb_id), int(nbytes))
+            self._resident_bytes += int(nbytes)
+        with _OWNERS_LOCK:
+            _RB_OWNERS[int(rb_id)] = (self, int(nbytes))
+
+    def advance_locals(self, next_local: int) -> None:
+        """Continue local-id allocation past the journal's high-water
+        mark — restored ids and fresh ones must never collide."""
+        with self._lock:
+            self._next_local = itertools.count(max(int(next_local), 1))
 
     # -- stats ------------------------------------------------------------
     def note_wait(self, seconds: float) -> None:

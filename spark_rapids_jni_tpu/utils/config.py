@@ -147,6 +147,26 @@ def _parse_fault_spec(v: str) -> str:
     return spec
 
 
+def _parse_checkpoint_dir(v: str) -> str:
+    """Validate SPARK_RAPIDS_TPU_CHECKPOINT_DIR at flag-read time: a
+    whitespace-only value or a path that exists but is not a directory
+    is a deployment mistake that would silently disable durability, so
+    fail loudly (the directory itself is created lazily on first
+    checkpoint)."""
+    if v and not v.strip():
+        raise ValueError(
+            "SPARK_RAPIDS_TPU_CHECKPOINT_DIR must be a directory path, "
+            f"got whitespace {v!r}"
+        )
+    path = v.strip()
+    if path and os.path.exists(path) and not os.path.isdir(path):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_CHECKPOINT_DIR={path!r} exists and is "
+            "not a directory"
+        )
+    return path
+
+
 @dataclasses.dataclass(frozen=True)
 class Flag:
     name: str
@@ -270,7 +290,8 @@ _FLAGS = {
             "FAULTS", "", _parse_fault_spec,
             "deterministic fault-injection plan (utils/faults.py): "
             "'[seed=N,]site:kind:prob[:count],...' — site in "
-            "dispatch|compile|serde|hbm_admit|serve_accept|spill, kind in "
+            "dispatch|compile|serde|hbm_admit|serve_accept|spill|"
+            "checkpoint, kind in "
             "transient|oom|permanent, prob in [0,1], count = max "
             "injections (0/absent = unlimited); '' (default) = off",
         ),
@@ -288,6 +309,23 @@ _FLAGS = {
             "directory for disk-tier spill files (utils/spill.py); '' "
             "(default) = a per-process directory under the system temp "
             "dir; files this process wrote are swept at exit either way",
+        ),
+        Flag(
+            "DURABLE", False, _as_bool,
+            "durable serving plane (serving/durable.py): on = per-"
+            "session write-ahead journal of namespace mutations with "
+            "CRC-framed fsync'd records, table payloads checkpointed "
+            "via the spill .npz serde, crash-safe restore + warm-start "
+            "manifest replay before the listener accepts traffic; off "
+            "(default) costs one cached generation compare per mutation",
+        ),
+        Flag(
+            "CHECKPOINT_DIR", "", _parse_checkpoint_dir,
+            "directory for durable serving checkpoints (journals, "
+            "table payloads, warm-start manifest); '' (default) = "
+            "<tempdir>/srt-checkpoint. Unlike SPILL_DIR this directory "
+            "is NEVER swept at exit — checkpoints must survive the "
+            "process to be worth writing",
         ),
         Flag(
             "HOST_SPILL_BUDGET_GB", 4.0,

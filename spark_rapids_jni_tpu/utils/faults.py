@@ -156,8 +156,10 @@ def retryable_class(cls: type) -> bool:
 #   hbm_admit    serving session.Session.admit (HBM budget admission)
 #   serve_accept serving server._dispatch (per-command accept point)
 #   spill        utils/spill.py eviction copy-out + repage upload
+#   checkpoint   serving/durable.py journal append (torn-write
+#                emulation), payload persist, and restore-time read
 SITES = ("dispatch", "compile", "serde", "hbm_admit", "serve_accept",
-         "spill")
+         "spill", "checkpoint")
 
 KINDS = ("transient", "oom", "permanent")
 

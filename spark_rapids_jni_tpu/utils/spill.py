@@ -647,16 +647,16 @@ def repage_locked(tid: int):
     return table
 
 
-def _upload(entry: SpilledTable):
-    """Batched upload of a spilled entry's storage buffers — the
+def _upload_cols(cols, names, logical_rows):
+    """Batched upload of spill-format column tuples — the
     _upload_host_columns discipline: ONE jax.device_put over the flat
-    leaf list, then rebuild Columns/Table around the device arrays."""
+    leaf list, then rebuild Columns/Table around the device arrays.
+    Shared by the repage path and the checkpoint restore path."""
     import jax
 
     from .. import dtype as dt
     from ..column import Column, Table
 
-    cols = _load_cols(entry)
     leaves = []
     for _, _, data, validity, lengths in cols:
         leaves.append(data)
@@ -678,7 +678,79 @@ def _upload(entry: SpilledTable):
         out.append(
             Column(d, dt.DType(dt.TypeId(ti), sc), v, lens)
         )
-    return Table(out, entry.names, entry.logical_rows)
+    return Table(out, names, logical_rows)
+
+
+def _upload(entry: SpilledTable):
+    cols = _load_cols(entry)  # sets entry.names/logical_rows from meta
+    return _upload_cols(cols, entry.names, entry.logical_rows)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint serde: the durable serving plane (serving/durable.py) reuses
+# the disk-tier .npz format (meta + d{i}/v{i}/l{i}) as its payload
+# substrate, but with synchronous fsync'd writes and atomic rename —
+# a checkpoint that exists must be complete
+# ---------------------------------------------------------------------------
+
+
+def save_table_npz(path: str, table) -> int:
+    """Write a device Table's payload as a spill-format .npz at ``path``
+    (tmp + fsync + atomic rename, synchronous). Returns the host byte
+    size. The file is NOT registered in ``_FILES``: the caller owns its
+    lifetime and the exit sweep must never touch checkpoints."""
+    cols = []
+    for c in table.columns:
+        data = _host_copy(c.data)
+        validity = None if c.validity is None else _host_copy(c.validity)
+        lengths = None if c.lengths is None else _host_copy(c.lengths)
+        cols.append(
+            (int(c.dtype.id), int(c.dtype.scale), data, validity, lengths)
+        )
+    meta = {
+        "type_ids": [c[0] for c in cols],
+        "scales": [c[1] for c in cols],
+        "names": None if table.names is None else list(table.names),
+        "logical_rows": table.logical_rows,
+    }
+    arrays = {
+        "meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+    }
+    nbytes = 0
+    for i, (_, _, data, validity, lengths) in enumerate(cols):
+        arrays[f"d{i}"] = data
+        nbytes += data.nbytes
+        if validity is not None:
+            arrays[f"v{i}"] = validity
+            nbytes += validity.nbytes
+        if lengths is not None:
+            arrays[f"l{i}"] = lengths
+            nbytes += lengths.nbytes
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return nbytes
+
+
+def load_table_npz(path: str):
+    """Read a .npz written by ``save_table_npz`` (or the demote path)
+    back into a device Table — the restore-time repage."""
+    lockcheck.note_blocking("spill_disk_read")
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        cols = []
+        for i, (ti, sc) in enumerate(
+            zip(meta["type_ids"], meta["scales"])
+        ):
+            cols.append((
+                ti, sc, z[f"d{i}"],
+                z[f"v{i}"] if f"v{i}" in z else None,
+                z[f"l{i}"] if f"l{i}" in z else None,
+            ))
+    return _upload_cols(cols, meta["names"], meta["logical_rows"])
 
 
 # ---------------------------------------------------------------------------
@@ -720,6 +792,19 @@ def spill_file_count() -> int:
     return len(_FILES)
 
 
+def _checkpoint_prefix() -> str:
+    """Absolute checkpoint-dir prefix (trailing separator) the sweep
+    must never cross. Spill scratch is process-scoped and swept at
+    exit; checkpoints (SPARK_RAPIDS_TPU_CHECKPOINT_DIR, or the stable
+    default under the system temp dir) exist precisely to outlive the
+    process, so any path under this prefix is exempt even if it was
+    (wrongly) registered for sweeping."""
+    d = config.get_flag("CHECKPOINT_DIR") or os.path.join(
+        tempfile.gettempdir(), "srt-checkpoint"
+    )
+    return os.path.abspath(d) + os.sep
+
+
 def reset() -> None:
     """Test hook: drop all tracking and remove every spill file."""
     global _DEVICE_BYTES, _HOST_BYTES, _DISK_BYTES, _HOST_HW, _DISK_HW
@@ -732,18 +817,26 @@ def reset() -> None:
             _HOST_HW = _DISK_HW = 0
     with _EVENTS_LOCK:
         _EVENTS.clear()
+    keep = _checkpoint_prefix()
     for path in list(_FILES):
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        if not os.path.abspath(path).startswith(keep):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         _FILES.discard(path)
 
 
 def _sweep_at_exit() -> None:  # pragma: no cover - atexit path
     """No orphaned spill files: remove anything this process wrote and
-    the per-process default directory when it is left empty."""
+    the per-process default directory when it is left empty — except
+    checkpoints, which must survive the process (the durable-serving
+    restore depends on it)."""
+    keep = _checkpoint_prefix()
     for path in list(_FILES):
+        if os.path.abspath(path).startswith(keep):
+            _FILES.discard(path)
+            continue
         try:
             os.unlink(path)
         except OSError:

@@ -161,7 +161,7 @@ METRIC_NAMESPACES = frozenset({
     "compile_cache", "pipeline", "hbm", "span", "span_ms", "serving",
     "session", "retry", "faults", "breaker", "fault", "spill", "lock",
     "shuffle", "distributed", "io", "probe", "bench", "groupby",
-    "join", "sort", "profile", "stream",
+    "join", "sort", "profile", "stream", "checkpoint", "restore",
 })
 METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
