@@ -36,7 +36,7 @@ python3 tools/srt_check.py
 # scripts must tag clean under the plan-time analyzer (the GpuOverrides
 # analog) — a driver must never ship a plan the runtime would reject.
 python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh \
-  ci/smoke-spill.sh ci/smoke-restart.sh
+  ci/smoke-chaos-mesh.sh ci/smoke-spill.sh ci/smoke-restart.sh
 
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).
@@ -73,6 +73,13 @@ bash ci/smoke-observability.sh
 # byte-identical with nonzero retry counters, the circuit breaker must
 # trip and re-close via the background probe, and zero tables may leak.
 bash ci/smoke-chaos.sh
+
+# Mesh chaos smoke: a mesh-backed served stream under seeded
+# shuffle/collective faults must replay exchanges to byte-identical
+# results with nonzero shuffle.retries; persistent collective failure
+# must walk the degradation ladder to the floor and fall back to the
+# single-device exact path (served, not shed) with zero leaked tables.
+bash ci/smoke-chaos-mesh.sh
 
 # Spill smoke: a served stream with a device working set ~2x the
 # (shrunk) HBM budget must complete byte-identical by spilling cold

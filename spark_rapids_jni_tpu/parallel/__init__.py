@@ -5,9 +5,23 @@ the downstream spark-rapids plugin's UCX/NCCL shuffle manager (SURVEY.md
 §2.5). Here the exchange is a first-class component: Spark-compatible hash
 partitioning (ops/partition.py) + ``jax.lax.all_to_all`` over the mesh's
 ICI axis under ``shard_map``, with XLA inserting the collective schedule.
+
+Fault tolerance (the distributed analog of Spark's ExecutorLost /
+shuffle-fetch retry semantics): ``run_collective`` gives every launch a
+lineage-replay retry boundary, ``MeshHealth`` heartbeats a mesh with a
+deadline, and ``MeshRunner`` degrades to the surviving device count and
+replays instead of dying (tolerant.py, planmesh.py).
 """
 
-from .mesh import make_mesh, shard_table, replicate_table, local_shards
+from .mesh import (
+    MeshHealth,
+    make_mesh,
+    shard_table,
+    replicate_table,
+    local_shards,
+)
+from .tolerant import MeshRunner, run_collective
+from .planmesh import MeshUnsupported, run_plan_mesh
 from .shuffle import (
     ShuffleOverflowError,
     exchange,
@@ -32,6 +46,11 @@ from .distributed import (
 )
 
 __all__ = [
+    "MeshHealth",
+    "MeshRunner",
+    "MeshUnsupported",
+    "run_collective",
+    "run_plan_mesh",
     "make_mesh",
     "shard_table",
     "replicate_table",

@@ -17,6 +17,13 @@ distributed instances of the reference's two-phase sizing discipline
 rather than dropping rows. The join exchanges each side ONCE: the
 shuffled shards stay device-resident between the count pass and the
 materialize pass.
+
+Fault tolerance: every shard_map launch here is a ``collective``-site
+replay boundary (``tolerant.run_collective``) — the host wrapper's
+sharded inputs + planned capacities are the lineage, so a transient
+collective failure re-runs only the failed launch with backoff
+(``shuffle.retries``/``shuffle.giveups``). Overflow errors are typed
+``faults.PermanentError``: never retried, never breaker-counted.
 """
 
 from __future__ import annotations
@@ -29,7 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..column import Table
-from ..utils import metrics
+from ..utils import faults, metrics
 from ..ops.groupby import GroupbyAgg, groupby_aggregate_capped
 from ..ops.join import (
     inner_join_capped,
@@ -39,6 +46,7 @@ from ..ops.join import (
     membership_mask,
 )
 from .mesh import SHUFFLE_AXIS, shard_map, shard_table
+from .tolerant import run_collective
 from .shuffle import (
     _ragged_impl,
     _round_capacity,
@@ -66,8 +74,8 @@ def _warn_if_recv_exceeds_hbm(cap: int, table: Table, label: str) -> None:
 
     srt_log.log(
         "INFO", "hbm", "recv_buffer_plan", label=label,
-        estimated_bytes=int(est), budget_bytes=int(budget),
-        fits=bool(est <= budget),
+        estimated_bytes=int(est), budget_bytes=int(budget),  # srt: allow-host-sync(host-only arithmetic: row_bytes and the budget are host ints)
+        fits=bool(est <= budget),  # srt: allow-host-sync(host-only arithmetic: row_bytes and the budget are host ints)
     )
     if metrics.enabled():
         metrics.counter_add("shuffle.recv_plans")
@@ -86,16 +94,23 @@ def _warn_if_recv_exceeds_hbm(cap: int, table: Table, label: str) -> None:
         )
 
 
-class JoinOverflowError(RuntimeError):
+class JoinOverflowError(faults.PermanentError):
     """A capped join produced more matches than its static output
     capacity — rows would have been dropped. Raised by the host
-    wrappers; never silent."""
+    wrappers; never silent.
+
+    Typed :class:`~..utils.faults.PermanentError` (a replay at the same
+    capacity overflows identically — never retried, never counted by
+    the breaker); still a ``RuntimeError`` via ``FaultError``."""
 
 
-class GroupOverflowError(RuntimeError):
+class GroupOverflowError(faults.PermanentError):
     """A capped groupby saw more distinct keys than its static segment
     capacity — groups would have been dropped. Raised by the host
-    wrappers; never silent."""
+    wrappers; never silent.
+
+    Typed :class:`~..utils.faults.PermanentError` like
+    :class:`JoinOverflowError`."""
 
 
 @metrics.traced("distributed.groupby")
@@ -127,6 +142,7 @@ def distributed_groupby(
     counts = partition_counts(sharded, by, mesh, axis)
     cap = capacity or total_recv_capacity(counts)
     _warn_if_recv_exceeds_hbm(cap, table, "groupby")
+    # srt: allow-host-sync(two-phase sizing: the planning pass exists to produce this host capacity)
     pair_cap = _round_capacity(int(jnp.max(counts)))
     # a device can't see more groups than the rows it receives
     seg_cap = groups_per_device or cap
@@ -147,9 +163,12 @@ def distributed_groupby(
         out_specs=P(axis),
         check_vma=False,
     )
-    agg, ngroups, overflow = fn(sharded, counts)
+    agg, ngroups, overflow = run_collective(
+        "distributed.groupby", lambda: fn(sharded, counts)
+    )
     if on_overflow == "raise":
         check_overflow_compact(overflow, cap, "groupby")
+        # srt: allow-host-sync(lossless verdict: the overflow check exists to block until the counts land)
         worst_groups = int(jnp.max(ngroups))
         if worst_groups > seg_cap:
             raise GroupOverflowError(
@@ -213,9 +232,11 @@ def _shuffle_join(
             else None
         ),
     )
-    ocap = (
-        _round_capacity(int(jnp.max(cnts))) if count_pass else out_capacity
-    )
+    if count_pass:
+        # srt: allow-host-sync(two-phase sizing: the count pass exists to produce this host capacity)
+        ocap = _round_capacity(int(jnp.max(cnts)))
+    else:
+        ocap = out_capacity
 
     def join_body(ls: Table, locc, rs: Table, rocc):
         out, count = capped_fn(
@@ -230,8 +251,12 @@ def _shuffle_join(
         out_specs=P(axis),
         check_vma=False,
     )
-    out, count = join_fn(ls_g, locc_g, rs_g, rocc_g)
+    out, count = run_collective(
+        f"distributed.{label}",
+        lambda: join_fn(ls_g, locc_g, rs_g, rocc_g),
+    )
     if on_overflow == "raise":
+        # srt: allow-host-sync(lossless verdict: the overflow check exists to block until the counts land)
         worst = int(jnp.max(count))
         if worst > ocap:
             raise JoinOverflowError(
@@ -260,7 +285,9 @@ def _co_partition(
     rcounts = partition_counts(rsh, on, mesh, axis)
     lcap = capacity or total_recv_capacity(lcounts)
     rcap = capacity or total_recv_capacity(rcounts)
+    # srt: allow-host-sync(two-phase sizing: the planning pass exists to produce these host capacities)
     lpair = _round_capacity(int(jnp.max(lcounts)))
+    # srt: allow-host-sync(two-phase sizing: the planning pass exists to produce these host capacities)
     rpair = _round_capacity(int(jnp.max(rcounts)))
 
     def body(l_local: Table, r_local: Table, lC, rC):
@@ -284,8 +311,9 @@ def _co_partition(
         out_specs=P(axis),
         check_vma=False,
     )
-    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = fn(
-        lsh, rsh, lcounts, rcounts
+    ls_g, locc_g, lov, rs_g, rocc_g, rov, cnts = run_collective(
+        "distributed.co_partition",
+        lambda: fn(lsh, rsh, lcounts, rcounts),
     )
     if on_overflow == "raise":
         check_overflow_compact(lov, lcap, "left side")
@@ -338,7 +366,10 @@ def _distributed_membership_join(
         body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
         check_vma=False,
     )
-    occ = fn(ls_g, locc_g, rs_g, rocc_g)
+    occ = run_collective(
+        "distributed.membership",
+        lambda: fn(ls_g, locc_g, rs_g, rocc_g),
+    )
     return ls_g, occ, lov, rov
 
 
@@ -460,7 +491,10 @@ def broadcast_inner_join(
             out_specs=P(axis),
             check_vma=False,
         )
-        lo_g, counts_g, cnts = cnt_fn(lsh, right)
+        lo_g, counts_g, cnts = run_collective(
+            "distributed.broadcast_count", lambda: cnt_fn(lsh, right)
+        )
+        # srt: allow-host-sync(two-phase sizing: the count pass exists to produce this host capacity)
         ocap = _round_capacity(int(jnp.max(cnts)))
 
         def body(l_local: Table, r_full: Table, lo, counts):
@@ -479,7 +513,10 @@ def broadcast_inner_join(
             out_specs=P(axis),
             check_vma=False,
         )
-        out, count = fn(lsh, right, lo_g, counts_g)
+        out, count = run_collective(
+            "distributed.broadcast_join",
+            lambda: fn(lsh, right, lo_g, counts_g),
+        )
     else:
         ocap = out_capacity
 
@@ -496,8 +533,11 @@ def broadcast_inner_join(
             out_specs=P(axis),
             check_vma=False,
         )
-        out, count = fn(lsh, right)
+        out, count = run_collective(
+            "distributed.broadcast_join", lambda: fn(lsh, right)
+        )
     if on_overflow == "raise":
+        # srt: allow-host-sync(lossless verdict: the overflow check exists to block until the counts land)
         worst = int(jnp.max(count))
         if worst > ocap:
             raise JoinOverflowError(
@@ -554,6 +594,7 @@ def distributed_sort(
         words.extend(_key_words(table.column(k.column), k))
     n = table.row_count
     stride = max(n // max(sample_size, 1), 1)
+    # srt: allow-host-sync(range-partition sampling: the splitter sample is a deliberate host step)
     samp = [np.asarray(w[::stride]) for w in words]
     order = np.lexsort(samp[::-1])
     m = order.shape[0]
@@ -585,12 +626,16 @@ def distributed_sort(
         dest = dest_of(local)
         return jnp.bincount(dest, length=num).astype(jnp.int32)[None, :]
 
-    counts = shard_map(
+    count_launch = shard_map(
         count_body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
         check_vma=False,
-    )(sharded)
+    )
+    counts = run_collective(
+        "distributed.sort_counts", lambda: count_launch(sharded)
+    )
     cap = capacity or total_recv_capacity(counts)
     _warn_if_recv_exceeds_hbm(cap, table, "sort")
+    # srt: allow-host-sync(two-phase sizing: the planning pass exists to produce this host capacity)
     pair_cap = _round_capacity(int(jnp.max(counts)))
 
     def body(local: Table, C):
@@ -617,7 +662,9 @@ def distributed_sort(
         body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
         check_vma=False,
     )
-    out, occ, overflow = fn(sharded, counts)
+    out, occ, overflow = run_collective(
+        "distributed.sort", lambda: fn(sharded, counts)
+    )
     if on_overflow == "raise":
         check_overflow_compact(overflow, cap, "distributed sort")
     return out, occ, overflow

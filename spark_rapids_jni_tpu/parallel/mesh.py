@@ -8,11 +8,14 @@ keeps partition-heavy traffic on the inner (ICI) axis.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import config, faults, flight, log, metrics
 
 try:  # jax >= 0.6 exposes shard_map at top level
     from jax import shard_map as _shard_map
@@ -41,10 +44,28 @@ SHUFFLE_AXIS = "shuffle"
 def make_mesh(
     n_devices: Optional[int] = None, axis: str = SHUFFLE_AXIS
 ) -> Mesh:
+    """Build the 1-D shuffle mesh over the first ``n_devices`` devices.
+
+    Loud-fail contract: a mesh-shape vs device-count mismatch names the
+    requested shape AND the remedy instead of whatever XLA error would
+    surface from the first collective. ``mesh`` is also an injection
+    site — a chaos plan can make construction fail like a dead slice.
+    """
     devs = jax.devices()
-    n = n_devices or len(devs)
+    n = len(devs) if n_devices is None else int(n_devices)
+    if n <= 0:
+        raise ValueError(
+            f"mesh axis {axis!r}: requested {n} devices; a mesh needs "
+            "at least 1"
+        )
     if n > len(devs):
-        raise ValueError(f"requested {n} devices, have {len(devs)}")
+        raise ValueError(
+            f"mesh axis {axis!r}: requested {n} devices, have "
+            f"{len(devs)} ({devs[0].platform}); shrink the mesh or, on "
+            "the CPU test tier, set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    faults.inject("mesh")
     return Mesh(np.array(devs[:n]), (axis,))
 
 
@@ -58,7 +79,10 @@ def shard_table(table: Table, mesh: Mesh, axis: str = SHUFFLE_AXIS) -> Table:
     size = mesh.shape[axis]
     if n % size:
         raise ValueError(
-            f"row count {n} not divisible by mesh axis size {size}"
+            f"mesh axis {axis!r} (size {size}): row count {n} is not "
+            f"divisible by the shard axis; pad the table to a multiple "
+            f"of {size} (the planmesh wrapper does) or build a mesh "
+            "whose size divides the row count"
         )
 
     def put(x):
@@ -83,3 +107,77 @@ def replicate_table(table: Table, mesh: Mesh) -> Table:
 def local_shards(table: Table) -> int:
     """Number of addressable shards of the first buffer (introspection)."""
     return len(table.columns[0].data.addressable_shards)
+
+
+class MeshHealth:
+    """Cheap heartbeat probe for a mesh: one psum all-reduce with a
+    deadline (``SPARK_RAPIDS_TPU_MESH_PROBE_S``).
+
+    A mesh whose collective answers (with the right sum) within the
+    deadline is healthy; a hang past the deadline or any raise —
+    including an injected ``mesh``-site fault — marks it unhealthy.
+    The heartbeat runs on a worker thread so a wedged collective costs
+    the probe its deadline, never the caller its process.
+    """
+
+    def __init__(self, deadline_s: Optional[float] = None):
+        self.deadline_s = (
+            float(config.get_flag("MESH_PROBE_S"))
+            if deadline_s is None else float(deadline_s)
+        )
+
+    def probe(self, mesh: Mesh, axis: str = SHUFFLE_AXIS) -> bool:
+        """True iff every device on ``mesh`` answered the heartbeat."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as _P
+
+        metrics.counter_add("mesh.probes")
+        size = int(mesh.shape[axis])
+        verdict = {}
+
+        def beat():
+            try:
+                faults.inject("mesh")
+                fn = shard_map(
+                    lambda x: jax.lax.psum(x, axis),
+                    mesh=mesh, in_specs=_P(axis), out_specs=_P(),
+                    check_vma=False,
+                )
+                out = fn(jnp.ones((size,), jnp.int32))
+                # srt: allow-host-sync(heartbeat verdict: the probe exists to block until the mesh answers)
+                verdict["ok"] = int(out[0]) == size
+            # srt: allow-broad-except(any heartbeat failure is an unhealthy verdict, classified below by the caller-facing metering)
+            except Exception as e:
+                verdict["ok"] = False
+                verdict["error"] = e
+                faults.note_error_class(e, "mesh.probe")
+
+        t = threading.Thread(
+            target=beat, name="srt-mesh-probe", daemon=True
+        )
+        t.start()
+        t.join(self.deadline_s)
+        if t.is_alive():
+            # wedged collective: the deadline IS the verdict
+            metrics.counter_add("mesh.probe_timeouts")
+            if flight.enabled():
+                flight.record("I", "mesh.probe_timeout", size)
+            log.log(
+                "WARN", "faults", "mesh_probe_timeout",
+                devices=size, deadline_s=self.deadline_s,
+            )
+            return False
+        ok = bool(verdict.get("ok"))
+        if not ok:
+            metrics.counter_add("mesh.probe_failures")
+            if flight.enabled():
+                flight.record("I", "mesh.probe_failure", size)
+            err = verdict.get("error")
+            log.log(
+                "WARN", "faults", "mesh_probe_failure", devices=size,
+                error=(
+                    f"{type(err).__name__}: {str(err)[:200]}"
+                    if err is not None else None
+                ),
+            )
+        return ok

@@ -1,0 +1,153 @@
+"""Mesh data-parallel plan execution for row-local segments.
+
+``run_plan_mesh`` runs a plan whose every op is row-local (``cast``,
+``filter``, ``rlike`` — plan.py's ``_ROW_LOCAL``) as ONE shard_map
+stage over a :class:`~.tolerant.MeshRunner`: rows split into contiguous
+blocks (one per device), each shard runs the same fused segment body
+the single-device path compiles (``plan._run_segment_traced``), and the
+host gathers each shard's valid prefix back in mesh order.
+
+Parity contract: row-local ops neither reorder rows nor look across
+them, so block-sharded execution followed by an in-order prefix gather
+is byte-identical to the single-device result — at ANY mesh size. That
+mesh-size independence is what makes the degradation ladder safe here:
+when the runner remeshes to fewer devices mid-incident and replays, the
+stage re-derives shard layout and per-shard valid counts from the
+captured host-side lineage (the undonated input table + ops) at the new
+size and the bytes do not change.
+
+Anything else — multi-table rest inputs, non-row-local ops, padded
+inputs — raises :class:`MeshUnsupported` and the caller falls through
+to the ordinary single-device plan path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..column import Column, Table
+from .mesh import SHUFFLE_AXIS, shard_map
+from .tolerant import MeshRunner
+
+
+class MeshUnsupported(Exception):
+    """This plan/input shape has no mesh path; use the exact path."""
+
+
+def _check_supported(ops: Sequence[dict], table: Table,
+                     rest: Sequence[Table]) -> None:
+    from .. import plan as plan_mod
+
+    if rest:
+        raise MeshUnsupported("mesh plan path takes no rest tables")
+    if not ops:
+        raise MeshUnsupported("empty plan")
+    if not table.columns or table.logical_row_count == 0:
+        raise MeshUnsupported("empty table")
+    for op in ops:
+        name = op.get("op")
+        if name not in plan_mod._ROW_LOCAL:
+            raise MeshUnsupported(
+                f"op {name!r} is not row-local; mesh path handles "
+                f"{sorted(plan_mod._ROW_LOCAL)} only"
+            )
+
+
+def run_plan_mesh(
+    ops: Sequence[dict],
+    table: Table,
+    runner: MeshRunner,
+    rest: Sequence[Table] = (),
+) -> Table:
+    """Run a row-local plan data-parallel over ``runner``'s mesh.
+
+    Never consumes ``table`` (the un-donated input IS the replay
+    lineage); returns the exact (unpadded) result table. Raises
+    :class:`MeshUnsupported` when the plan has no mesh path and
+    :class:`~..utils.faults.Degraded` when the runner's ladder hits
+    its device floor.
+    """
+    from .. import plan as plan_mod
+    from ..utils import buckets
+
+    _check_supported(ops, table, rest)
+    # a bucket-padded wire upload shrinks to its real rows first: the
+    # mesh stage derives its own shard padding, and the caller's padded
+    # input stays untouched (it is the fallback path's donation)
+    table = buckets.unpad_table(table)
+    seg_ops = list(ops)
+    n = int(table.row_count)
+    axis = runner.axis
+
+    def stage(mesh):
+        # re-derived per replay: a smaller surviving mesh re-plans the
+        # shard layout + per-shard valid counts from the same lineage
+        size = int(mesh.shape[axis])
+        per = -(-n // size)  # ceil: contiguous row blocks, one per dev
+        pad = per * size - n
+
+        def padleaf(x):
+            if pad:
+                x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            return jax.device_put(
+                x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+            )
+
+        pt = jax.tree_util.tree_map(padleaf, table)
+        counts = np.clip(n - np.arange(size) * per, 0, per).astype(
+            np.int32
+        )
+        cnt = jax.device_put(
+            jnp.asarray(counts), NamedSharding(mesh, P(axis))
+        )
+
+        def body(local, c):
+            t2, n2 = plan_mod._run_segment_traced(seg_ops, local, c[0])
+            return t2, jnp.reshape(n2, (1,)).astype(jnp.int32)
+
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
+        out_t, out_c = fn(pt, cnt)
+
+        # host-side gather: each shard's valid prefix, in mesh order —
+        # exactly the single-device result for row-local segments
+        # srt: allow-host-sync(result materialization: the stage's output IS these host bytes)
+        got = np.asarray(jax.device_get(out_c))
+        per_out = out_t.row_count // size
+
+        def take(x):
+            if x is None:
+                return None
+            # srt: allow-host-sync(result materialization: gathering the sharded output to host)
+            full = np.asarray(jax.device_get(x))
+            return np.concatenate(
+                [full[i * per_out:i * per_out + int(got[i])]
+                 for i in range(size)]
+            )
+
+        cols = []
+        for c in out_t.columns:
+            cols.append(Column(
+                data=jnp.asarray(take(c.data)),
+                dtype=c.dtype,
+                validity=(
+                    None if c.validity is None
+                    else jnp.asarray(take(c.validity))
+                ),
+                lengths=(
+                    None if c.lengths is None
+                    else jnp.asarray(take(c.lengths))
+                ),
+            ))
+        return Table(cols, names=out_t.names)
+
+    return runner.run_stage("plan.mesh", stage)

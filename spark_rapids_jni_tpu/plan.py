@@ -31,7 +31,9 @@ fusion can change launch counts, never results
 Telemetry (``plan.*``, through the metrics registry + flight recorder):
 ``plan.calls``/``plan.segments``/``plan.fused_segments``/
 ``plan.fused_ops``/``plan.exact_ops``/``plan.fallbacks``/
-``plan.declined`` counters, a ``plan`` span wrapping each run with one
+``plan.declined`` counters (plus ``plan.mesh_segments``/
+``plan.mesh_declined``/``plan.mesh_fallbacks`` when a mesh runner is
+offered — see ``parallel/planmesh.py``), a ``plan`` span wrapping each run with one
 ``plan.segment`` span per segment, ``plan.fallback`` flight instants,
 and the ``compile_cache.miss`` instants ``cached_jit`` already emits
 (fused executables are named ``srt_fused_plan`` so ``jax.log_compiles``
@@ -452,11 +454,22 @@ def run_plan(
     table: Table,
     rest: Sequence[Table] = (),
     donate_input: bool = False,
+    mesh_runner=None,
 ) -> Table:
     """Execute a plan (a list of op dicts) over ``table``; returns the
     final (possibly padded) Table. The chain's flowing table is always
     the FIRST input of every op; ``rest`` supplies extra tables for
     multi-table segment-boundary ops (see ``_take_rest``).
+
+    ``mesh_runner`` (a ``parallel.tolerant.MeshRunner``) offers the
+    plan to the mesh data-parallel path first: row-local plans run
+    sharded over the runner's mesh with fault-tolerant replay
+    (``parallel/planmesh.py``). A plan with no mesh path falls through
+    here silently; a mesh whose degradation ladder hits its device
+    floor falls back to this single-device exact path (metered as
+    ``plan.mesh_fallbacks`` — the serving tier's keep-the-tenant
+    guarantee). The mesh path never consumes ``table``, so both
+    fallbacks are safe even with ``donate_input=True``.
 
     ``donate_input=True`` declares ``table`` consumed by this plan —
     nothing else holds its buffers (a wire upload, a resident id the
@@ -476,6 +489,29 @@ def run_plan(
     for op in ops:
         if not isinstance(op, dict) or "op" not in op:
             raise ValueError(f"plan entries must be op objects, got {op!r}")
+    if mesh_runner is not None:
+        from .parallel import planmesh
+
+        try:
+            out = planmesh.run_plan_mesh(ops, table, mesh_runner, rest)
+            metrics.counter_add("plan.mesh_segments")
+            return out
+        except planmesh.MeshUnsupported:
+            # not a failure: this plan has no mesh path
+            metrics.counter_add("plan.mesh_declined")
+        except faults.Degraded as e:
+            # collective failures persisted down to the runner's device
+            # floor: the single-device exact path below IS the
+            # degradation target — the mesh path never consumed the
+            # input, so the replay lineage is intact
+            metrics.counter_add("plan.mesh_fallbacks")
+            faults.note_error_class(e, "plan.mesh")
+            if flight.enabled():
+                flight.record("I", "plan.mesh_fallback", str(e)[:160])
+            log.log(
+                "WARN", "plan", "mesh_degraded_to_exact",
+                error=f"{type(e).__name__}: {str(e)[:200]}",
+            )
     orig_rest = tuple(rest)
     queue = list(orig_rest)
     if buckets.enabled():

@@ -124,12 +124,17 @@ class Client:
                  name: Optional[str] = None, weight: float = 1.0,
                  session: Optional[str] = None, timeout: float = 60.0,
                  deadline_s: Optional[float] = None,
-                 resume: Optional[str] = None):
+                 resume: Optional[str] = None,
+                 mesh: Optional[int] = None):
         self._addr = (host, int(port))
+        # mesh=N asks for mesh-backed execution over N devices
+        # (0/None = single-device); an impossible count is a typed
+        # bad_request at hello, naming the remedy
         self._hello = {
             k: v for k, v in (
                 ("name", name), ("weight", weight), ("session", session),
                 ("deadline_s", deadline_s), ("resume", resume),
+                ("mesh", mesh),
             ) if v is not None
         }
         self._timeout = timeout

@@ -173,6 +173,11 @@ class Server:
         self._quarantined: dict = {}    # sid -> quarantine reason
         self._manifest: Optional[durable.Manifest] = None
         self._restore_doc: Optional[dict] = None
+        # mesh-backed sessions (parallel/tolerant.py): one shared
+        # MeshRunner per requested device count — the degradation
+        # ladder's state (surviving mesh, counters) is daemon-wide, so
+        # a mesh that shrank for one tenant stays shrunk for the next
+        self._mesh_runners: dict = {}
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Server":
@@ -472,6 +477,26 @@ class Server:
         frames.send_frame(sock, doc)
         return sess
 
+    def _mesh_runner(self, n_devices: int):
+        """The shared MeshRunner for ``n_devices`` (None when 0).
+
+        Construction happens OUTSIDE the server lock (mesh setup can
+        compile); a racing duplicate loses to ``setdefault`` and is
+        dropped. ValueError from an impossible device count propagates
+        to the hello/stream error path as a typed bad_request."""
+        n = int(n_devices or 0)
+        if not n:
+            return None
+        with self._lock:
+            runner = self._mesh_runners.get(n)
+        if runner is not None:
+            return runner
+        from ..parallel.tolerant import MeshRunner
+
+        runner = MeshRunner(n)
+        with self._lock:
+            return self._mesh_runners.setdefault(n, runner)
+
     def _attach(self, header) -> Session:
         sid = header.get("session")
         weight = float(header.get("weight", 1.0) or 1.0)
@@ -480,6 +505,16 @@ class Server:
             raise ValueError(
                 f"hello: deadline_s must be >= 0, got {deadline_s}"
             )
+        mesh_devices = int(header.get("mesh") or 0)
+        if mesh_devices < 0:
+            raise ValueError(
+                f"hello: mesh must be >= 0 devices, got {mesh_devices}"
+            )
+        if mesh_devices:
+            # eager loud-fail: a device count this host cannot mesh
+            # answers a typed bad_request AT HELLO (make_mesh names the
+            # remedy), not an internal error on the first stream
+            self._mesh_runner(mesh_devices)
         dur = durable.enabled()
         with self._lock:
             if self._draining:
@@ -507,6 +542,8 @@ class Server:
                 sess.connections += 1
                 if deadline_s:
                     sess.deadline_s = deadline_s
+                if mesh_devices:
+                    sess.mesh_devices = mesh_devices
                 return sess
             if len(self._sessions) >= self.max_sessions:
                 raise SessionLimit(
@@ -520,6 +557,7 @@ class Server:
             )
             sess = Session(new_id, name, weight, budget)
             sess.deadline_s = deadline_s
+            sess.mesh_devices = mesh_devices
             sess.connections = 1
             self._sessions[new_id] = sess
             self._sessions_served += 1
@@ -758,6 +796,12 @@ class Server:
                 flight.record("I", "serving.stream", f"{sess.name}:{n}")
 
             man = self._manifest if durable.enabled() else None
+            # mesh-backed session: offer every batch's plan to the
+            # shared runner; run_plan falls back to the single-device
+            # exact path on MeshUnsupported or a degraded-out mesh
+            # (the keep-the-tenant guarantee — metered, typed), so
+            # donation stays safe either way
+            runner = self._mesh_runner(sess.mesh_devices)
 
             def make_work(b):
                 def work():
@@ -770,7 +814,10 @@ class Server:
                         # warm-start manifest: the decoded (padded)
                         # table carries the exact compile signature
                         man.note(ops, [tbl], True)
-                    out = plan_mod.run_plan(ops, tbl, donate_input=True)
+                    out = plan_mod.run_plan(
+                        ops, tbl, donate_input=True,
+                        mesh_runner=runner,
+                    )
                     return rb._table_to_wire(out)
 
                 return work
@@ -983,6 +1030,7 @@ class Server:
         with self._lock:
             sessions = [s.to_doc() for s in self._sessions.values()]
             served = self._sessions_served
+            runners = list(self._mesh_runners.values())
         return {
             "port": self.port,
             "max_sessions": self.max_sessions,
@@ -993,6 +1041,7 @@ class Server:
             "resident_tables": rb.resident_table_count(),
             "spill": spill.stats_doc(),
             "breaker": self.breaker.to_doc(),
+            "mesh": [r.to_doc() for r in runners],
             "durability": {
                 **durable.stats_doc(),
                 "draining": self._draining,

@@ -81,6 +81,12 @@ class Session:
         # session-default request deadline (seconds) from the hello
         # frame; per-command headers override, 0 means none
         self.deadline_s = 0.0
+        # mesh-backed execution: hello ``mesh`` header device count; 0
+        # (default) = single-device. Streams offer their plans to the
+        # server's MeshRunner for that count; the degradation ladder
+        # falls back to the single-device exact path rather than
+        # shedding this tenant
+        self.mesh_devices = 0
         self.created = time.time()
         self.connections = 0
         self.closed = False
@@ -368,6 +374,7 @@ class Session:
                 "spilled_tables": len(self._spilled_rb),
                 "tables": len(self._tables),
                 "connections": self.connections,
+                "mesh_devices": self.mesh_devices,
                 **dict(self.stats),
             }
         doc["queue_wait"] = self.wait_percentiles()

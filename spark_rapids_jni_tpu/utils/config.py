@@ -291,7 +291,7 @@ _FLAGS = {
             "deterministic fault-injection plan (utils/faults.py): "
             "'[seed=N,]site:kind:prob[:count],...' — site in "
             "dispatch|compile|serde|hbm_admit|serve_accept|spill|"
-            "checkpoint, kind in "
+            "checkpoint|shuffle|collective|mesh, kind in "
             "transient|oom|permanent, prob in [0,1], count = max "
             "injections (0/absent = unlimited); '' (default) = off",
         ),
@@ -365,6 +365,14 @@ _FLAGS = {
             _parse_positive_float("BREAKER_PROBE_S"),
             "serving circuit breaker: seconds an OPEN breaker waits "
             "before letting one half-open probe through",
+        ),
+        Flag(
+            "MESH_PROBE_S", 5.0,
+            _parse_positive_float("MESH_PROBE_S"),
+            "deadline in seconds for one MeshHealth heartbeat "
+            "(parallel/mesh.py): an all-reduce that has not answered "
+            "by then marks the probed mesh unhealthy and the "
+            "degradation ladder drops to fewer devices",
         ),
         Flag(
             "LOCKCHECK", False, _as_bool,

@@ -158,8 +158,14 @@ def retryable_class(cls: type) -> bool:
 #   spill        utils/spill.py eviction copy-out + repage upload
 #   checkpoint   serving/durable.py journal append (torn-write
 #                emulation), payload persist, and restore-time read
+#   shuffle      parallel/shuffle.py host wrappers: every exchange
+#                pack/all_to_all/unpack launch boundary
+#   collective   parallel/distributed.py + parallel/planmesh.py: every
+#                shard_map launch of a distributed op or mesh stage
+#   mesh         parallel/mesh.py: mesh construction (make_mesh) and
+#                the MeshHealth heartbeat probe
 SITES = ("dispatch", "compile", "serde", "hbm_admit", "serve_accept",
-         "spill", "checkpoint")
+         "spill", "checkpoint", "shuffle", "collective", "mesh")
 
 KINDS = ("transient", "oom", "permanent")
 

@@ -1,0 +1,457 @@
+"""Chaos matrix for distributed fault tolerance (ISSUE 15).
+
+The contract under test, on the 8-device CPU mesh: seeded
+``shuffle``/``collective``/``mesh``-site faults provoke transient,
+permanent, and dead-slice failures inside the exchange launches, and the
+plane recovers with results BYTE-IDENTICAL to a faults-off run —
+lineage replay re-runs only the failed exchange, donated inputs are
+at-most-once (zero retries, a ``shuffle.giveups`` bump), and persistent
+collective failure walks the ``MeshRunner`` ladder down to the
+surviving device count (8 -> 4 -> 2 -> 1) with parity preserved at
+every rung because row-local mesh plans are mesh-size independent. At
+the floor a typed ``Degraded`` falls the plan back to the single-device
+exact path — a mesh-backed serving session degrades, it does not shed
+the tenant. The disabled injection gate stays under 5 µs per call.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtype as dt
+from spark_rapids_jni_tpu import plan as plan_mod
+from spark_rapids_jni_tpu import runtime_bridge as rb
+from spark_rapids_jni_tpu import serving
+from spark_rapids_jni_tpu import parallel
+from spark_rapids_jni_tpu.column import Table
+from spark_rapids_jni_tpu.ops.groupby import GroupbyAgg
+from spark_rapids_jni_tpu.utils import config, faults, metrics
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+
+I64 = int(dt.TypeId.INT64)
+F64 = int(dt.TypeId.FLOAT64)
+B8 = int(dt.TypeId.BOOL8)
+
+BOUNDARY_SIZES = (1023, 1024, 1025)
+
+# row-local chain: the mesh path shards it as contiguous row blocks
+ROW_LOCAL_CHAIN = [
+    {"op": "filter", "mask": 1},
+    {"op": "cast", "column": 0, "type_id": F64},
+]
+
+# ends in a global op: the mesh path must decline it (MeshUnsupported)
+GLOBAL_CHAIN = [
+    {"op": "cast", "column": 0, "type_id": F64},
+    {"op": "sort_by", "keys": [{"column": 0}]},
+]
+
+CHAOS_FLAGS = (
+    "FAULTS", "RETRY_MAX", "RETRY_BASE_MS", "MESH_PROBE_S",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    for name in CHAOS_FLAGS + ("BUCKETS", "METRICS"):
+        config.clear_flag(name)
+
+
+@pytest.fixture
+def mesh():
+    return parallel.make_mesh(8)
+
+
+def _plan_table(n: int, seed: int = 0) -> Table:
+    rng = np.random.default_rng(n + seed)
+    return Table.from_pydict({
+        "x": rng.integers(-50, 50, n, dtype=np.int64),
+        "m": rng.integers(0, 3, n, dtype=np.int64) > 0,
+    })
+
+
+def _tbl(t: Table):
+    """Byte-comparable logical view. The exact path may hand back a
+    padded table carrying ``logical_rows`` (the wire layer slices it);
+    the mesh path gathers the exact prefix — compare logical content."""
+    n = int(t.logical_row_count)
+    cols = []
+    for c in t.columns:
+        data = np.asarray(c.data)
+        cols.append((
+            str(data.dtype),
+            data[:n].tolist(),
+            None if c.validity is None
+            else np.asarray(c.validity)[:n].tolist(),
+        ))
+    return (n, cols)
+
+
+def _shuffle_multiset(out, occ):
+    """Order-free content of a shuffled table: (k, v) multiset."""
+    occ_np = np.asarray(occ)
+    got_k = np.asarray(out["k"].data)[occ_np]
+    got_v = np.asarray(out["v"].data)[occ_np]
+    return sorted(zip(got_k.tolist(), got_v.tolist()))
+
+
+def _counter(name: str) -> int:
+    return int(metrics.snapshot()["counters"].get(name, 0))
+
+
+# ---------------------------------------------------------------------------
+# site registration + the disabled-path cost gate
+# ---------------------------------------------------------------------------
+
+
+class TestChaosSites:
+    def test_distributed_sites_registered(self):
+        assert {"shuffle", "collective", "mesh"} <= set(faults.SITES)
+
+    def test_disabled_inject_under_five_microseconds(self):
+        iters = 20_000
+        for site in ("shuffle", "collective", "mesh"):
+            faults.inject(site)  # warm the gate
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                faults.inject(site)
+            per = (time.perf_counter() - t0) / iters
+            assert per < 5e-6, f"{site}: {per * 1e6:.2f}us per call"
+
+
+# ---------------------------------------------------------------------------
+# satellite: overflow errors flow through the taxonomy as permanent
+# ---------------------------------------------------------------------------
+
+
+class TestOverflowClassification:
+    @pytest.mark.parametrize("exc_cls", (
+        parallel.ShuffleOverflowError,
+        parallel.JoinOverflowError,
+        parallel.GroupOverflowError,
+    ))
+    def test_overflow_is_typed_permanent(self, exc_cls):
+        e = exc_cls("capacity 16 overflowed")
+        # still a RuntimeError for pre-taxonomy callers
+        assert isinstance(e, RuntimeError)
+        assert isinstance(e, faults.PermanentError)
+        assert not faults.retryable_class(faults.classify(e))
+
+    def test_undersized_shuffle_not_retried(self, mesh):
+        config.set_flag("METRICS", "1")
+        before = _counter("shuffle.retries")
+        t = Table.from_pydict({"k": np.full(128, 7, dtype=np.int64),
+                               "v": np.arange(128, dtype=np.int64)})
+        with pytest.raises(parallel.ShuffleOverflowError):
+            parallel.shuffle_table(t, ["k"], mesh, capacity=8)
+        assert _counter("shuffle.retries") == before
+
+
+# ---------------------------------------------------------------------------
+# satellite: loud-fail validation in mesh construction + sharding
+# ---------------------------------------------------------------------------
+
+
+class TestLoudFailValidation:
+    def test_make_mesh_names_shape_and_remedy(self):
+        with pytest.raises(ValueError) as ei:
+            parallel.make_mesh(1024)
+        msg = str(ei.value)
+        assert "1024" in msg and "XLA_FLAGS" in msg
+
+    def test_make_mesh_rejects_zero(self):
+        with pytest.raises(ValueError):
+            parallel.make_mesh(0)
+
+    def test_shard_table_names_axis_and_remedy(self, mesh):
+        t = Table.from_pydict({"k": np.arange(13, dtype=np.int64)})
+        with pytest.raises(ValueError) as ei:
+            parallel.shard_table(t, mesh)
+        msg = str(ei.value)
+        assert "shuffle" in msg and "divisible" in msg and "13" in msg
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos on the shuffle exchange: replay parity, typed permanents,
+# at-most-once for donated inputs
+# ---------------------------------------------------------------------------
+
+
+class TestShuffleChaos:
+    def test_transient_replays_to_parity(self, mesh):
+        config.set_flag("METRICS", "1")
+        config.set_flag("RETRY_BASE_MS", "1")
+        n = 1024
+        rng = np.random.default_rng(7)
+        t = Table.from_pydict({
+            "k": rng.integers(0, 60, n, dtype=np.int64),
+            "v": rng.integers(-100, 100, n, dtype=np.int64),
+        })
+        out, occ, _ = parallel.shuffle_table(t, ["k"], mesh, capacity=n)
+        want = _shuffle_multiset(out, occ)
+        before = _counter("shuffle.retries")
+        config.set_flag("FAULTS", "seed=11,shuffle:transient:1:2")
+        out, occ, _ = parallel.shuffle_table(t, ["k"], mesh, capacity=n)
+        assert _shuffle_multiset(out, occ) == want
+        assert faults.injection_stats()["shuffle:transient"]["injected"] == 2
+        assert _counter("shuffle.retries") - before >= 2
+
+    def test_permanent_surfaces_typed_without_retry(self, mesh):
+        config.set_flag("METRICS", "1")
+        before = _counter("shuffle.retries")
+        config.set_flag("FAULTS", "shuffle:permanent:1:1")
+        t = Table.from_pydict({"k": np.arange(256, dtype=np.int64),
+                               "v": np.arange(256, dtype=np.int64)})
+        with pytest.raises(faults.PermanentError):
+            parallel.shuffle_table(t, ["k"], mesh, capacity=256)
+        assert _counter("shuffle.retries") == before
+
+    def test_donated_input_is_at_most_once(self, mesh):
+        config.set_flag("METRICS", "1")
+        config.set_flag("RETRY_BASE_MS", "1")
+        n = 512
+        t = Table.from_pydict({"k": np.arange(n, dtype=np.int64),
+                               "v": np.arange(n, dtype=np.int64)})
+        retries = _counter("shuffle.retries")
+        giveups = _counter("shuffle.giveups")
+        config.set_flag("FAULTS", "seed=1,shuffle:transient:1:1")
+        with pytest.raises(faults.TransientDeviceError):
+            parallel.shuffle_table(
+                t, ["k"], mesh, capacity=n, donate_input=True
+            )
+        # the first transient surfaced: ZERO replays of consumed buffers
+        assert _counter("shuffle.retries") == retries
+        assert _counter("shuffle.giveups") - giveups >= 1
+        # fault-free donated run still works and stays lossless
+        config.set_flag("FAULTS", "")
+        out, occ, overflow = parallel.shuffle_table(
+            t, ["k"], mesh, capacity=n, donate_input=True
+        )
+        assert int(np.asarray(overflow).max()) <= 0
+        assert int(np.asarray(occ).sum()) == n
+
+    def test_collective_faults_inside_groupby_recover(self, mesh):
+        config.set_flag("RETRY_BASE_MS", "1")
+        config.set_flag("FAULTS", "seed=13,collective:transient:1:2")
+        n = 1024
+        rng = np.random.default_rng(13)
+        k = rng.integers(0, 40, n, dtype=np.int64)
+        v = rng.integers(-100, 100, n, dtype=np.int64)
+        t = Table.from_pydict({"k": k, "v": v})
+        agg, ngroups, overflow = parallel.distributed_groupby(
+            t, ["k"], [GroupbyAgg("v", "sum")], mesh,
+        )
+        assert int(np.asarray(overflow).max()) <= 0
+        got = {}
+        ks = np.asarray(agg["k"].data).reshape(8, -1)
+        sums = np.asarray(agg["sum_v"].data).reshape(8, -1)
+        counts = np.asarray(ngroups)
+        for d in range(8):
+            for i in range(counts[d]):
+                got[int(ks[d, i])] = int(sums[d, i])
+        want = {int(u): int(v[k == u].sum()) for u in np.unique(k)}
+        assert got == want
+        assert faults.injection_stats()["collective:transient"][
+            "injected"] == 2
+
+
+# ---------------------------------------------------------------------------
+# mesh degradation ladder: halve, probe, replay; typed Degraded at floor
+# ---------------------------------------------------------------------------
+
+
+class TestMeshDegradation:
+    def test_ladder_halves_probes_and_replays(self):
+        config.set_flag("RETRY_MAX", "0")
+        config.set_flag("METRICS", "1")
+        degraded_before = _counter("mesh.degraded")
+        runner = parallel.MeshRunner(8)
+        sizes = []
+
+        def stage(mesh):
+            size = int(mesh.shape["shuffle"])
+            sizes.append(size)
+            if size > 2:
+                raise faults.TransientDeviceError(
+                    f"UNAVAILABLE: slice lost at {size}"
+                )
+            return "ok"
+
+        assert runner.run_stage("chaos.stage", stage) == "ok"
+        assert sizes == [8, 4, 2]  # 8 -> 4 -> 2, success at 2
+        doc = runner.to_doc()
+        assert doc["degraded"] is True
+        assert doc["devices"] == 2 and doc["requested_devices"] == 8
+        assert doc["replays"] == 2 and doc["degradations"] == 2
+        assert _counter("mesh.degraded") - degraded_before == 2
+
+    def test_floor_raises_typed_degraded(self):
+        config.set_flag("RETRY_MAX", "0")
+        config.set_flag("METRICS", "1")
+        runner = parallel.MeshRunner(2, min_devices=2)
+
+        def stage(mesh):
+            raise faults.TransientDeviceError("UNAVAILABLE: dead slice")
+
+        with pytest.raises(faults.Degraded) as ei:
+            runner.run_stage("chaos.floor", stage)
+        assert "2-device floor" in str(ei.value)
+        assert _counter("mesh.exhausted") >= 1
+
+    def test_health_probe_answers_on_live_mesh(self, mesh):
+        assert parallel.MeshHealth().probe(mesh) is True
+
+    def test_health_probe_fails_on_injected_mesh_fault(self, mesh):
+        config.set_flag("METRICS", "1")
+        before = _counter("mesh.probe_failures")
+        config.set_flag("FAULTS", "mesh:transient:1:1")
+        assert parallel.MeshHealth().probe(mesh) is False
+        assert _counter("mesh.probe_failures") - before == 1
+
+    def test_make_mesh_is_an_injection_site(self):
+        config.set_flag("FAULTS", "mesh:permanent:1:1")
+        with pytest.raises(faults.PermanentError):
+            parallel.make_mesh(8)
+
+
+# ---------------------------------------------------------------------------
+# mesh-backed plans: parity at bucket edges, parity through degradation,
+# exact-path fallback at the floor, declines for unsupported chains
+# ---------------------------------------------------------------------------
+
+
+class TestPlanMesh:
+    @pytest.mark.parametrize("n", BOUNDARY_SIZES)
+    def test_parity_at_bucket_edges(self, n):
+        config.set_flag("BUCKETS", "")
+        t = _plan_table(n)
+        want = _tbl(plan_mod.run_plan(ROW_LOCAL_CHAIN, t))
+        runner = parallel.MeshRunner(8)
+        got = _tbl(plan_mod.run_plan(ROW_LOCAL_CHAIN, t,
+                                     mesh_runner=runner))
+        assert got == want
+        assert runner.to_doc()["degraded"] is False
+
+    def test_parity_through_full_ladder(self):
+        """Three dead-slice events walk the mesh 8 -> 4 -> 2 -> 1; the
+        replay on each smaller mesh stays byte-identical because
+        row-local plans are mesh-size independent."""
+        config.set_flag("BUCKETS", "")
+        config.set_flag("RETRY_MAX", "0")
+        config.set_flag("METRICS", "1")
+        n = 1024
+        t = _plan_table(n)
+        want = _tbl(plan_mod.run_plan(ROW_LOCAL_CHAIN, t))
+        config.set_flag("FAULTS", "seed=2,collective:transient:1:3")
+        runner = parallel.MeshRunner(8)
+        got = _tbl(plan_mod.run_plan(ROW_LOCAL_CHAIN, t,
+                                     mesh_runner=runner))
+        assert got == want
+        doc = runner.to_doc()
+        assert doc["degraded"] is True and doc["devices"] == 1
+        assert doc["replays"] == 3
+
+    def test_floor_falls_back_to_exact_path(self):
+        """Unbounded collective failure exhausts the ladder; the plan
+        degrades to the single-device exact path instead of failing."""
+        config.set_flag("BUCKETS", "")
+        config.set_flag("RETRY_MAX", "0")
+        config.set_flag("METRICS", "1")
+        n = 1023
+        t = _plan_table(n)
+        want = _tbl(plan_mod.run_plan(ROW_LOCAL_CHAIN, t))
+        fallbacks = _counter("plan.mesh_fallbacks")
+        config.set_flag("FAULTS", "collective:transient:1")
+        runner = parallel.MeshRunner(8)
+        got = _tbl(plan_mod.run_plan(ROW_LOCAL_CHAIN, t,
+                                     mesh_runner=runner))
+        config.set_flag("FAULTS", "")
+        assert got == want
+        assert _counter("plan.mesh_fallbacks") - fallbacks == 1
+        assert _counter("mesh.exhausted") >= 1
+
+    def test_global_chain_declined_to_exact(self):
+        config.set_flag("BUCKETS", "")
+        config.set_flag("METRICS", "1")
+        t = _plan_table(512)
+        want = _tbl(plan_mod.run_plan(GLOBAL_CHAIN, t))
+        declined = _counter("plan.mesh_declined")
+        runner = parallel.MeshRunner(8)
+        got = _tbl(plan_mod.run_plan(GLOBAL_CHAIN, t, mesh_runner=runner))
+        assert got == want
+        assert _counter("plan.mesh_declined") - declined == 1
+
+
+# ---------------------------------------------------------------------------
+# serving: a mesh-backed session serves byte-identical streams, and
+# degrades to the exact path under chaos instead of shedding the tenant
+# ---------------------------------------------------------------------------
+
+
+def _wire_cols(n: int, seed: int = 0):
+    rng = np.random.default_rng(n + seed)
+    k = rng.integers(-50, 50, n, dtype=np.int64)
+    mask = (rng.integers(0, 3, n, dtype=np.int64) > 0).astype(np.uint8)
+    return ([I64, B8], [0, 0], [k.tobytes(), mask.tobytes()],
+            [None, None], n)
+
+
+def _norm(wire):
+    t, s, d, v, n = wire
+    return (
+        [int(x) for x in t], [int(x) for x in s],
+        [None if x is None else bytes(x) for x in d],
+        [None if x is None else bytes(x) for x in v], int(n),
+    )
+
+
+class TestServingMesh:
+    def test_mesh_session_streams_byte_identical(self):
+        config.set_flag("BUCKETS", "")
+        batches = [_wire_cols(1023), _wire_cols(1024)]
+        with serving.serve() as srv:
+            with serving.Client(srv.port, name="plain") as c:
+                want = [_norm(r) for r in c.stream(ROW_LOCAL_CHAIN,
+                                                   batches)]
+            with serving.Client(srv.port, name="meshed", mesh=8) as c:
+                got = [_norm(r) for r in c.stream(ROW_LOCAL_CHAIN,
+                                                  batches)]
+            assert got == want
+            docs = srv.stats()["mesh"]
+            assert docs and docs[0]["requested_devices"] == 8
+        assert rb.leak_report() == []
+
+    def test_mesh_session_degrades_not_sheds(self):
+        config.set_flag("BUCKETS", "")
+        config.set_flag("RETRY_MAX", "0")
+        config.set_flag("METRICS", "1")
+        batch = _wire_cols(1024)
+        with serving.serve() as srv:
+            with serving.Client(srv.port, name="plain") as c:
+                want = [_norm(r) for r in c.stream(ROW_LOCAL_CHAIN,
+                                                   [batch])]
+            fallbacks = _counter("plan.mesh_fallbacks")
+            config.set_flag("FAULTS", "collective:transient:1")
+            with serving.Client(srv.port, name="meshed", mesh=8) as c:
+                got = [_norm(r) for r in c.stream(ROW_LOCAL_CHAIN,
+                                                  [batch])]
+            config.set_flag("FAULTS", "")
+            assert got == want  # served exactly, not shed
+            assert _counter("plan.mesh_fallbacks") - fallbacks == 1
+        assert rb.leak_report() == []
+
+    def test_impossible_mesh_count_is_typed_at_hello(self):
+        with serving.serve() as srv:
+            with pytest.raises(serving.ServingError) as ei:
+                serving.Client(srv.port, mesh=1024).connect()
+            assert ei.value.type == "bad_request"
+            assert "XLA_FLAGS" in str(ei.value)
+            with pytest.raises(serving.ServingError) as ei:
+                serving.Client(srv.port, mesh=-4).connect()
+            assert ei.value.type == "bad_request"

@@ -115,6 +115,14 @@ DETERMINISM_MODULES = (
 HOT_SYNC_MODULES = (
     os.path.join("spark_rapids_jni_tpu", "plan.py"),
     os.path.join("spark_rapids_jni_tpu", "bucketed.py"),
+    # the distributed tier: syncs here stall every device on the mesh,
+    # so the deliberate ones (two-phase sizing, overflow verdicts,
+    # result gathers) carry allow-host-sync pragmas and anything new
+    # gets flagged
+    os.path.join("spark_rapids_jni_tpu", "parallel", "mesh.py"),
+    os.path.join("spark_rapids_jni_tpu", "parallel", "shuffle.py"),
+    os.path.join("spark_rapids_jni_tpu", "parallel", "distributed.py"),
+    os.path.join("spark_rapids_jni_tpu", "parallel", "planmesh.py"),
 )
 
 # attribute names that denote DEVICE buffers on a Column/Table — an
@@ -162,6 +170,7 @@ METRIC_NAMESPACES = frozenset({
     "session", "retry", "faults", "breaker", "fault", "spill", "lock",
     "shuffle", "distributed", "io", "probe", "bench", "groupby",
     "join", "sort", "profile", "stream", "checkpoint", "restore",
+    "mesh",
 })
 METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
