@@ -138,14 +138,42 @@ def _metrics_enable():
     the package installed). The flight recorder is the crash telemetry:
     its tail rides in every structured failure record and is flushed to
     SPARK_RAPIDS_TPU_FLIGHT_DUMP from the SIGTERM handler."""
+    import os
+    import tempfile
+
     try:
         from spark_rapids_jni_tpu.utils import config as _srt_config
 
         _srt_config.set_flag("METRICS", True)
         _srt_config.set_flag("FLIGHT", True)
         _srt_config.set_flag("PROFILE", "on")
+        # plan-stats store: a per-run directory (inherited by the
+        # config subprocesses through the environment) so every arm's
+        # run_plan executions land drift-comparable records the
+        # headline's "drift" block summarizes
+        pdir = os.path.join(
+            tempfile.gettempdir(), f"srt-bench-planstats-{os.getpid()}"
+        )
+        # srt: allow-env-read(dir must ride env into config subprocesses)
+        pdir = os.environ.setdefault(
+            "SPARK_RAPIDS_TPU_PLANSTATS_DIR", pdir
+        )
+        _srt_config.set_flag("PLANSTATS_DIR", pdir)
     except Exception:
         pass
+
+
+def _drift_block():
+    """Compact drift summary from this run's plan-stats store for the
+    headline JSON (record/plan counts + findings by type), or None when
+    the store is absent/empty — old readers never see the key change
+    shape."""
+    try:
+        from spark_rapids_jni_tpu.utils import planstats as _srt_planstats
+
+        return _srt_planstats.summary()
+    except Exception:
+        return None
 
 
 def _flush_telemetry():
@@ -1740,12 +1768,21 @@ def bench_tpcds(platform, scale=None):
     return entries
 
 
-def bench_tpcds_distributed(devices: int = 8, scale: float = 0.05):
+def bench_tpcds_distributed(devices: int = 8, scale: float = 0.05,
+                            timeout_s: float = 1800.0):
     """Config 4: the same Parquet files through the mesh-distributed
-    q5/q23/q64 DAGs on the virtual CPU mesh (simulation wall-clock)."""
+    q5/q23/q64 DAGs on the virtual CPU mesh (simulation wall-clock).
+
+    ``timeout_s`` bounds the WHOLE arm (parquet generation + the mesh
+    subprocess); overrunning raises subprocess.TimeoutExpired, which
+    the ``_guard`` caller turns into a structured ``{type:"timeout"}``
+    failure record — the r04 rc=124 postmortem: this arm used to start
+    with minutes of budget left and run unbounded to the driver's
+    kill."""
     import os
     import subprocess
 
+    t0 = time.time()
     cache = f"/tmp/srt_tpcds_sf{scale}"
     if not os.path.exists(os.path.join(cache, "store_sales.parquet")):
         from benchmarks import tpcds
@@ -1763,7 +1800,7 @@ def bench_tpcds_distributed(devices: int = 8, scale: float = 0.05):
     )
     out = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
-        timeout=1800, env=env,
+        timeout=max(timeout_s - (time.time() - t0), 60.0), env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     for line in out.stdout.splitlines():
@@ -1776,10 +1813,15 @@ def bench_tpcds_distributed(devices: int = 8, scale: float = 0.05):
     return None
 
 
-def bench_distributed_skew():
+def bench_distributed_skew(timeout_s: float = 900.0):
     """Config 4 shape at 1e7 rows: zipf-skew distributed groupby through
     the ragged-compact exchange on the virtual 8-device CPU mesh (the
-    multi-chip path; numbers are CPU-simulation, labeled as such)."""
+    multi-chip path; numbers are CPU-simulation, labeled as such).
+
+    An overrun of ``timeout_s`` raises subprocess.TimeoutExpired out to
+    ``_guard``'s structured ``{type:"timeout"}`` record — this used to
+    be swallowed into a bare progress line, leaving the headline JSON
+    with no trace of the arm at all."""
     import os
     import subprocess
 
@@ -1795,7 +1837,7 @@ def bench_distributed_skew():
         out = subprocess.run(
             [sys.executable, "-m", "benchmarks.run", "--configs", "skew",
              "--devices", "8", "--rows", "10000000"],
-            capture_output=True, text=True, timeout=900, env=env,
+            capture_output=True, text=True, timeout=timeout_s, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         for line in reversed(out.stdout.strip().splitlines()):
@@ -1804,6 +1846,8 @@ def bench_distributed_skew():
             except json.JSONDecodeError:
                 continue
         _progress(f"skew run produced no JSON: {out.stderr[-500:]}")
+    except subprocess.TimeoutExpired:
+        raise
     except Exception as e:  # pragma: no cover
         _progress(f"skew run failed: {e}")
     return None
@@ -1812,11 +1856,23 @@ def bench_distributed_skew():
 def _guard(entries, name, fn):
     """Run one config; a failure records a structured failure entry
     instead of killing the whole ladder (the driver needs the JSON
-    line)."""
+    line). An arm that overruns its own wall-clock slice
+    (subprocess.TimeoutExpired) records the typed ``{type:"timeout"}``
+    failure — the arm is sacrificed, the headline line survives."""
+    import subprocess
+
     _progress(name)
     t0 = time.time()
     try:
         out = fn()
+    except subprocess.TimeoutExpired as e:
+        slice_s = float(e.timeout or 0.0)
+        _progress(f"  TIMEOUT after {slice_s:.0f}s")
+        entries.append(_failure_record(
+            name, f"timeout {slice_s:.0f}s", exc_type="timeout",
+            elapsed_s=time.time() - t0,
+        ))
+        return None
     except Exception as e:  # pragma: no cover
         _progress(f"  FAILED: {e}")
         entries.append(
@@ -2150,7 +2206,7 @@ def _spawn_config(entries, name: str, timeout_s: float = None):
     except subprocess.TimeoutExpired:
         _progress(f"  TIMEOUT after {timeout_s:.0f}s")
         entries.append(_failure_record(
-            name, f"timeout {timeout_s:.0f}s", exc_type="TimeoutExpired",
+            name, f"timeout {timeout_s:.0f}s", exc_type="timeout",
             elapsed_s=time.time() - t0, retries=_failure_count(name),
         ))
         return None
@@ -2461,6 +2517,7 @@ def _emit(entries, platform, arrow_rows_per_s=None):
             "vs_baseline": _num(vs, 3),
             "platform": platform,
             "headline_source": source,
+            "drift": _drift_block(),
             "configs": entries,
             "note": (
                 "Line re-printed after every config (take the LAST "
@@ -2628,19 +2685,50 @@ def main():
                 ))
         _emit(entries, platform)
 
-    # CPU-mesh configs (budgeted: these cannot be allowed to starve the
-    # flush loop — each needs _MESH_STAGE_FLOOR_S of budget left to
-    # start, since once started it runs to completion)
-    for mesh_name, mesh_fn in (
+    # CPU-mesh configs. Each arm gets its OWN wall-clock slice, clamped
+    # to the budget remaining minus the Arrow reserve: an arm that
+    # overruns is killed by its subprocess timeout and recorded as a
+    # structured {type:"timeout"} failure — never again the r04 rc=124
+    # where a stage started with minutes left and ran unbounded past
+    # the driver's kill, leaving parsed=null. The TPC-DS-from-parquet
+    # arm is additionally opt-in (SRT_BENCH_MESH_TPCDS=1): at ~30min
+    # worst case it ate the whole tail, and the skew arm already
+    # exercises the distributed exchange for the headline.
+    mesh_arms = [
         ("config 4: distributed zipf skew, 8-device CPU mesh",
-         bench_distributed_skew),
-        ("config 4: TPC-DS q5/q23/q64 from parquet, 8-dev mesh",
-         bench_tpcds_distributed),
+         bench_distributed_skew, 900.0),
+    ]
+    tpcds_name = "config 4: TPC-DS q5/q23/q64 from parquet, 8-dev mesh"
+    if os.environ.get("SRT_BENCH_MESH_TPCDS", "").strip().lower() in (
+        "1", "true", "yes", "on"
     ):
-        if time.time() > deadline - _MESH_STAGE_FLOOR_S:
+        mesh_arms.append((tpcds_name, bench_tpcds_distributed, 1800.0))
+    else:
+        _progress(
+            f"skipping {tpcds_name}: opt-in arm "
+            "(set SRT_BENCH_MESH_TPCDS=1)"
+        )
+        entries.append(_failure_record(
+            tpcds_name,
+            "skipped: opt-in arm (SRT_BENCH_MESH_TPCDS unset)",
+            exc_type="OptInSkipped", skipped=True,
+        ))
+    for mesh_name, mesh_fn, arm_cap_s in mesh_arms:
+        slice_s = min(arm_cap_s, deadline - time.time() - _ARROW_FLOOR_S)
+        if slice_s < _MESH_STAGE_FLOOR_S:
             _progress(f"skipping {mesh_name}: budget tail exhausted")
+            entries.append(_failure_record(
+                mesh_name,
+                f"skipped: budget {budget_s:.0f}s exhausted",
+                exc_type="BudgetExceeded",
+                elapsed_s=time.time() - t_start, skipped=True,
+            ))
+            _emit(entries, platform)
             continue
-        _guard(entries, mesh_name, mesh_fn)
+        _guard(
+            entries, mesh_name,
+            lambda fn=mesh_fn, s=slice_s: fn(timeout_s=s),
+        )
         _emit(entries, platform)
 
     # fresh Arrow denominator last: it only refines vs_baseline

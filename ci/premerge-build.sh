@@ -36,7 +36,8 @@ python3 tools/srt_check.py
 # scripts must tag clean under the plan-time analyzer (the GpuOverrides
 # analog) — a driver must never ship a plan the runtime would reject.
 python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh \
-  ci/smoke-chaos-mesh.sh ci/smoke-spill.sh ci/smoke-restart.sh
+  ci/smoke-chaos-mesh.sh ci/smoke-spill.sh ci/smoke-restart.sh \
+  ci/smoke-drift.sh
 
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).
@@ -93,6 +94,12 @@ bash ci/smoke-spill.sh
 # request ids apply nothing new, and replayed plans land on the
 # manifest-warmed compile cache with zero misses.
 bash ci/smoke-restart.sh
+
+# Drift smoke: every run_plan execution under a stats dir must append
+# a CRC-framed per-segment record; a seeded cardinality skew must land
+# a typed drift finding; `explain --drift` must render the store as
+# predicted-vs-observed percentiles.
+bash ci/smoke-drift.sh
 
 # Bench smoke on whatever device this node has.
 python3 bench.py

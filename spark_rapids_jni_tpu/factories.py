@@ -272,12 +272,32 @@ def run_plan(
     donate them and update HBM in place (``hbm.donated_bytes``). The
     caller must not touch ``table`` afterwards."""
     from . import plan as plan_mod
-    from .utils import buckets
+    from .utils import buckets, profiler
 
-    out = plan_mod.run_plan(
-        list(ops), table, tuple(rest), donate_input=donate_input
-    )
-    return buckets.unpad_table(out) if unpad else out
+    ops = list(ops)
+    schema = None
+    report = None
+    if profiler.enabled():
+        # key the plan-stats record like the wire entries do; static
+        # analysis here is observational only — plan.run_plan stays the
+        # loud validator for this path
+        from . import plancheck
+
+        try:
+            schema = plancheck.schema_of_table(table)
+            report = plancheck.analyze(
+                ops, schema=schema, rows=int(table.logical_row_count),
+            )
+        # srt: allow-broad-except(stats keying is best-effort; plan.run_plan still validates loudly)
+        except Exception:
+            schema = report = None
+    with profiler.maybe_session(
+        ops, label="plan_python", schema=schema, static=report,
+    ):
+        out = plan_mod.run_plan(
+            ops, table, tuple(rest), donate_input=donate_input
+        )
+        return buckets.unpad_table(out) if unpad else out
 
 
 # ---------------------------------------------------------------------------

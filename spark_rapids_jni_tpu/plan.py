@@ -491,14 +491,29 @@ def run_plan(
             raise ValueError(f"plan entries must be op objects, got {op!r}")
     if mesh_runner is not None:
         from .parallel import planmesh
+        from .utils import hbm
 
+        # the mesh path runs the whole plan as ONE sharded stage, so it
+        # gets one whole-plan "mesh" segment for attribution — the
+        # plan-stats record of a mesh run carries rows/bytes like the
+        # segment loop below does for the exact path
+        pseg = profiler.segment_begin(
+            0, "mesh", ops, rows_in=int(table.logical_row_count)
+        )
         try:
             out = planmesh.run_plan_mesh(ops, table, mesh_runner, rest)
             metrics.counter_add("plan.mesh_segments")
+            profiler.segment_end(
+                pseg, rows_out=int(out.logical_row_count),
+                out_bytes=hbm.table_bytes(out),
+            )
+            pseg = None
             return out
         except planmesh.MeshUnsupported:
             # not a failure: this plan has no mesh path
             metrics.counter_add("plan.mesh_declined")
+            profiler.segment_end(pseg)
+            pseg = None
         except faults.Degraded as e:
             # collective failures persisted down to the runner's device
             # floor: the single-device exact path below IS the
@@ -512,6 +527,13 @@ def run_plan(
                 "WARN", "plan", "mesh_degraded_to_exact",
                 error=f"{type(e).__name__}: {str(e)[:200]}",
             )
+            profiler.segment_end(pseg, fallback=True)
+            pseg = None
+        finally:
+            # an unexpected exception propagates: close the segment so
+            # the thread-local binding never leaks past this plan
+            if pseg is not None:
+                profiler.segment_end(pseg)
     orig_rest = tuple(rest)
     queue = list(orig_rest)
     if buckets.enabled():

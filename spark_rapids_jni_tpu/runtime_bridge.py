@@ -764,15 +764,15 @@ def table_plan_wire(
     # op index + reason and subclasses ValueError)
     from . import plancheck
 
-    plancheck.check_plan(
-        ops,
-        schema=plancheck.schema_from_wire(type_ids, scales),
-        rows=int(num_rows),
-    )
-    with profiler.maybe_session(ops, label="plan_wire"):
+    schema = plancheck.schema_from_wire(type_ids, scales)
+    report = plancheck.check_plan(ops, schema=schema, rows=int(num_rows))
+    pad_to = _plan_pad_to(ops, num_rows)
+    with profiler.maybe_session(
+        ops, label="plan_wire", schema=schema, bucket=pad_to,
+        static=report,
+    ):
         tbl = _table_from_wire(
-            type_ids, scales, datas, valids, num_rows,
-            _plan_pad_to(ops, num_rows),
+            type_ids, scales, datas, valids, num_rows, pad_to,
         )
         result = plan_mod.run_plan(ops, tbl, donate_input=True)
         return _table_to_wire(result)
@@ -806,15 +806,17 @@ def table_stream_wire(plan_json: str, batches: Sequence) -> list:
     from . import plancheck
 
     batches = list(batches)
+    schema = None
+    bucket = None
     if batches:
         first = batches[0]
-        plancheck.check_plan(
-            ops,
-            schema=plancheck.schema_from_wire(first[0], first[1]),
-            rows=int(first[4]),
+        schema = plancheck.schema_from_wire(first[0], first[1])
+        report = plancheck.check_plan(
+            ops, schema=schema, rows=int(first[4]),
         )
+        bucket = _plan_pad_to(ops, int(first[4]))
     else:
-        plancheck.check_plan(ops)
+        report = plancheck.check_plan(ops)
 
     def decode(batch):
         type_ids, scales, datas, valids, num_rows = batch
@@ -827,7 +829,8 @@ def table_stream_wire(plan_json: str, batches: Sequence) -> list:
         return plan_mod.run_plan(ops, tbl, donate_input=True)
 
     with profiler.maybe_session(
-        ops, label="stream", batches=len(batches)
+        ops, label="stream", batches=len(batches), schema=schema,
+        bucket=bucket, static=report,
     ):
         with metrics.span(
             "stream", batches=len(batches), depth=pipeline.depth()
@@ -1156,12 +1159,13 @@ def table_op_resident(
         spill.unpin_ids(table_ids[1:] if donate else table_ids)
 
 
-def _static_check_resident_plan(ops, table_ids: Sequence[int]) -> None:
+def _static_check_resident_plan(ops, table_ids: Sequence[int]):
     """Plan-time analysis for the resident entry: schemas come from the
     registry (a peek — no Pending resolution, so an in-flight input
     degrades the walk to structural validation instead of blocking the
     enqueue). Raises plancheck.PlanCheckError before any input capture,
-    pin, or pipeline enqueue."""
+    pin, or pipeline enqueue. Returns ``(report, head_schema)`` so the
+    caller can key the profile session's plan-stats record."""
     from . import plancheck
 
     def settled(tid):
@@ -1177,13 +1181,17 @@ def _static_check_resident_plan(ops, table_ids: Sequence[int]) -> None:
             if t is not None
             else (None, None)
         )
-    plancheck.check_plan(
+    head_schema = (
+        plancheck.schema_of_table(head) if head is not None else None
+    )
+    report = plancheck.check_plan(
         ops,
-        schema=plancheck.schema_of_table(head) if head is not None else None,
+        schema=head_schema,
         rows=int(head.logical_row_count) if head is not None else None,
         rest=rest,
         names=head.names if head is not None else None,
     )
+    return report, head_schema
 
 
 def table_plan_resident(
@@ -1210,14 +1218,17 @@ def table_plan_resident(
         raise TypeError(
             "table_plan_resident: plan must be a JSON list of ops"
         )
-    _static_check_resident_plan(ops, table_ids)
+    report, head_schema = _static_check_resident_plan(ops, table_ids)
     cell: dict = {}
 
     def work():
         # the session opens INSIDE the work closure so it scopes the
         # actual execution — on a pipeline worker when enqueued, on the
         # caller when synchronous — not the enqueue-and-return
-        with profiler.maybe_session(ops, label="plan_resident"):
+        with profiler.maybe_session(
+            ops, label="plan_resident", schema=head_schema,
+            static=report,
+        ):
             tables = pipeline.materialize_inputs(cell["inputs"])
             for p in cell["barrier"]:
                 p.settle_terminally()

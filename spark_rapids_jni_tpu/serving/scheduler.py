@@ -284,6 +284,12 @@ class FairScheduler:
                 t.error = e
                 faults.note_error_class(e, "serving." + t.label)
             t.end_t = time.perf_counter()
+            lat_s = t.end_t - t.submit_t
+            sess.note_latency(lat_s)
+            metrics.hist_observe(
+                "serving.latency_ms", lat_s * 1e3,
+                bounds=metrics.SPAN_MS_BOUNDS,
+            )
             with self._cv:
                 self._inflight[sess.id] = max(
                     self._inflight.get(sess.id, 1) - 1, 0

@@ -57,6 +57,7 @@ from ..utils import (
     lockcheck,
     log,
     metrics,
+    planstats,
     profiler,
     spill,
 )
@@ -422,8 +423,14 @@ class Server:
                     sess = self._cmd_hello(sock, header, sess)
                     continue
                 if cmd == "bye":
-                    frames.send_frame(sock, {"ok": True})
+                    # detach BEFORE the ack: the client treats the bye
+                    # reply as "slot freed", and may immediately open a
+                    # new session against max_sessions
                     clean = True
+                    if sess is not None:
+                        self._detach(sess, clean=True)
+                        sess = None
+                    frames.send_frame(sock, {"ok": True})
                     break
                 if sess is None:
                     frames.send_frame(sock, _error_header(
@@ -760,19 +767,20 @@ class Server:
         # bad_request (tagged report attached) BEFORE any scheduler
         # admission, HBM charge, or upload
         if batches:
-            plancheck.check_plan(
-                ops,
-                schema=plancheck.schema_from_wire(
-                    batches[0][0], batches[0][1]
-                ),
-                rows=int(batches[0][4]),
+            schema = plancheck.schema_from_wire(
+                batches[0][0], batches[0][1]
+            )
+            report = plancheck.check_plan(
+                ops, schema=schema, rows=int(batches[0][4]),
             )
         else:
-            plancheck.check_plan(ops)
+            schema = None
+            report = plancheck.check_plan(ops)
         n = len(batches)
         sess.stats["bytes_in"] += len(payload)
         scope = profiler.profile_session(
-            ops, label=f"serve:{sess.name}", batches=n
+            ops, label=f"serve:{sess.name}", batches=n,
+            schema=schema, static=report,
         )
         prof = scope.__enter__()
         results = [None] * n
@@ -1041,6 +1049,7 @@ class Server:
             "resident_tables": rb.resident_table_count(),
             "spill": spill.stats_doc(),
             "breaker": self.breaker.to_doc(),
+            "planstats": planstats.stats_doc(),
             "mesh": [r.to_doc() for r in runners],
             "durability": {
                 **durable.stats_doc(),

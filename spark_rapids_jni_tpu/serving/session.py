@@ -105,6 +105,7 @@ class Session:
         self._spilled_bytes = 0         # charged bytes currently off-device
         self._spilled_rb: set = set()   # rb ids of ours that are spilled
         self._waits = deque(maxlen=4096)  # queue-wait seconds
+        self._lats = deque(maxlen=4096)   # submit->done latency seconds
         self.stats = {
             "requests": 0,
             "shed": 0,
@@ -345,21 +346,33 @@ class Session:
         with self._lock:
             self.stats["shed"] += 1
 
-    def wait_percentiles(self) -> dict:
+    def note_latency(self, seconds: float) -> None:
+        """End-to-end submit->done latency of one scheduled request —
+        queue wait PLUS execution, the number the tenant experiences."""
         with self._lock:
-            waits = sorted(self._waits)
-        if not waits:
+            self._lats.append(float(seconds))
+
+    def _percentiles(self, samples) -> dict:
+        with self._lock:
+            vals = sorted(samples)
+        if not vals:
             return {"p50_ms": 0.0, "p95_ms": 0.0, "max_ms": 0.0}
 
         def pct(p):
-            i = min(int(p * (len(waits) - 1) + 0.5), len(waits) - 1)
-            return round(waits[i] * 1e3, 3)
+            i = min(int(p * (len(vals) - 1) + 0.5), len(vals) - 1)
+            return round(vals[i] * 1e3, 3)
 
         return {
             "p50_ms": pct(0.50),
             "p95_ms": pct(0.95),
-            "max_ms": round(waits[-1] * 1e3, 3),
+            "max_ms": round(vals[-1] * 1e3, 3),
         }
+
+    def wait_percentiles(self) -> dict:
+        return self._percentiles(self._waits)
+
+    def latency_percentiles(self) -> dict:
+        return self._percentiles(self._lats)
 
     def to_doc(self) -> dict:
         with self._cv:
@@ -378,6 +391,7 @@ class Session:
                 **dict(self.stats),
             }
         doc["queue_wait"] = self.wait_percentiles()
+        doc["latency"] = self.latency_percentiles()
         return doc
 
     # -- teardown ---------------------------------------------------------

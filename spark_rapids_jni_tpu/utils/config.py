@@ -262,6 +262,49 @@ _FLAGS = {
             "a non-empty path implies PROFILE",
         ),
         Flag(
+            "PLANSTATS", False, _as_bool,
+            "plan-statistics store (utils/planstats.py): on = every "
+            "profile session (and therefore every run_plan execution — "
+            "PLANSTATS implies PROFILE-style auto-sessions and the "
+            "metrics plane) appends one CRC-framed record keyed by "
+            "plan fingerprint x schema x bucket, with per-segment "
+            "observed times/rows/bytes, counter deltas, and drift "
+            "findings vs plancheck's static predictions; off (default) "
+            "costs one cached generation compare per dispatch",
+        ),
+        Flag(
+            "PLANSTATS_DIR", "", str,
+            "directory for plan-statistics store files "
+            "(planstats-<host>-<pid>.wal); '' (default) = "
+            "<tempdir>/srt-planstats. A non-empty path implies "
+            "PLANSTATS. Files are NEVER swept at exit — history across "
+            "processes is what the drift layer compares against",
+        ),
+        Flag(
+            "PLANSTATS_ROTATE_MB", 64.0,
+            _parse_positive_float("PLANSTATS_ROTATE_MB"),
+            "per-process stats-store rotation threshold in MiB: past "
+            "it the live WAL rotates to <name>.wal.1 (one old "
+            "generation kept, older dropped) — bounded disk, "
+            "crash-safe at every point",
+        ),
+        Flag(
+            "DRIFT_ROWS_FACTOR", 4.0,
+            _parse_positive_float("DRIFT_ROWS_FACTOR"),
+            "cardinality drift threshold: a segment whose observed "
+            "rows_out deviates from its history median by more than "
+            "this factor (either direction) gets a typed drift "
+            "finding and a drift.cardinality tick",
+        ),
+        Flag(
+            "DRIFT_HBM_FACTOR", 2.0,
+            _parse_positive_float("DRIFT_HBM_FACTOR"),
+            "HBM drift threshold: a segment whose observed working-set "
+            "proxy exceeds plancheck's static est_hbm_bytes by more "
+            "than this factor gets a typed drift finding and a "
+            "drift.hbm tick",
+        ),
+        Flag(
             "SERVE_PORT", 0, _parse_port,
             "serving daemon (serving/server.py) localhost TCP port; "
             "0 (default) = OS-assigned ephemeral port, read back from "

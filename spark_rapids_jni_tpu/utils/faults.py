@@ -101,7 +101,12 @@ _TRANSIENT_MARKERS = (
     "connection refused", "broken pipe", "device or resource busy",
 )
 
-_TRANSIENT_TYPES = ("DeviceUnreachable", "TimeoutExpired", "Unavailable")
+# "timeout" covers bench's structured {type: "timeout"} per-arm
+# records; "TimeoutExpired" stays for live subprocess exceptions and
+# old failure records
+_TRANSIENT_TYPES = (
+    "DeviceUnreachable", "TimeoutExpired", "Unavailable", "timeout",
+)
 
 _OOM_MARKERS = (
     "resource_exhausted", "resource exhausted", "out of memory",

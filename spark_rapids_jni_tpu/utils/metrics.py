@@ -89,8 +89,15 @@ _GATE_FLIGHT = False
 
 def _refresh_gate() -> None:
     global _GATE_GEN, _GATE_ENABLED, _GATE_SPAN, _GATE_FLIGHT
-    _GATE_ENABLED = bool(config.get_flag("METRICS")) or bool(
-        config.get_flag("METRICS_DUMP")
+    _GATE_ENABLED = (
+        bool(config.get_flag("METRICS"))
+        or bool(config.get_flag("METRICS_DUMP"))
+        # the plan-stats store diffs counters around every profile
+        # session (utils/planstats.py) — stats with all-zero spill/
+        # retry/shed columns would be silently wrong, so PLANSTATS
+        # pulls the registry on with it
+        or bool(config.get_flag("PLANSTATS"))
+        or bool(str(config.get_flag("PLANSTATS_DIR") or ""))
     )
     _GATE_FLIGHT = flight.enabled()
     _GATE_SPAN = (
@@ -131,6 +138,17 @@ def bytes_add(name: str, n: int) -> None:
         return
     with _LOCK:
         _BYTES[name] = _BYTES.get(name, 0) + int(n)
+
+
+def counter_values(names: Sequence[str]) -> Dict[str, int]:
+    """Point-in-time values of named counters/byte-counters (0 when a
+    name was never ticked) — the cheap targeted read planstats diffs
+    around each profile session, vs snapshot() which copies every
+    table."""
+    with _LOCK:
+        return {
+            n: int(_COUNTERS.get(n) or _BYTES.get(n) or 0) for n in names
+        }
 
 
 def timer_record(name: str, seconds: float) -> None:

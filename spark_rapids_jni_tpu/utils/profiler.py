@@ -42,7 +42,10 @@ one module-global bool (``_ACTIVE``) when no session is open — the
 
 Import discipline: this module imports ONLY ``config`` and ``flight``
 (plus stdlib). metrics/buckets/pipeline/hbm/plan/runtime_bridge all
-import *it*, so anything heavier here is an import cycle.
+import *it*, so anything heavier here is an import cycle — which is why
+the plan-stats hook (``utils/planstats.py``, PR 16) is lazy-imported at
+session open/close behind its own cached flag gate, never at module
+load.
 """
 
 from __future__ import annotations
@@ -72,15 +75,29 @@ _TRUTHY = frozenset({"1", "true", "yes", "on"})
 
 _GATE_GEN = -1
 _GATE_ON = False
+_GATE_STATS = False
 
 
 def _refresh_gate() -> None:
-    global _GATE_GEN, _GATE_ON
+    global _GATE_GEN, _GATE_ON, _GATE_STATS
     v = config.get_flag("PROFILE")
     on = (v is True) or str(v or "").strip().lower() in _TRUTHY
+    # the plan-stats store (utils/planstats.py) records per finished
+    # session, so PLANSTATS implies auto-sessions; the flags are read
+    # here directly (planstats imports metrics which imports us, so it
+    # must never be imported at module load)
+    s = config.get_flag("PLANSTATS")
+    _GATE_STATS = (
+        (s is True) or str(s or "").strip().lower() in _TRUTHY
+        or bool(str(config.get_flag("PLANSTATS_DIR") or ""))
+    )
     # a configured dump path implies profiling, the
     # METRICS_DUMP-implies-METRICS convention
-    _GATE_ON = on or bool(str(config.get_flag("PROFILE_DUMP") or ""))
+    _GATE_ON = (
+        on
+        or bool(str(config.get_flag("PROFILE_DUMP") or ""))
+        or _GATE_STATS
+    )
     _GATE_GEN = config.generation()
 
 
@@ -89,6 +106,14 @@ def enabled() -> bool:
     if _GATE_GEN != config.generation():
         _refresh_gate()
     return _GATE_ON
+
+
+def _planstats_on() -> bool:
+    """True when finished sessions should append a stats record
+    (same cached gate refresh; no planstats import on this path)."""
+    if _GATE_GEN != config.generation():
+        _refresh_gate()
+    return _GATE_STATS
 
 
 # ---------------------------------------------------------------------------
@@ -179,11 +204,52 @@ class _Seg:
         }
 
 
+def _schema_token(schema) -> Optional[str]:
+    """Normalize a schema argument (ColType sequence or string) to the
+    compact comma-joined token the stats store keys on; anything else
+    degrades to None — same never-fail rule as :func:`_plan_ops`."""
+    if schema is None:
+        return None
+    if isinstance(schema, str):
+        return schema or None
+    try:
+        return ",".join(c.pretty() for c in schema) or None
+    # srt: allow-broad-except(unrecognized schema shape degrades to None; the profiler must never fail the query it observes)
+    except Exception:
+        return None
+
+
+def _compact_static(report) -> Optional[dict]:
+    """Shrink a plancheck analyze/check report to the prediction fields
+    the drift layer compares against — full reports carry per-op
+    reasons/schemas that would bloat every stats record."""
+    if not isinstance(report, dict):
+        return None
+    try:
+        return {
+            "segments": [
+                {
+                    "kind": s.get("kind"),
+                    "ops": list(s.get("ops") or []),
+                    "rows_bound": s.get("rows_bound"),
+                    "est_hbm_bytes": s.get("est_hbm_bytes"),
+                }
+                for s in report.get("segments") or []
+            ],
+            "rows_out_bound": report.get("rows_out_bound"),
+            "est_hbm_peak_bytes": report.get("est_hbm_peak_bytes"),
+        }
+    # srt: allow-broad-except(malformed static report degrades to no prediction; the profiler must never fail the query it observes)
+    except Exception:
+        return None
+
+
 class ProfileSession:
     """Attribution state for ONE plan/stream execution."""
 
     def __init__(self, plan=None, label: str = "plan",
-                 batches: Optional[int] = None):
+                 batches: Optional[int] = None, schema=None,
+                 bucket: Optional[int] = None, static=None):
         self.session_id = uuid.uuid4().hex[:16]
         self.label = label
         self.plan = _plan_ops(plan)
@@ -191,6 +257,12 @@ class ProfileSession:
         self.host = _HOST
         self.epoch_ns = time.time_ns()
         self.batches = batches
+        # the stats-store key parts + embedded static prediction
+        # (planstats drift layer); None when the caller has none
+        self.schema = _schema_token(schema)
+        self.bucket = int(bucket) if bucket is not None else None
+        self.pred = _compact_static(static)
+        self._counter_base: Optional[Dict[str, int]] = None
         self.wall_s = 0.0
         self._t0 = time.perf_counter()
         self._lock = lockcheck.make_lock("profiler.session")
@@ -239,6 +311,12 @@ class ProfileSession:
         }
         if self.batches is not None:
             doc["batches"] = self.batches
+        if self.schema is not None:
+            doc["schema"] = self.schema
+        if self.bucket is not None:
+            doc["bucket"] = self.bucket
+        if self.pred is not None:
+            doc["pred"] = self.pred
         return doc
 
 
@@ -299,16 +377,31 @@ class _SessionScope:
     as the process-wide fallback for worker-thread notes)."""
 
     def __init__(self, plan=None, label: str = "plan",
-                 batches: Optional[int] = None):
+                 batches: Optional[int] = None, schema=None,
+                 bucket: Optional[int] = None, static=None):
         self._plan = plan
         self._label = label
         self._batches = batches
+        self._schema = schema
+        self._bucket = bucket
+        self._static = static
         self.session: Optional[ProfileSession] = None
 
     def __enter__(self) -> ProfileSession:
         global _ACTIVE
-        sess = ProfileSession(self._plan, self._label, self._batches)
+        sess = ProfileSession(
+            self._plan, self._label, self._batches,
+            schema=self._schema, bucket=self._bucket,
+            static=self._static,
+        )
         self.session = sess
+        if _planstats_on():
+            try:
+                from . import planstats
+                sess._counter_base = planstats.counter_snapshot()
+            # srt: allow-broad-except(stats capture must never fail the query it observes)
+            except Exception:
+                sess._counter_base = None
         stack = getattr(_TLS, "sessions", None)
         if stack is None:
             stack = _TLS.sessions = []
@@ -336,8 +429,16 @@ class _SessionScope:
             if sess in _OPEN:
                 _OPEN.remove(sess)
             _ACTIVE = bool(_OPEN)
+        doc = sess.to_doc()
         with _SESSIONS_LOCK:
-            _SESSIONS.append(sess.to_doc())
+            _SESSIONS.append(doc)
+        if _planstats_on():
+            try:
+                from . import planstats
+                planstats.record_session(doc, sess._counter_base)
+            # srt: allow-broad-except(stats persistence must never fail the query it observes)
+            except Exception:
+                pass
         return False
 
 
@@ -391,16 +492,22 @@ _NULL_SCOPE = _NullScope()
 
 
 def profile_session(plan=None, label: str = "plan",
-                    batches: Optional[int] = None) -> _SessionScope:
+                    batches: Optional[int] = None, schema=None,
+                    bucket: Optional[int] = None,
+                    static=None) -> _SessionScope:
     """Explicit API: ``with profile_session(plan_json) as prof:`` scopes
     one plan/stream execution; ``prof.to_doc()`` (or
     ``profiler.sessions()[-1]`` after exit) is the structured record.
-    Always collects, regardless of the PROFILE flag."""
-    return _SessionScope(plan, label, batches)
+    Always collects, regardless of the PROFILE flag. ``schema`` /
+    ``bucket`` / ``static`` (a plancheck report) key and seed the
+    plan-stats record when PLANSTATS is on."""
+    return _SessionScope(plan, label, batches, schema=schema,
+                         bucket=bucket, static=static)
 
 
 def maybe_session(plan=None, label: str = "plan",
-                  batches: Optional[int] = None):
+                  batches: Optional[int] = None, schema=None,
+                  bucket: Optional[int] = None, static=None):
     """Auto-session for the runtime_bridge entries: a real scope when
     ``SPARK_RAPIDS_TPU_PROFILE`` is on and this thread has no session
     yet (an explicit outer session owns nested plan runs), else the
@@ -410,7 +517,8 @@ def maybe_session(plan=None, label: str = "plan",
         return _NULL_SCOPE
     if getattr(_TLS, "sessions", None):
         return _NULL_SCOPE
-    return _SessionScope(plan, label, batches)
+    return _SessionScope(plan, label, batches, schema=schema,
+                         bucket=bucket, static=static)
 
 
 # ---------------------------------------------------------------------------

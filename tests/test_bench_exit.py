@@ -59,6 +59,13 @@ class TestUnreachableClassifier:
         )
         assert bench._unreachable_failure(e)
 
+    def test_structured_timeout_record_counts_as_unreachable(self):
+        # the per-arm {type:"timeout"} record (an arm overrunning its
+        # wall-clock slice) classifies transient like TimeoutExpired
+        e = bench._failure_record("cfg", "timeout 900s", exc_type="timeout")
+        assert e["failure"]["type"] == "timeout"
+        assert bench._unreachable_failure(e)
+
     def test_genuine_crash_is_not_unreachable(self):
         e = bench._failure_record(
             "cfg", "assertion failed: groupby-sum mismatch vs numpy",
@@ -153,11 +160,25 @@ class TestBudgetExhaustedRun:
         doc = json.loads(last)
         assert doc["metric"] == "groupby_sum_100M_int64"
         by_name = {c["name"]: c for c in doc["configs"]}
-        # every budgeted arm is present as a structured skip record
-        assert set(by_name) == set(bench._LADDER)
-        for c in by_name.values():
+        # every budgeted ladder arm is present as a structured skip
+        assert set(bench._LADDER) <= set(by_name)
+        for arm in bench._LADDER:
+            c = by_name[arm]
             assert c["failure"]["type"] == "BudgetExceeded"
             assert c["failure"]["skipped"] is True
+        # the mesh tail arms likewise carry typed skip records instead
+        # of vanishing into a progress line: the skew arm is
+        # budget-starved, the TPC-DS-from-parquet arm is opt-in
+        skew = by_name[
+            "config 4: distributed zipf skew, 8-device CPU mesh"
+        ]
+        assert skew["failure"]["type"] == "BudgetExceeded"
+        assert skew["failure"]["skipped"] is True
+        tpcds = by_name[
+            "config 4: TPC-DS q5/q23/q64 from parquet, 8-dev mesh"
+        ]
+        assert tpcds["failure"]["type"] == "OptInSkipped"
+        assert tpcds["failure"]["skipped"] is True
         # the tail floors declined to start the unbounded stages
         assert "skipping arrow baseline" in proc.stderr
 

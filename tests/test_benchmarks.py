@@ -185,6 +185,11 @@ def test_bench_main_emits_parseable_line_when_unreachable(monkeypatch, tmp_path)
     # isolate from any real daemon state
     monkeypatch.setattr(bench, "_STATE_PATH", str(tmp_path / "state.json"))
     monkeypatch.setenv("SRT_BENCH_DEADLINE_S", "-1")
+    # pre-set the store dir so monkeypatch restores it: bench's
+    # _metrics_enable exports it (setdefault) for its subprocesses
+    monkeypatch.setenv(
+        "SPARK_RAPIDS_TPU_PLANSTATS_DIR", str(tmp_path / "planstats")
+    )
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         bench.main()
@@ -195,7 +200,15 @@ def test_bench_main_emits_parseable_line_when_unreachable(monkeypatch, tmp_path)
         assert doc["metric"] == "groupby_sum_100M_int64"
     last = json_mod.loads(lines[-1])
     assert last["headline_source"].startswith("published_round")
-    assert {e["name"] for e in last["configs"]} == set(bench._LADDER)
+    names = {e["name"] for e in last["configs"]}
+    # every ladder arm plus the mesh tail's typed skip records
+    assert set(bench._LADDER) <= names
+    for e in last["configs"]:
+        if e["name"] not in bench._LADDER:
+            assert e["failure"]["skipped"] is True
+            assert e["failure"]["type"] in (
+                "BudgetExceeded", "OptInSkipped", "DeviceUnreachable"
+            )
 
 
 def test_bench_emit_daemon_provenance(monkeypatch, capsys):

@@ -36,11 +36,19 @@ def _metrics_isolated(monkeypatch):
     monkeypatch.delenv("SPARK_RAPIDS_TPU_LOG_LEVEL", raising=False)
     monkeypatch.delenv("SPARK_RAPIDS_TPU_FLIGHT", raising=False)
     monkeypatch.delenv("SPARK_RAPIDS_TPU_FLIGHT_DUMP", raising=False)
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_PLANSTATS", raising=False)
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_PLANSTATS_DIR", raising=False)
+    # flag overrides leaked by an earlier module (bench helpers run
+    # in-process set METRICS/FLIGHT/PROFILE/PLANSTATS_DIR) beat the env
+    for f in ("METRICS", "METRICS_DUMP", "FLIGHT", "FLIGHT_DUMP",
+              "PROFILE", "PROFILE_DUMP", "PLANSTATS", "PLANSTATS_DIR"):
+        config.clear_flag(f)
     metrics.reset()
     flight.reset()
     yield
     for f in ("METRICS", "METRICS_DUMP", "LOG_LEVEL", "TRACE",
-              "FLIGHT", "FLIGHT_DUMP", "PROFILE", "PROFILE_DUMP"):
+              "FLIGHT", "FLIGHT_DUMP", "PROFILE", "PROFILE_DUMP",
+              "PLANSTATS", "PLANSTATS_DIR"):
         config.clear_flag(f)
     metrics.reset()
     flight.reset()
@@ -459,18 +467,32 @@ class TestBenchFailureRecords:
         monkeypatch.setattr(bench, "_stop_daemon", lambda: None)
         monkeypatch.setattr(bench, "_STATE_PATH", str(tmp_path / "s.json"))
         monkeypatch.setenv("SRT_BENCH_DEADLINE_S", "-1")
+        # pre-set the store dir so monkeypatch restores it: bench's
+        # _metrics_enable exports it (setdefault) for its subprocesses
+        monkeypatch.setenv(
+            "SPARK_RAPIDS_TPU_PLANSTATS_DIR", str(tmp_path / "planstats")
+        )
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
             bench.main()
         last = json.loads(buf.getvalue().strip().splitlines()[-1])
-        assert {e["name"] for e in last["configs"]} == set(bench._LADDER)
+        by_name = {e["name"]: e for e in last["configs"]}
+        # every ladder arm is present, plus the mesh tail's typed skip
+        # records (the arms never vanish into bare progress lines)
+        assert set(bench._LADDER) <= set(by_name)
         for e in last["configs"]:
             assert "metrics" in e or "failure" in e, e
-            f = e["failure"]
+        for arm in bench._LADDER:
+            f = by_name[arm]["failure"]
             assert f["type"] == "DeviceUnreachable"
             assert f["message"] == "device unreachable"
             assert f["elapsed_s"] is not None
             assert f["retries"] == 1
+        extra = set(by_name) - set(bench._LADDER)
+        for arm in extra:
+            f = by_name[arm]["failure"]
+            assert f["skipped"] is True
+            assert f["type"] in ("BudgetExceeded", "OptInSkipped")
 
 
 def _analyze_mod():
@@ -578,9 +600,47 @@ class TestAnalyzeBench:
         }
         p = tmp_path / "bench.json"
         p.write_text(json.dumps(doc))
-        entries, raw = mod._load(str(p))
+        entries, raw, drift = mod._load(str(p))
         assert "groupby_sum_16M" in entries
         assert "join" not in entries  # failures never rank in the A/B
+        assert drift is None  # pre-planstats file: no drift block
         mod.summarize_failures(raw)
         out = capsys.readouterr().out
         assert "TimeoutExpired" in out and "join" in out
+
+    def test_load_surfaces_headline_drift_block(self, tmp_path):
+        mod = _analyze_mod()
+        doc = {
+            "metric": "groupby_sum_100M_int64",
+            "drift": {"records": 6, "plans": 2,
+                      "findings": {"cardinality": 1}},
+            "configs": [{"name": "a", "seconds_median": 1.0}],
+        }
+        p = tmp_path / "bench.json"
+        p.write_text(json.dumps(doc))
+        _, _, drift = mod._load(str(p))
+        assert drift == {"records": 6, "plans": 2,
+                         "findings": {"cardinality": 1}}
+
+    def test_summarize_drift_with_findings(self, capsys):
+        mod = _analyze_mod()
+        mod.summarize_drift(
+            {"records": 6, "plans": 2,
+             "findings": {"cardinality": 1, "hbm": 2}}
+        )
+        out = capsys.readouterr().out
+        assert "6 stats record(s) over 2 plan group(s)" in out
+        assert "cardinality=1" in out and "hbm=2" in out
+        assert "explain.py --drift" in out
+
+    def test_summarize_drift_clean_store(self, capsys):
+        mod = _analyze_mod()
+        mod.summarize_drift({"records": 3, "plans": 1, "findings": {}})
+        out = capsys.readouterr().out
+        assert "no drift findings" in out
+
+    def test_summarize_drift_tolerates_old_files(self, capsys):
+        # pre-planstats BENCH files pass None through _load: quiet skip
+        mod = _analyze_mod()
+        mod.summarize_drift(None)
+        assert capsys.readouterr().out == ""

@@ -48,17 +48,18 @@ CHAIN = [
 @pytest.fixture(autouse=True)
 def _profiler_isolated(monkeypatch):
     for env in ("PROFILE", "PROFILE_DUMP", "FLIGHT", "FLIGHT_DUMP",
-                "METRICS", "METRICS_DUMP"):
+                "METRICS", "METRICS_DUMP", "PLANSTATS", "PLANSTATS_DIR"):
         monkeypatch.delenv("SPARK_RAPIDS_TPU_" + env, raising=False)
         # a flag OVERRIDE leaked by an earlier module (bench helpers
-        # run in-process set PROFILE/METRICS/FLIGHT) beats the env
+        # run in-process set PROFILE/METRICS/FLIGHT/PLANSTATS_DIR)
+        # beats the env
         config.clear_flag(env)
     profiler.reset()
     flight.reset()
     metrics.reset()
     yield
     for f in ("PROFILE", "PROFILE_DUMP", "FLIGHT", "FLIGHT_DUMP",
-              "METRICS", "METRICS_DUMP"):
+              "METRICS", "METRICS_DUMP", "PLANSTATS", "PLANSTATS_DIR"):
         config.clear_flag(f)
     profiler.reset()
     flight.reset()

@@ -327,6 +327,78 @@ class TestBenchArmTier:
         assert got == []
 
 
+class TestStatsAppend:
+    def test_raw_append_in_planstats_flagged(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/utils/planstats.py", """
+            def sneak(path):
+                return open(path, "ab")
+        """)
+        assert "SRT010" in passes_of(got)
+
+    def test_helper_site_clean(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/utils/planstats.py", """
+            def _open_append(path):
+                return open(path, "ab")
+        """)
+        assert got == []
+
+    def test_read_mode_clean(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/utils/planstats.py", """
+            def load(path):
+                with open(path, "rb") as f:
+                    return f.read()
+        """)
+        assert got == []
+
+    def test_mode_keyword_flagged(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/utils/planstats.py", """
+            def sneak(path):
+                return open(path, mode="a")
+        """)
+        assert "SRT010" in passes_of(got)
+
+    def test_stats_path_append_elsewhere_flagged(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/foo.py", """
+            def dump(planstats_path, rec):
+                with open(planstats_path, "a") as f:
+                    f.write(rec)
+        """)
+        assert "SRT010" in passes_of(got)
+
+    def test_stats_dirname_literal_flagged(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/foo.py", """
+            import os
+            def dump(rec):
+                with open(os.path.join("/tmp/srt-planstats", "x.wal"),
+                          "ab") as f:
+                    f.write(rec)
+        """)
+        assert "SRT010" in passes_of(got)
+
+    def test_unrelated_append_elsewhere_clean(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/foo.py", """
+            def log(path, line):
+                with open(path, "a") as f:
+                    f.write(line)
+        """)
+        assert got == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        got = scan(tmp_path, f"{PKG}/utils/planstats.py", """
+            def migrate(path):
+                # srt: allow-stats-append(one-shot v0 store migration)
+                return open(path, "ab")
+        """)
+        assert got == []
+
+    def test_repo_planstats_has_one_sanctioned_site(self):
+        # the shipped module must route every append through the helper
+        findings = srt.scan_file(os.path.join(
+            REPO_ROOT, PKG, "utils", "planstats.py"
+        ))
+        assert [f for f in findings if f.pass_id == "SRT010"] == []
+
+
 class TestPragmaGrammar:
     def test_empty_reason_is_a_finding(self, tmp_path):
         got = scan(tmp_path, f"{PKG}/foo.py", """

@@ -10,8 +10,10 @@ Also summarizes the per-config "metrics" blocks bench entries carry
 since the observability PR (top ops by time and by bytes moved,
 span-duration p50/p95/max from the ``span_ms.*`` histograms, a top-5
 ops-by-self-time table, a plan-fusion summary from the ``plan.*``
-counters and ``fusion`` blocks, plus structured failure records),
-tolerating old BENCH files that predate any of these fields.
+counters and ``fusion`` blocks, structured failure records, plus the
+headline ``drift`` block the plan-stats store emits since the
+observability PR), tolerating old BENCH files that predate any of
+these fields.
 
 Usage: python tools/analyze_bench.py [path-to-state-or-bench-json]
 """
@@ -63,7 +65,8 @@ _GROUPS = {
 
 
 def _load(path: str) -> tuple:
-    """(ranked-entries-by-name, raw entry list incl. failures/metrics)."""
+    """(ranked-entries-by-name, raw entry list incl. failures/metrics,
+    headline ``drift`` block or None for files that predate it)."""
     with open(path) as f:
         text = f.read()
     try:
@@ -92,7 +95,8 @@ def _load(path: str) -> tuple:
         raw.append(e)
         if "name" in e and "seconds_median" in e:
             entries.setdefault(e["name"], e)
-    return entries, raw
+    drift = summary.get("drift")
+    return entries, raw, drift if isinstance(drift, dict) else None
 
 
 def _merge_metrics(raw: list) -> dict:
@@ -543,9 +547,31 @@ def summarize_failures(raw: list) -> None:
         )
 
 
+def summarize_drift(drift) -> None:
+    """Plan-stats drift summary from the headline ``drift`` block
+    (record/plan-group counts and typed findings accumulated by the
+    run's stats store — planstats.summary()). Old BENCH files predate
+    the block and pass None — silent skip, like the other summaries."""
+    if not isinstance(drift, dict):
+        return
+    head = (
+        f"\nplan drift: {drift.get('records', 0)} stats record(s) over "
+        f"{drift.get('plans', 0)} plan group(s)"
+    )
+    findings = drift.get("findings") or {}
+    if findings:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(findings.items())
+        )
+        print(f"{head}; findings: {detail}")
+        print("  inspect with: python tools/explain.py --drift <stats-dir>")
+    else:
+        print(f"{head}; no drift findings")
+
+
 def main() -> None:
     path = sys.argv[1] if len(sys.argv) > 1 else _STATE
-    entries, raw = _load(path)
+    entries, raw, drift = _load(path)
     if not entries:
         print("no measured entries")
         merged = _merge_metrics(raw)
@@ -557,6 +583,7 @@ def main() -> None:
         summarize_serving(raw)
         summarize_profile(raw)
         summarize_failures(raw)
+        summarize_drift(drift)
         return
     for label, arms in _GROUPS.items():
         got = [(a, entries[a]) for a in arms if a in entries]
@@ -586,6 +613,7 @@ def main() -> None:
     summarize_serving(raw)
     summarize_profile(raw)
     summarize_failures(raw)
+    summarize_drift(drift)
 
 
 if __name__ == "__main__":

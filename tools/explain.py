@@ -29,11 +29,18 @@ and the static HBM footprint bound — without executing anything
 tokens (``int64``, ``decimal64:-2``, ``list<int32>``, ``string``...);
 without it the walk is structural only.
 
+``--drift`` renders the plan-stats store (utils/planstats.py) instead:
+per-(plan, schema, bucket) group, each segment's observed rows/HBM/
+wall-time percentiles next to plancheck's static prediction, plus the
+typed drift findings recorded at append time. Inputs are store files
+or directories (default: the configured ``PLANSTATS_DIR``).
+
 Usage:
     python tools/explain.py profile.json
     python tools/explain.py --json profile.json
     python tools/explain.py --merge worker0.json worker1.json -o m.json
     python tools/explain.py --static plan.json --schema int64,bool8 --rows 4096
+    python tools/explain.py --drift [statsdir]
 """
 
 from __future__ import annotations
@@ -100,6 +107,33 @@ def parse_schema_tokens(spec: str):
             tid = dt.TypeId[tok.strip().upper()]
         cols.append(plancheck.ColType(tid, scale, child))
     return cols
+
+
+def run_drift(args) -> int:
+    """--drift: render the plan-stats store as predicted-vs-observed
+    per-segment history with percentiles (utils/planstats.py). Inputs
+    are stats-store files or directories; with none, the configured
+    ``SPARK_RAPIDS_TPU_PLANSTATS_DIR`` (or its tempdir default)."""
+    from spark_rapids_jni_tpu.utils import planstats
+
+    records = []
+    paths = args.inputs or [planstats.stats_dir()]
+    for p in paths:
+        records.extend(planstats.load(p))
+    if not records:
+        print(
+            "explain: no plan-stats records in "
+            + ", ".join(repr(p) for p in paths)
+            + " (was SPARK_RAPIDS_TPU_PLANSTATS on?)",
+            file=sys.stderr,
+        )
+        return 1
+    report = planstats.drift_report(records)
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(planstats.render_drift(report))
+    return 0
 
 
 def run_static(args) -> int:
@@ -265,8 +299,10 @@ def main(argv=None) -> int:
         description="profiler sessions -> EXPLAIN ANALYZE report",
     )
     ap.add_argument(
-        "inputs", nargs="+",
-        help="profile dump / flight dump / bench output file(s)",
+        "inputs", nargs="*",
+        help="profile dump / flight dump / bench output file(s); with "
+        "--drift, stats-store files/directories (default: the "
+        "configured store directory)",
     )
     ap.add_argument(
         "--json", action="store_true", dest="as_json",
@@ -298,7 +334,17 @@ def main(argv=None) -> int:
         help="with --static: input row-count bound for the footprint "
         "estimate",
     )
+    ap.add_argument(
+        "--drift", action="store_true",
+        help="inputs are plan-stats store files/dirs (utils/"
+        "planstats.py): render predicted-vs-observed per-segment "
+        "history with percentiles + typed drift findings",
+    )
     args = ap.parse_args(argv)
+    if args.drift:
+        return run_drift(args)
+    if not args.inputs:
+        ap.error("inputs are required (except with --drift)")
     if args.static:
         return run_static(args)
     if len(args.inputs) > 1 and not args.merge:

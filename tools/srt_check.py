@@ -54,6 +54,13 @@ Passes (each emits ``file:line:col`` findings):
   ``.item()``, and ``np.asarray`` on non-constants. Each sync stalls
   the launch pipeline; deliberate ones (the exact path's row-count
   reads) carry ``# srt: allow-host-sync(<reason>)``.
+* **SRT010 stats-append** — append-mode ``open()`` on the plan-stats
+  store anywhere but ``planstats._open_append``: the store's crash
+  tolerance rests on every writer emitting CRC-framed records through
+  the one helper (truncate-to-good self-heal, rotation, flush
+  discipline). A raw ``open(..., "a")`` on a stats path bypasses the
+  framing, and a torn write there corrupts history for every later
+  reader. Justified sites carry ``# srt: allow-stats-append(<reason>)``.
 * **SRT000 bad-pragma** — a suppression pragma with a missing reason
   or an unknown pass name is itself a finding: silent suppression
   grows back the prose problem this tool replaces.
@@ -170,7 +177,7 @@ METRIC_NAMESPACES = frozenset({
     "session", "retry", "faults", "breaker", "fault", "spill", "lock",
     "shuffle", "distributed", "io", "probe", "bench", "groupby",
     "join", "sort", "profile", "stream", "checkpoint", "restore",
-    "mesh",
+    "mesh", "planstats", "drift",
 })
 METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
@@ -195,6 +202,7 @@ PASS_PRAGMAS = {
     "SRT007": "untiered-arm",
     "SRT008": "dispatch-parity",
     "SRT009": "host-sync",
+    "SRT010": "stats-append",
 }
 PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
 LOOSE_PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-")
@@ -923,6 +931,102 @@ def check_dispatch_parity(relpath: str, tree: ast.Module,
 
 
 # ---------------------------------------------------------------------------
+# SRT010: plan-stats store writes go through the CRC-framed helper
+# ---------------------------------------------------------------------------
+
+# the one sanctioned raw-append site (crc framing + self-heal live there)
+STATS_APPEND_HELPER = "_open_append"
+_STATS_PATH_HINTS = ("planstats", "stats_dir", "stats_path")
+
+
+def _open_mode_literal(call: ast.Call) -> Optional[str]:
+    """The string mode of an ``open()`` call, or None when dynamic."""
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _mentions_stats_path(call: ast.Call) -> bool:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ) and "planstats" in node.value:
+                return True
+            if isinstance(node, ast.Name) and any(
+                h in node.id for h in _STATS_PATH_HINTS
+            ):
+                return True
+            if isinstance(node, ast.Attribute) and any(
+                h in node.attr for h in _STATS_PATH_HINTS
+            ):
+                return True
+    return False
+
+
+def check_stats_append(relpath: str, tree: ast.Module,
+                       pragmas: _Pragmas) -> List[Finding]:
+    """Append-mode ``open()`` on the stats store outside the framed
+    helper. Inside ``utils/planstats.py`` every append-mode open must
+    live in ``_open_append``; elsewhere, an append-mode open whose
+    arguments reference a stats path is a bypass of the framing."""
+    in_planstats = relpath.replace(os.sep, "/").endswith(
+        "spark_rapids_jni_tpu/utils/planstats.py"
+    )
+    findings: List[Finding] = []
+
+    class _V(ast.NodeVisitor):
+        def __init__(self):
+            self.fn_stack: List[str] = []
+
+        def visit_FunctionDef(self, node):
+            self.fn_stack.append(node.name)
+            self.generic_visit(node)
+            self.fn_stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                mode = _open_mode_literal(node)
+                if mode is not None and "a" in mode:
+                    if in_planstats:
+                        if STATS_APPEND_HELPER not in self.fn_stack:
+                            self._emit(
+                                node,
+                                "append-mode open() in planstats "
+                                "outside _open_append — every store "
+                                "write must go through the CRC-framed "
+                                "helper (torn-tail self-heal, "
+                                "rotation, flush discipline)",
+                            )
+                    elif _mentions_stats_path(node):
+                        self._emit(
+                            node,
+                            "raw append-mode open() on a plan-stats "
+                            "path — append via planstats' framed "
+                            "writer instead; unframed bytes corrupt "
+                            "the store for every later reader",
+                        )
+            self.generic_visit(node)
+
+        def _emit(self, node, msg):
+            if not pragmas.suppresses("SRT010", node.lineno):
+                findings.append(Finding(
+                    "SRT010", relpath, node.lineno,
+                    node.col_offset, msg,
+                ))
+
+    _V().visit(tree)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -944,6 +1048,7 @@ def scan_file(path: str, repo_root: str = REPO_ROOT) -> List[Finding]:
     checker.visit(tree)
     findings = checker.findings
     findings.extend(check_bench_tiers(relpath, tree, pragmas))
+    findings.extend(check_stats_append(relpath, tree, pragmas))
     findings.extend(check_dispatch_parity(
         relpath, tree, pragmas,
         os.path.dirname(os.path.abspath(path)),
