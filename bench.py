@@ -1813,16 +1813,27 @@ def bench_tpcds_distributed(devices: int = 8, scale: float = 0.05,
     return None
 
 
-def bench_distributed_skew(timeout_s: float = 900.0):
-    """Config 4 shape at 1e7 rows: zipf-skew distributed groupby through
-    the ragged-compact exchange on the virtual 8-device CPU mesh (the
-    multi-chip path; numbers are CPU-simulation, labeled as such).
+def _arm_cap(default_s: float) -> float:
+    """Per-arm wall-clock slice for the CPU-mesh tail stages.
 
-    An overrun of ``timeout_s`` raises subprocess.TimeoutExpired out to
-    ``_guard``'s structured ``{type:"timeout"}`` record — this used to
-    be swallowed into a bare progress line, leaving the headline JSON
-    with no trace of the arm at all."""
-    import os
+    SRT_BENCH_ARM_TIMEOUT_S overrides the default so a smoke run can
+    bound every tail arm tightly — the arm dies to its own subprocess
+    timeout (a structured {type:"timeout"} entry) instead of running
+    into the driver's rc=124 kill and eating the headline emit."""
+    raw = os.environ.get("SRT_BENCH_ARM_TIMEOUT_S", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            _progress(f"ignoring bad SRT_BENCH_ARM_TIMEOUT_S={raw!r}")
+    return default_s
+
+
+def _skew_child(timeout_s: float, rows: int = 10_000_000,
+                skew_split=None):
+    """One benchmarks.run zipf-skew child on the 8-device CPU mesh;
+    returns its parsed JSON entry (or None). ``skew_split`` pins the
+    adaptive splitter via the child's env for the A/B arm."""
     import subprocess
 
     env = dict(os.environ)
@@ -1833,24 +1844,100 @@ def bench_distributed_skew(timeout_s: float = 900.0):
         env.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     ).strip()
+    if skew_split is not None:
+        env["SPARK_RAPIDS_TPU_SKEW_SPLIT"] = "1" if skew_split else "0"
+    out = subprocess.run(
+        [sys.executable, "-m", "benchmarks.run", "--configs", "skew",
+         "--devices", "8", "--rows", str(rows)],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    _progress(f"skew run produced no JSON: {out.stderr[-500:]}")
+    return None
+
+
+def bench_distributed_skew(timeout_s: float = 900.0):
+    """Config 4 shape at 1e7 rows: zipf-skew distributed groupby through
+    the ragged-compact exchange on the virtual 8-device CPU mesh (the
+    multi-chip path; numbers are CPU-simulation, labeled as such).
+
+    An overrun of ``timeout_s`` raises subprocess.TimeoutExpired out to
+    ``_guard``'s structured ``{type:"timeout"}`` record — this used to
+    be swallowed into a bare progress line, leaving the headline JSON
+    with no trace of the arm at all."""
+    import subprocess
+
     try:
-        out = subprocess.run(
-            [sys.executable, "-m", "benchmarks.run", "--configs", "skew",
-             "--devices", "8", "--rows", "10000000"],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        for line in reversed(out.stdout.strip().splitlines()):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-        _progress(f"skew run produced no JSON: {out.stderr[-500:]}")
+        return _skew_child(timeout_s)
     except subprocess.TimeoutExpired:
         raise
     except Exception as e:  # pragma: no cover
         _progress(f"skew run failed: {e}")
     return None
+
+
+def bench_mesh_skew_adaptive(timeout_s: float = 900.0):
+    """The adaptive-skew A/B (ISSUE 17): the BENCH_r04 zipf config run
+    twice on the 8-device CPU mesh — splitting off (the r04 behaviour:
+    exchange capacity sized from the raw hot-destination counts) vs on
+    (hot keys salted across sub-partitions with partial-agg before the
+    exchange). Emits one entry whose structured ``skew`` block carries
+    both arms' seconds / recv_buffer_rows / peak_rss plus the deltas.
+
+    Each child gets half the slice; an overrun raises TimeoutExpired
+    out to _guard's typed record so the headline line survives."""
+    half = max(timeout_s / 2.0, 1.0)
+    t0 = time.time()
+    off = _skew_child(half, skew_split=False)
+    rest = max(timeout_s - (time.time() - t0), 1.0)
+    on = _skew_child(min(half, rest), skew_split=True)
+    if off is None or on is None:
+        _progress("skew A/B incomplete: "
+                  f"off={'ok' if off else 'lost'} "
+                  f"on={'ok' if on else 'lost'}")
+        return None
+
+    def _arm(e):
+        return {
+            "seconds": e.get("seconds"),
+            "recv_buffer_rows": e.get("recv_buffer_rows_per_device"),
+            "peak_rss_mb": e.get("peak_rss_mb"),
+            "max_over_mean": e.get("max_over_mean"),
+            "skew_splits": e.get("skew_splits", 0),
+        }
+
+    def _delta(key):
+        a, b = off.get(key), on.get(key)
+        if a is None or b is None:
+            return None
+        return round(a - b, 4)
+
+    from spark_rapids_jni_tpu.utils import config as srt_config
+
+    return {
+        "config": "4-skew-adaptive",
+        "name": "mesh_skew_adaptive",
+        "rows": on.get("rows"),
+        "devices": on.get("devices"),
+        "platform": on.get("platform"),
+        "skew": {
+            "factor": float(srt_config.get_flag("SKEW_SPLIT_FACTOR")),
+            "splits": on.get("skew_splits", 0),
+            "off": _arm(off),
+            "on": _arm(on),
+            "deltas": {
+                "seconds": _delta("seconds"),
+                "recv_buffer_rows": _delta(
+                    "recv_buffer_rows_per_device"),
+                "peak_rss_mb": _delta("peak_rss_mb"),
+            },
+        },
+    }
 
 
 def _guard(entries, name, fn):
@@ -2695,14 +2782,20 @@ def main():
     # worst case it ate the whole tail, and the skew arm already
     # exercises the distributed exchange for the headline.
     mesh_arms = [
+        # the adaptive-skew A/B first: it carries the headline skew
+        # block (seconds / recv-buffer / RSS deltas, splitting on vs
+        # off), so it must land before any budget-tail exhaustion
+        ("config 4: adaptive skew split A/B, 8-device CPU mesh",
+         bench_mesh_skew_adaptive, _arm_cap(900.0)),
         ("config 4: distributed zipf skew, 8-device CPU mesh",
-         bench_distributed_skew, 900.0),
+         bench_distributed_skew, _arm_cap(900.0)),
     ]
     tpcds_name = "config 4: TPC-DS q5/q23/q64 from parquet, 8-dev mesh"
     if os.environ.get("SRT_BENCH_MESH_TPCDS", "").strip().lower() in (
         "1", "true", "yes", "on"
     ):
-        mesh_arms.append((tpcds_name, bench_tpcds_distributed, 1800.0))
+        mesh_arms.append((tpcds_name, bench_tpcds_distributed,
+                          _arm_cap(1800.0)))
     else:
         _progress(
             f"skipping {tpcds_name}: opt-in arm "

@@ -106,6 +106,10 @@ def main():
         from spark_rapids_jni_tpu.parallel import distributed_groupby
         from spark_rapids_jni_tpu.parallel.mesh import make_mesh
 
+        from spark_rapids_jni_tpu.utils import config as srt_config
+        from spark_rapids_jni_tpu.utils import metrics as srt_metrics
+
+        srt_config.set_flag("METRICS", "1")
         n = max(args.rows, 10_000_000)
         n -= n % args.devices
         rng = np.random.default_rng(5)
@@ -126,11 +130,39 @@ def main():
         assert int(np.asarray(overflow).max()) <= 0
         want_groups = len(np.unique(k))
         assert total_groups == want_groups, (total_groups, want_groups)
+        # destination balance after planning: exact planned recv totals
+        # when the adaptive splitter fired (gauges), else derived from
+        # the raw key distribution (hash skew the planner saw)
+        snap = srt_metrics.snapshot()
+        gauges = snap.get("gauges") or {}
+        splits = int((snap.get("counters") or {}).get(
+            "shuffle.skew_splits", 0))
+
+        def _gauge(name):
+            g = gauges.get(name)
+            return None if g is None else float(g.get("value", 0.0))
+
+        post_ratio = _gauge("shuffle.skew_post_ratio_x100")
+        recv_max = _gauge("shuffle.skew_recv_after")
+        if splits and post_ratio is not None:
+            max_over_mean = post_ratio / 100.0
+        else:
+            from spark_rapids_jni_tpu.ops.partition import (
+                partition_ids_hash,
+            )
+
+            pids = np.asarray(partition_ids_hash(t, ["k"], args.devices))
+            dest_rows = np.bincount(pids, minlength=args.devices)
+            max_over_mean = float(dest_rows.max() / dest_rows.mean())
+            recv_max = float(dest_rows.max())
         print(json.dumps({
             "config": "4-skew", "rows": n, "devices": args.devices,
             "seconds": round(secs, 3), "groups": total_groups,
             "hot_key_rows": hot, "recv_buffer_rows_per_device": buf_rows,
             "peak_rss_mb": peak_mb, "platform": platform,
+            "skew_splits": splits,
+            "max_recv_rows": None if recv_max is None else int(recv_max),
+            "max_over_mean": round(max_over_mean, 3),
         }))
 
     if "4" in configs and args.devices:

@@ -37,7 +37,7 @@ python3 tools/srt_check.py
 # analog) — a driver must never ship a plan the runtime would reject.
 python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh \
   ci/smoke-chaos-mesh.sh ci/smoke-spill.sh ci/smoke-restart.sh \
-  ci/smoke-drift.sh
+  ci/smoke-drift.sh ci/smoke-skew.sh
 
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).
@@ -100,6 +100,14 @@ bash ci/smoke-restart.sh
 # a typed drift finding; `explain --drift` must render the store as
 # predicted-vs-observed percentiles.
 bash ci/smoke-drift.sh
+
+# Skew smoke: a seeded zipf stream through a plan carrying a
+# `partition` op must run on the 8-device mesh byte-identical to the
+# exact path; the adaptive splitter must fire (nonzero
+# shuffle.skew_splits) and hold the planned max/mean recv ratio under
+# SKEW_SPLIT_FACTOR; zero leaked tables; the decision must render as a
+# typed DRIFT[skew] finding.
+bash ci/smoke-skew.sh
 
 # Bench smoke on whatever device this node has.
 python3 bench.py

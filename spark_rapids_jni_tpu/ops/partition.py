@@ -63,3 +63,78 @@ def round_robin_partition(
         jnp.arange(n, dtype=jnp.int32) + start_partition, num_partitions
     )
     return _reorder_by_parts(table, part, num_partitions)
+
+
+def range_splitters(
+    table: Table,
+    columns: Sequence[Union[int, str]],
+    num_partitions: int,
+    sample_size: int = 8192,
+) -> list[jax.Array]:
+    """P-1 range splitters from a deterministic host-side key sample.
+
+    Spark's RangePartitioning boundary computation: sample the sort-key
+    order words at a fixed stride, lexsort the sample, and cut it into
+    ``num_partitions`` equal runs. Deterministic given the table, so the
+    exact path and every mesh replica compute identical boundaries —
+    the byte-parity anchor for range partition as a plan op.
+    """
+    import numpy as np
+
+    from .sort import SortKey, _key_words
+
+    keys = [SortKey(c) for c in columns]
+    words = []
+    for k in keys:
+        words.extend(_key_words(table.column(k.column), k))
+    n = table.row_count
+    stride = max(n // max(sample_size, 1), 1)
+    # srt: allow-host-sync(range-partition sampling: the splitter sample is a deliberate host step)
+    samp = [np.asarray(w[::stride]) for w in words]
+    order = np.lexsort(samp[::-1])
+    m = order.shape[0]
+    cut = [order[(i * m) // num_partitions] for i in range(1, num_partitions)]
+    return [jnp.asarray(np.stack([s[c] for c in cut])) for s in samp]
+
+
+def partition_ids_range(
+    table: Table,
+    columns: Sequence[Union[int, str]],
+    splitters: Sequence[jax.Array],
+) -> jax.Array:
+    """Range-partition ids from precomputed splitters (jittable).
+
+    partition id = number of splitters <= key, lexicographically over
+    the key order words — mirrors distributed_sort's dest computation
+    so a range ``partition`` plan op and TotalOrderSort agree.
+    """
+    from .sort import SortKey, _key_words
+
+    keys = [SortKey(c) for c in columns]
+    words = []
+    for k in keys:
+        words.extend(_key_words(table.column(k.column), k))
+    n = table.row_count
+    nsplit = 0 if not splitters else int(splitters[0].shape[0])
+    dest = jnp.zeros((n,), jnp.int32)
+    for i in range(nsplit):
+        le = jnp.zeros((n,), jnp.bool_)
+        eq = jnp.ones((n,), jnp.bool_)
+        for w, sp in zip(words, splitters):
+            sv = sp[i]
+            le = le | (eq & (sv < w))
+            eq = eq & (sv == w)
+        dest = dest + (le | eq).astype(jnp.int32)
+    return dest
+
+
+def range_partition(
+    table: Table,
+    columns: Sequence[Union[int, str]],
+    num_partitions: int,
+    sample_size: int = 8192,
+) -> tuple[Table, jax.Array]:
+    """(rows reordered partition-contiguously, per-partition counts)."""
+    splitters = range_splitters(table, columns, num_partitions, sample_size)
+    part = partition_ids_range(table, columns, splitters)
+    return _reorder_by_parts(table, part, num_partitions)

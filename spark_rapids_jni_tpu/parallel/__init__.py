@@ -21,13 +21,20 @@ from .mesh import (
     local_shards,
 )
 from .tolerant import MeshRunner, run_collective
-from .planmesh import MeshUnsupported, run_plan_mesh
+from .planmesh import (
+    MeshUnsupported,
+    prepare_exchange,
+    run_plan_mesh,
+    run_plan_mesh_stream,
+)
 from .shuffle import (
     ShuffleOverflowError,
+    SkewPlan,
     exchange,
     exchange_ragged,
     partition_counts,
     plan_capacity,
+    plan_skew,
     shuffle_table,
     shuffle_table_compact,
     total_recv_capacity,
@@ -51,6 +58,8 @@ __all__ = [
     "MeshUnsupported",
     "run_collective",
     "run_plan_mesh",
+    "run_plan_mesh_stream",
+    "prepare_exchange",
     "make_mesh",
     "shard_table",
     "replicate_table",
@@ -62,6 +71,8 @@ __all__ = [
     "shuffle_table",
     "shuffle_table_compact",
     "total_recv_capacity",
+    "plan_skew",
+    "SkewPlan",
     "ShuffleOverflowError",
     "GroupOverflowError",
     "JoinOverflowError",

@@ -7,23 +7,48 @@ blocks (one per device), each shard runs the same fused segment body
 the single-device path compiles (``plan._run_segment_traced``), and the
 host gathers each shard's valid prefix back in mesh order.
 
+Shuffle as a plan op (ISSUE 17): a plan may additionally carry ONE
+``partition`` op (``plan._EXCHANGE_OPS``) anywhere in the chain. It is
+the mesh segment boundary: the scan-side row-local chain, a two-phase
+counts pass, a ragged all-to-all exchange, a device-local stable sort
+back into partition order, and the merge-side row-local chain all run
+as one planned pipeline under the same ``MeshRunner`` stage. The
+exchange launches are ``shuffle``-site replay boundaries inside the
+stage, so seeded shuffle faults replay losslessly from the host-side
+lineage and persistent failure walks the degradation ladder like any
+other stage.
+
 Parity contract: row-local ops neither reorder rows nor look across
 them, so block-sharded execution followed by an in-order prefix gather
-is byte-identical to the single-device result — at ANY mesh size. That
-mesh-size independence is what makes the degradation ladder safe here:
-when the runner remeshes to fewer devices mid-incident and replays, the
-stage re-derives shard layout and per-shard valid counts from the
-captured host-side lineage (the undonated input table + ops) at the new
-size and the bytes do not change.
+is byte-identical to the single-device result — at ANY mesh size. The
+partition boundary preserves this: the exact path's ``partition`` is a
+stable reorder by partition id, and the mesh path maps the contiguous
+pid range ``[d*num//size, (d+1)*num//size)`` to device ``d`` (monotonic
+in pid), exchanges rows in stable (src, in-src) order, and stable-sorts
+each device's received prefix by recomputed pid — so device ``d`` holds
+exactly the ``d``-th contiguous slice of the exact path's reordered
+table and the in-order gather is byte-identical, again at ANY mesh
+size. That mesh-size independence is what makes the degradation ladder
+safe here: when the runner remeshes to fewer devices mid-incident and
+replays, the stage re-derives shard layout, counts, and capacities from
+the captured host-side lineage (the undonated input table + ops) at the
+new size and the bytes do not change.
 
-Anything else — multi-table rest inputs, non-row-local ops, padded
-inputs — raises :class:`MeshUnsupported` and the caller falls through
-to the ordinary single-device plan path.
+Anything else — multi-table rest inputs, non-row-local chain ops, more
+than one partition boundary, padded inputs — raises
+:class:`MeshUnsupported` and the caller falls through to the ordinary
+single-device plan path.
+
+``run_plan_mesh_stream`` drives a SEQUENCE of batches through the same
+plan with exchange/compute overlap: batch N+1's scan-side counts pass
+and host-side pack are staged on the pipeline workers
+(``pipeline.stage_ahead``) while batch N's exchange launch runs on the
+caller thread — the overlap shows up as ``pipeline.overlap_ms``.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -31,16 +56,37 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..column import Column, Table
+from ..utils import metrics
 from .mesh import SHUFFLE_AXIS, shard_map
-from .tolerant import MeshRunner
+from .tolerant import MeshRunner, run_collective
 
 
 class MeshUnsupported(Exception):
     """This plan/input shape has no mesh path; use the exact path."""
 
 
+def _split_at_exchange(ops: Sequence[dict]):
+    """``(pre_ops, partition_op | None, post_ops)`` — the plan split at
+    its (single) exchange boundary."""
+    from .. import plan as plan_mod
+
+    idx = [
+        i for i, o in enumerate(ops)
+        if o.get("op") in plan_mod._EXCHANGE_OPS
+    ]
+    if not idx:
+        return list(ops), None, []
+    if len(idx) > 1:
+        raise MeshUnsupported(
+            "mesh path handles one partition boundary per plan; "
+            f"got {len(idx)}"
+        )
+    i = idx[0]
+    return list(ops[:i]), ops[i], list(ops[i + 1:])
+
+
 def _check_supported(ops: Sequence[dict], table: Table,
-                     rest: Sequence[Table]) -> None:
+                     rest: Sequence[Table]):
     from .. import plan as plan_mod
 
     if rest:
@@ -49,62 +95,92 @@ def _check_supported(ops: Sequence[dict], table: Table,
         raise MeshUnsupported("empty plan")
     if not table.columns or table.logical_row_count == 0:
         raise MeshUnsupported("empty table")
-    for op in ops:
+    pre, part, post = _split_at_exchange(ops)
+    for op in (*pre, *post):
         name = op.get("op")
         if name not in plan_mod._ROW_LOCAL:
             raise MeshUnsupported(
                 f"op {name!r} is not row-local; mesh path handles "
-                f"{sorted(plan_mod._ROW_LOCAL)} only"
+                f"{sorted(plan_mod._ROW_LOCAL)} chains (around one "
+                "optional partition boundary) only"
             )
+    if part is not None and part.get("kind", "hash") == "range" and pre:
+        # range splitters are sampled from the exchange INPUT; with a
+        # scan-side chain that input only exists per shard mid-stage,
+        # so the deterministic full-table sample the exact path draws
+        # is unavailable — decline rather than break byte parity
+        raise MeshUnsupported(
+            "range partition needs an empty scan-side chain: splitters "
+            "are sampled from the full exchange input"
+        )
+    return pre, part, post
 
 
-def run_plan_mesh(
-    ops: Sequence[dict],
-    table: Table,
-    runner: MeshRunner,
-    rest: Sequence[Table] = (),
-) -> Table:
-    """Run a row-local plan data-parallel over ``runner``'s mesh.
+def _pack_sharded(table: Table, mesh, axis: str, n: int):
+    """(padded sharded table, per-shard valid counts) for a contiguous
+    row-block layout — the host-side pack step."""
+    size = int(mesh.shape[axis])
+    per = -(-n // size)  # ceil: contiguous row blocks, one per dev
+    pad = per * size - n
 
-    Never consumes ``table`` (the un-donated input IS the replay
-    lineage); returns the exact (unpadded) result table. Raises
-    :class:`MeshUnsupported` when the plan has no mesh path and
-    :class:`~..utils.faults.Degraded` when the runner's ladder hits
-    its device floor.
-    """
+    def padleaf(x):
+        if pad:
+            x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+        return jax.device_put(
+            x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+        )
+
+    pt = jax.tree_util.tree_map(padleaf, table)
+    counts = np.clip(n - np.arange(size) * per, 0, per).astype(np.int32)
+    cnt = jax.device_put(
+        jnp.asarray(counts), NamedSharding(mesh, P(axis))
+    )
+    return pt, cnt
+
+
+def _gather_prefix(out_t: Table, out_c, size: int) -> Table:
+    """Host-side gather: each shard's valid prefix, in mesh order —
+    exactly the single-device result for row-local segments."""
+    # srt: allow-host-sync(result materialization: the stage's output IS these host bytes)
+    got = np.asarray(jax.device_get(out_c))
+    per_out = out_t.row_count // size
+
+    def take(x):
+        if x is None:
+            return None
+        # srt: allow-host-sync(result materialization: gathering the sharded output to host)
+        full = np.asarray(jax.device_get(x))
+        return np.concatenate(
+            [full[i * per_out:i * per_out + int(got[i])]
+             for i in range(size)]
+        )
+
+    cols = []
+    for c in out_t.columns:
+        cols.append(Column(
+            data=jnp.asarray(take(c.data)),
+            dtype=c.dtype,
+            validity=(
+                None if c.validity is None
+                else jnp.asarray(take(c.validity))
+            ),
+            lengths=(
+                None if c.lengths is None
+                else jnp.asarray(take(c.lengths))
+            ),
+        ))
+    return Table(cols, names=out_t.names)
+
+
+def _rowlocal_stage(seg_ops, table: Table, n: int, axis: str):
+    """Stage closure for a pure row-local plan (no exchange boundary)."""
     from .. import plan as plan_mod
-    from ..utils import buckets
-
-    _check_supported(ops, table, rest)
-    # a bucket-padded wire upload shrinks to its real rows first: the
-    # mesh stage derives its own shard padding, and the caller's padded
-    # input stays untouched (it is the fallback path's donation)
-    table = buckets.unpad_table(table)
-    seg_ops = list(ops)
-    n = int(table.row_count)
-    axis = runner.axis
 
     def stage(mesh):
         # re-derived per replay: a smaller surviving mesh re-plans the
         # shard layout + per-shard valid counts from the same lineage
         size = int(mesh.shape[axis])
-        per = -(-n // size)  # ceil: contiguous row blocks, one per dev
-        pad = per * size - n
-
-        def padleaf(x):
-            if pad:
-                x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
-            return jax.device_put(
-                x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
-            )
-
-        pt = jax.tree_util.tree_map(padleaf, table)
-        counts = np.clip(n - np.arange(size) * per, 0, per).astype(
-            np.int32
-        )
-        cnt = jax.device_put(
-            jnp.asarray(counts), NamedSharding(mesh, P(axis))
-        )
+        pt, cnt = _pack_sharded(table, mesh, axis, n)
 
         def body(local, c):
             t2, n2 = plan_mod._run_segment_traced(seg_ops, local, c[0])
@@ -117,37 +193,288 @@ def run_plan_mesh(
             check_vma=False,
         )
         out_t, out_c = fn(pt, cnt)
+        return _gather_prefix(out_t, out_c, size)
 
-        # host-side gather: each shard's valid prefix, in mesh order —
-        # exactly the single-device result for row-local segments
-        # srt: allow-host-sync(result materialization: the stage's output IS these host bytes)
-        got = np.asarray(jax.device_get(out_c))
-        per_out = out_t.row_count // size
+    return stage
 
-        def take(x):
-            if x is None:
-                return None
-            # srt: allow-host-sync(result materialization: gathering the sharded output to host)
-            full = np.asarray(jax.device_get(x))
-            return np.concatenate(
-                [full[i * per_out:i * per_out + int(got[i])]
-                 for i in range(size)]
+
+def _partition_stage(pre, part, post, table: Table, n: int, axis: str,
+                     prepared: Optional[dict] = None):
+    """Stage closure for a plan with one partition boundary: scan-side
+    chain -> counts pass -> ragged exchange -> stable pid sort ->
+    merge-side chain, all re-derivable from the host-side lineage.
+
+    ``prepared`` (from :func:`prepare_exchange`) carries a pack + counts
+    pass already run for a specific mesh — reused only when the stage
+    executes on that same mesh; any replay on a degraded mesh
+    re-derives both.
+    """
+    from .. import plan as plan_mod
+    from ..ops import partition as partition_mod
+    from ..utils import config, planstats
+    from .shuffle import (
+        _ragged_impl,
+        _round_capacity,
+        check_overflow_compact,
+        exchange_ragged,
+        total_recv_capacity,
+    )
+
+    num = int(part["num"])
+    keys = list(part.get("keys", []))
+    kind = part.get("kind", "hash")
+    impl = _ragged_impl(None)
+    # range splitters come from the full host-side exchange input — the
+    # same deterministic sample the exact path draws, so partition ids
+    # agree byte-for-byte (scan-side chain is empty, per _check_supported)
+    splitters = (
+        partition_mod.range_splitters(table, keys, num)
+        if kind == "range" else None
+    )
+
+    def pids_of(local: Table):
+        if kind == "hash":
+            return partition_mod.partition_ids_hash(
+                local, keys or None, num
+            )
+        return partition_mod.partition_ids_range(local, keys, splitters)
+
+    def counts_pass(mesh, pt, cnt, size):
+        """Scan-side chain + per-(src, dst-device) planned send counts
+        — the two-phase sizing pass, a shuffle-site replay boundary."""
+
+        def count_body(local, c):
+            t2, n2 = plan_mod._run_segment_traced(pre, local, c[0])
+            rv = jnp.arange(t2.row_count, dtype=jnp.int32) < n2
+            pid = pids_of(t2)
+            dd = jnp.where(
+                rv, (pid * size) // num, size
+            ).astype(jnp.int32)
+            return jnp.bincount(dd, length=size + 1)[:size].astype(
+                jnp.int32
+            )[None, :]
+
+        fn = shard_map(
+            count_body, mesh=mesh,
+            in_specs=(P(axis), P(axis)), out_specs=P(axis),
+            check_vma=False,
+        )
+        return run_collective(
+            "plan.partition_counts", lambda: fn(pt, cnt), site="shuffle"
+        )
+
+    def stage(mesh):
+        size = int(mesh.shape[axis])
+        if (
+            prepared is not None
+            and prepared.get("mesh") is mesh
+            and prepared.get("size") == size
+        ):
+            pt, cnt = prepared["pt"], prepared["cnt"]
+            counts = prepared["counts"]
+        else:
+            pt, cnt = _pack_sharded(table, mesh, axis, n)
+            counts = counts_pass(mesh, pt, cnt, size)
+        cap = total_recv_capacity(counts)
+        # srt: allow-host-sync(two-phase sizing: the planning pass exists to produce this host capacity)
+        pair_cap = _round_capacity(int(jnp.max(counts)))
+        # observe (not split: a pure redistribution has no agg to make
+        # salting lossless) planned recv skew across destinations — the
+        # planstats drift surface for partition-op plans
+        # srt: allow-host-sync(two-phase sizing: the skew observation reads the planned counts)
+        recv = np.asarray(jax.device_get(jnp.sum(counts, axis=0)))
+        mean = float(recv.mean()) if recv.size else 0.0
+        factor = float(config.get_flag("SKEW_SPLIT_FACTOR"))
+        if mean > 0 and float(recv.max()) > factor * mean:
+            planstats.note_skew({
+                "site": "plan.partition",
+                "action": "observed",
+                "max_recv": int(recv.max()),
+                "mean_recv": mean,
+                "ratio": float(recv.max()) / mean,
+                "factor": factor,
+                "devices": size,
+            })
+
+        def body(local, c, C):
+            t2, n2 = plan_mod._run_segment_traced(pre, local, c[0])
+            rv = jnp.arange(t2.row_count, dtype=jnp.int32) < n2
+            pid = pids_of(t2)
+            dd = ((pid * size) // num).astype(jnp.int32)
+            out, occ, overflow = exchange_ragged(
+                t2, dd, C, cap, axis, impl, row_valid=rv,
+                pair_capacity=pair_cap,
+            )
+            # restore the exact path's order: received rows arrive in
+            # stable (src, in-src) order; a stable sort by recomputed
+            # pid (padding keyed past every real pid) makes this device
+            # hold its contiguous slice of the globally pid-sorted table
+            pid2 = pids_of(out)
+            skey = jnp.where(occ, pid2.astype(jnp.int32), num)
+            perm = jnp.argsort(skey, stable=True).astype(jnp.int32)
+            sorted_t = jax.tree_util.tree_map(
+                lambda x: None if x is None else x[perm], out
+            )
+            n_recv = jnp.sum(occ.astype(jnp.int32))
+            t3, n3 = plan_mod._run_segment_traced(post, sorted_t, n_recv)
+            return (
+                t3,
+                jnp.reshape(n3, (1,)).astype(jnp.int32),
+                jnp.reshape(overflow, (1,)).astype(jnp.int32),
             )
 
-        cols = []
-        for c in out_t.columns:
-            cols.append(Column(
-                data=jnp.asarray(take(c.data)),
-                dtype=c.dtype,
-                validity=(
-                    None if c.validity is None
-                    else jnp.asarray(take(c.validity))
-                ),
-                lengths=(
-                    None if c.lengths is None
-                    else jnp.asarray(take(c.lengths))
-                ),
-            ))
-        return Table(cols, names=out_t.names)
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P(axis), P()),
+            out_specs=(P(axis), P(axis), P(axis)),
+            check_vma=False,
+        )
+        out_t, out_c, out_ov = run_collective(
+            "plan.partition_exchange",
+            lambda: fn(pt, cnt, counts),
+            site="shuffle",
+        )
+        # capacity came from the real counts, so overflow means a bug —
+        # surface it loudly rather than gathering a truncated result
+        check_overflow_compact(out_ov, cap, "plan partition")
+        if metrics.enabled():
+            metrics.counter_add("partition.mesh_segments")
+            metrics.counter_add("partition.rows_exchanged", n)
+        return _gather_prefix(out_t, out_c, size)
 
-    return runner.run_stage("plan.mesh", stage)
+    return stage
+
+
+def run_plan_mesh(
+    ops: Sequence[dict],
+    table: Table,
+    runner: MeshRunner,
+    rest: Sequence[Table] = (),
+) -> Table:
+    """Run a row-local plan (optionally around one ``partition``
+    boundary) data-parallel over ``runner``'s mesh.
+
+    Never consumes ``table`` (the un-donated input IS the replay
+    lineage); returns the exact (unpadded) result table. Raises
+    :class:`MeshUnsupported` when the plan has no mesh path and
+    :class:`~..utils.faults.Degraded` when the runner's ladder hits
+    its device floor.
+    """
+    from ..utils import buckets
+
+    pre, part, post = _check_supported(ops, table, rest)
+    # a bucket-padded wire upload shrinks to its real rows first: the
+    # mesh stage derives its own shard padding, and the caller's padded
+    # input stays untouched (it is the fallback path's donation)
+    table = buckets.unpad_table(table)
+    n = int(table.row_count)
+    axis = runner.axis
+    if part is None:
+        return runner.run_stage(
+            "plan.mesh", _rowlocal_stage(list(ops), table, n, axis)
+        )
+    return runner.run_stage(
+        "plan.mesh.partition",
+        _partition_stage(pre, part, post, table, n, axis),
+    )
+
+
+def prepare_exchange(ops: Sequence[dict], table: Table,
+                     runner: MeshRunner) -> Optional[dict]:
+    """Stage the host-side pack + scan-side counts pass for ``table``
+    at the runner's CURRENT mesh — the work ``run_plan_mesh_stream``
+    overlaps with the previous batch's exchange launch. Returns the
+    prepared dict ``_partition_stage`` consumes, or None when the plan
+    has no partition boundary (nothing worth staging ahead)."""
+    from ..utils import buckets
+
+    pre, part, post = _check_supported(ops, table, ())
+    if part is None:
+        return None
+    table = buckets.unpad_table(table)
+    n = int(table.row_count)
+    axis = runner.axis
+    mesh = runner.mesh
+    size = int(mesh.shape[axis])
+    pt, cnt = _pack_sharded(table, mesh, axis, n)
+    from .. import plan as plan_mod
+    from ..ops import partition as partition_mod
+
+    num = int(part["num"])  # srt: allow-host-sync(plan literal, not a device value)
+    keys = list(part.get("keys", []))
+    kind = part.get("kind", "hash")
+    splitters = (
+        partition_mod.range_splitters(table, keys, num)
+        if kind == "range" else None
+    )
+
+    def count_body(local, c):
+        t2, n2 = plan_mod._run_segment_traced(pre, local, c[0])
+        rv = jnp.arange(t2.row_count, dtype=jnp.int32) < n2
+        if kind == "hash":
+            pid = partition_mod.partition_ids_hash(t2, keys or None, num)
+        else:
+            pid = partition_mod.partition_ids_range(t2, keys, splitters)
+        dd = jnp.where(rv, (pid * size) // num, size).astype(jnp.int32)
+        return jnp.bincount(dd, length=size + 1)[:size].astype(
+            jnp.int32
+        )[None, :]
+
+    fn = shard_map(
+        count_body, mesh=mesh,
+        in_specs=(P(axis), P(axis)), out_specs=P(axis),
+        check_vma=False,
+    )
+    counts = run_collective(
+        "plan.partition_counts", lambda: fn(pt, cnt), site="shuffle"
+    )
+    return {
+        "mesh": mesh, "size": size, "pt": pt, "cnt": cnt,
+        "counts": counts,
+    }
+
+
+def run_plan_mesh_stream(
+    ops: Sequence[dict],
+    batches: Sequence[Table],
+    runner: MeshRunner,
+) -> list:
+    """Drive ``batches`` through one plan with exchange/compute overlap.
+
+    While batch N's exchange launch runs on the caller thread, batch
+    N+1's scan-side counts pass and host-side pack run on the pipeline
+    workers (``pipeline.stage_ahead``; worker busy time is metered as
+    ``pipeline.overlap_ms``). With the pipeline off, batches run
+    sequentially — byte-identical results either way, in input order.
+    Degradation safety: a prepared pack targets the mesh it was staged
+    for; if the runner degraded in between, the stage re-derives from
+    the host-side lineage at the new size.
+    """
+    from .. import pipeline
+
+    batches = list(batches)
+    if not batches:
+        return []
+    pre, part, post = _check_supported(ops, batches[0], ())
+
+    def prepare(b: Table):
+        return (b, prepare_exchange(ops, b, runner))
+
+    def execute(prepped):
+        b, prepared = prepped
+        from ..utils import buckets
+
+        t = buckets.unpad_table(b)
+        n = int(t.row_count)
+        axis = runner.axis
+        if part is None:
+            return runner.run_stage(
+                "plan.mesh", _rowlocal_stage(list(ops), t, n, axis)
+            )
+        return runner.run_stage(
+            "plan.mesh.partition",
+            _partition_stage(pre, part, post, t, n, axis,
+                             prepared=prepared),
+        )
+
+    return pipeline.stage_ahead(batches, prepare, execute, "mesh.prepare")

@@ -225,6 +225,83 @@ def total_recv_capacity(counts) -> int:
     return cap
 
 
+class SkewPlan:
+    """The adaptive-skew decision from the planning counts (ISSUE 17).
+
+    ``engaged`` means at least one destination's planned recv total
+    exceeds ``factor x`` the mean — the Spark AQE skew-join-split
+    signal, read here from the same two-phase counts the capacity
+    sizing already computes. ``k`` is the salt fan-out: hot keys spread
+    across ``k`` sub-partitions, sized so each carries roughly a mean
+    destination's rows.
+    """
+
+    __slots__ = ("engaged", "factor", "k", "hot", "max_recv", "mean_recv")
+
+    def __init__(self, engaged, factor, k, hot, max_recv, mean_recv):
+        self.engaged = engaged
+        self.factor = factor
+        self.k = k
+        self.hot = tuple(hot)
+        self.max_recv = max_recv
+        self.mean_recv = mean_recv
+
+    @property
+    def ratio(self) -> float:
+        return (
+            self.max_recv / self.mean_recv if self.mean_recv > 0 else 0.0
+        )
+
+    def to_doc(self) -> dict:
+        return {
+            "engaged": self.engaged,
+            "factor": self.factor,
+            "k": self.k,
+            "hot_destinations": list(self.hot),
+            "max_recv": self.max_recv,
+            "mean_recv": self.mean_recv,
+            "ratio": self.ratio,
+        }
+
+
+def plan_skew(counts, factor: Optional[float] = None) -> SkewPlan:
+    """Skew decision for a planned exchange (host sync, planning pass).
+
+    ``counts`` is the (P, P) per-(src, dst) matrix from
+    :func:`partition_counts`. Destinations whose planned recv totals
+    (column sums) exceed ``factor x`` the mean are hot; ``factor``
+    defaults to the ``SKEW_SPLIT_FACTOR`` flag and the whole machinery
+    gates on the ``SKEW_SPLIT`` master switch.
+    """
+    import numpy as np
+
+    from ..utils import config
+
+    if factor is None:
+        factor = float(config.get_flag("SKEW_SPLIT_FACTOR"))
+    raw = config.get_flag("SKEW_SPLIT")
+    # test overrides arrive unparsed ("0" must read as off, like the env)
+    split_on = config._as_bool(raw) if isinstance(raw, str) else bool(raw)
+    # srt: allow-host-sync(two-phase sizing: the skew decision is part of the planning pass)
+    recv = np.asarray(jax.device_get(jnp.sum(counts, axis=0))).astype(
+        np.int64
+    )
+    num = int(recv.shape[0])
+    total = int(recv.sum())
+    max_recv = int(recv.max()) if recv.size else 0
+    mean = total / num if num else 0.0
+    if not split_on or num < 2 or total == 0:
+        return SkewPlan(False, factor, 1, (), max_recv, mean)
+    hot = [int(d) for d in np.nonzero(recv > factor * mean)[0]]
+    if not hot:
+        return SkewPlan(False, factor, 1, (), max_recv, mean)
+    k = int(min(num, max(2, -(-max_recv // max(int(mean), 1)))))
+    if metrics.enabled():
+        metrics.gauge_set("shuffle.skew_k", k)
+        metrics.gauge_set("shuffle.skew_hot_destinations", len(hot))
+    return SkewPlan(True, factor, k, hot, max_recv, mean)
+
+
 def _ragged_impl(impl: Optional[str]) -> str:
     """Resolve the exchange implementation for the active backend.
 

@@ -55,6 +55,15 @@ _SIMPLE_FUSABLE = frozenset(
     {"cast", "filter", "rlike", "distinct", "sort_by", "slice"}
 )
 
+# mesh exchange boundaries: planmesh splits a plan at these ops into a
+# scan-side chain -> counts-sized all-to-all -> merge-side chain, each
+# chain still fused under shard_map. On the exact path they run through
+# the ordinary per-op dispatch (a stable partition-contiguous reorder).
+# Pure literal — the exchange-plane side of the SRT008 parity check:
+# every member must also be in runtime_bridge.DISPATCH_OPS,
+# _dispatch_impl, and plancheck._RULES.
+_EXCHANGE_OPS = frozenset({"partition"})
+
 # fused-segment failures are replayed per-op; warn once per op-chain
 # shape (the bucketed._WARNED_OPS discipline), not per call
 _WARNED_SIGS = set()

@@ -731,6 +731,30 @@ def _r_from_rows(op, st):
     return out, None, rows
 
 
+def _r_partition(op, st):
+    kind = op.get("kind", "hash")
+    if kind not in ("hash", "range"):
+        raise _Reject(f"unknown partition kind {kind!r}")
+    num = op.get("num")
+    if isinstance(num, bool) or not isinstance(num, int):
+        raise _Reject(f"partition num must be an integer, got {num!r}")
+    if num < 1:
+        raise _Reject(f"partition num must be >= 1, got {num}")
+    keys = op.get("keys", [])
+    if not isinstance(keys, list):
+        raise _Reject(f"partition 'keys' must be a list, got {keys!r}")
+    if kind == "range" and not keys:
+        raise _Reject("partition kind='range' needs a non-empty 'keys' list")
+    for k in keys:
+        _key_ref(k, st.schema, st.names, what="partition key")
+    # pure row redistribution: schema and total rows pass through
+    # unchanged — only the row ORDER (exact path) / placement (mesh
+    # path) moves, which is why it can sit on a segment boundary.
+    if st.schema is None:
+        return None, None, st.rows
+    return list(st.schema), st.names, st.rows
+
+
 # The rule table — the plancheck side of the SRT008 registry-parity pair.
 # Keys must equal runtime_bridge.DISPATCH_OPS (enforced statically by
 # srt_check pass SRT008 and dynamically by tests/test_plancheck.py).
@@ -748,6 +772,7 @@ _RULES = {
     "slice": _r_slice,
     "repeat": _r_repeat,
     "sample": _r_sample,
+    "partition": _r_partition,
     "to_rows": _r_to_rows,
     "from_rows": _r_from_rows,
 }
@@ -843,6 +868,9 @@ def _tier(op: dict) -> Tuple[str, str]:
         "explode": "data-dependent output rows: exact path only",
         "repeat": "row-multiplying op: exact path only",
         "sample": "data-dependent gather: exact path only",
+        "partition": "exchange boundary: exact path reorders in place; "
+                     "the mesh path (planmesh) runs a counts-sized "
+                     "all-to-all here and fuses the chains either side",
         "to_rows": "row-format transpose: exact path only",
         "from_rows": "row-format transpose: exact path only",
     }

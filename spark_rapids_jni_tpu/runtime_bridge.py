@@ -561,6 +561,31 @@ def _dispatch_impl(
             table, int(op["n"]), seed=int(op.get("seed", 0)),
             replacement=bool(op.get("replacement", False)),
         )
+    if name == "partition":
+        # Spark's ShuffleExchangeExec partitioning step as a table op:
+        # rows reordered partition-contiguously by Pmod(Murmur3, num)
+        # (hash) or sampled key-range splitters (range). The exchange
+        # itself is the mesh path's job (planmesh); on the exact path
+        # the stable reorder IS the observable result, which is what
+        # the mesh path must match byte-for-byte after its all-to-all.
+        from .ops import partition as partition_mod
+
+        kind = op.get("kind", "hash")
+        num = int(op["num"])
+        if num < 1:
+            raise ValueError(f"partition: num must be >= 1, got {num}")
+        keys = list(op.get("keys", []))
+        if kind == "hash":
+            out, _ = partition_mod.hash_partition(table, keys or None, num)
+        elif kind == "range":
+            if not keys:
+                raise ValueError("partition: range kind needs keys")
+            out, _ = partition_mod.range_partition(table, keys, num)
+        else:
+            raise ValueError(f"unknown partition kind {kind!r}")
+        if metrics.enabled():
+            metrics.counter_add("partition.exact")
+        return out
     if name == "to_rows":
         # device row transpose; result = a true LIST<UINT8> column (the
         # reference's output type, row_conversion.cu:389-406)
@@ -602,6 +627,7 @@ DISPATCH_OPS = frozenset(
         "slice",
         "repeat",
         "sample",
+        "partition",
         "to_rows",
         "from_rows",
     }

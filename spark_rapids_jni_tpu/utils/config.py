@@ -305,6 +305,23 @@ _FLAGS = {
             "drift.hbm tick",
         ),
         Flag(
+            "SKEW_SPLIT_FACTOR", 2.0,
+            _parse_positive_float("SKEW_SPLIT_FACTOR"),
+            "adaptive shuffle-skew threshold: after the two-phase "
+            "counts pass, any destination whose planned recv rows "
+            "exceed this factor x the mean gets its hot keys salted "
+            "across sub-partitions (partial-agg before exchange, "
+            "merge-agg after) so exchange capacity is sized from the "
+            "post-split counts; disable the machinery wholesale with "
+            "SKEW_SPLIT=0",
+        ),
+        Flag(
+            "SKEW_SPLIT", True, _as_bool,
+            "master switch for adaptive skew repartitioning on the "
+            "mesh shuffle path; off = always size capacity from the "
+            "raw per-destination counts (BENCH_r04 behaviour)",
+        ),
+        Flag(
             "SERVE_PORT", 0, _parse_port,
             "serving daemon (serving/server.py) localhost TCP port; "
             "0 (default) = OS-assigned ephemeral port, read back from "

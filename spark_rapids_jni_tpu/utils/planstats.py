@@ -120,6 +120,11 @@ _STATS: Dict[str, int] = {}
 # flight-dump surfacing for "what diverged lately"
 _FINDINGS: "deque" = deque(maxlen=64)
 
+# skew events observed mid-plan (shuffle exchange planning) waiting to
+# ride the next session record as typed "skew" findings; bounded so an
+# always-disabled planstats can't leak
+_PENDING_SKEW: "deque" = deque(maxlen=64)
+
 
 def _count(name: str, n: int = 1, as_bytes: bool = False) -> None:
     with _STATS_LOCK:
@@ -128,6 +133,77 @@ def _count(name: str, n: int = 1, as_bytes: bool = False) -> None:
         metrics.bytes_add(name, n)
     else:
         metrics.counter_add(name, n)
+
+
+def _skew_detail(ev: dict) -> str:
+    """Human line for one skew event (the --drift rendering)."""
+    try:
+        site = ev.get("site", "?")
+        ratio = float(ev.get("ratio") or 0.0)
+        factor = float(ev.get("factor") or 0.0)
+        if ev.get("action") == "split":
+            hot = ev.get("hot_destinations") or 0
+            nhot = len(hot) if isinstance(hot, (list, tuple)) else int(hot)
+            return (
+                f"{site}: split {nhot} hot "
+                f"destination(s) across k={int(ev.get('k') or 0)} salts — "
+                f"planned max recv {int(ev.get('max_recv') or 0)} rows "
+                f"(x{ratio:.1f} mean) -> {int(ev.get('post_max_recv') or 0)} "
+                f"(x{float(ev.get('post_ratio') or 0.0):.1f}) "
+                f"at factor {factor:g}"
+            )
+        return (
+            f"{site}: planned max recv {int(ev.get('max_recv') or 0)} rows "
+            f"is x{ratio:.1f} the mean at factor {factor:g} — "
+            "no split applied"
+        )
+    # srt: allow-broad-except(telemetry formatting must never raise into the shuffle path)
+    except Exception:
+        return repr(ev)
+
+
+def note_skew(detail: dict) -> None:
+    """Record one adaptive-skew decision from the shuffle plane. Surfaces
+    immediately in the always-on findings ring (serving stats, flight
+    dumps) and rides the next ``record_session`` record as a typed
+    ``skew`` drift finding so ``explain --drift`` shows it next to the
+    cardinality/HBM divergences. Never raises into the exchange path."""
+    try:
+        ev = dict(detail)
+        entry = {
+            "type": "skew",
+            "segment": None,
+            "detail": _skew_detail(ev),
+            "event": ev,
+            "fp": None,
+            "schema": None,
+            "bucket": None,
+            "ts": None,
+        }
+        with _STATS_LOCK:
+            _FINDINGS.append(dict(entry))
+            _PENDING_SKEW.append(entry)
+        _count("drift.skew")
+    # srt: allow-broad-except(telemetry hook on the hot shuffle path)
+    except Exception:
+        pass
+
+
+def _drain_skew(rec: dict) -> List[dict]:
+    """Pop pending skew events into findings stamped with the session
+    record's identity (fp/schema/bucket/ts)."""
+    with _STATS_LOCK:
+        pending = list(_PENDING_SKEW)
+        _PENDING_SKEW.clear()
+    out = []
+    for entry in pending:
+        e = dict(entry)
+        e["fp"] = rec.get("fp")
+        e["schema"] = rec.get("schema")
+        e["bucket"] = rec.get("bucket")
+        e["ts"] = rec.get("ts")
+        out.append(e)
+    return out
 
 
 def stats_doc() -> dict:
@@ -324,6 +400,7 @@ _DELTA_KEYS = (
     "retry.attempts", "retry.giveups",
     "serving.shed",
     "shuffle.exchanges", "shuffle.rows_exchanged",
+    "shuffle.skew_splits",
     "plan.oom_spill_retries", "plan.mesh_fallbacks", "mesh.degraded",
 )
 
@@ -564,6 +641,7 @@ def record_session(doc: dict, base: Optional[Dict[str, int]] = None):
     if pred is not None:
         rec["pred"] = pred
     drift = _drift_check(rec, pred)
+    drift = list(drift) + _drain_skew(rec)
     if drift:
         rec["drift"] = drift
     nbytes = _writer().append(rec)
@@ -620,8 +698,11 @@ def drift_report(
                 "_segs": {},
                 "pred": None,
                 "findings": [],
+                "counters": {},
             }
         g["runs"] += 1
+        for ck, cv in (rec.get("counters") or {}).items():
+            g["counters"][ck] = g["counters"].get(ck, 0) + int(cv)
         label = rec.get("label")
         if label and label not in g["labels"]:
             g["labels"].append(label)
@@ -684,6 +765,7 @@ def drift_report(
                 "est_hbm_peak_bytes"
             ),
             "findings": g["findings"],
+            "counters": dict(sorted(g["counters"].items())),
         })
     return {
         "version": 1,
@@ -746,6 +828,17 @@ def render_drift(report: dict) -> str:
                 "      wall p50/p95/max "
                 + _fmt_dist(s.get("wall_s"), "ms", 1e3)
             )
+        # the exchange story of this plan group: shuffle/partition
+        # counter deltas (skew splits most of all) next to the findings
+        exch = {
+            k: v for k, v in (g.get("counters") or {}).items()
+            if k.startswith("shuffle.") or k.startswith("partition.")
+        }
+        if exch:
+            lines.append(
+                "  exchange: "
+                + " ".join(f"{k}={v}" for k, v in exch.items())
+            )
         finds = g.get("findings") or []
         if finds:
             lines.append(f"  findings ({len(finds)}):")
@@ -798,6 +891,7 @@ def reset() -> None:
     with _STATS_LOCK:
         _STATS.clear()
         _FINDINGS.clear()
+        _PENDING_SKEW.clear()
     _GATE = (None, False)
 
 

@@ -51,8 +51,18 @@ GLOBAL_CHAIN = [
     {"op": "sort_by", "keys": [{"column": 0}]},
 ]
 
+# exchange boundary mid-chain (ISSUE 17): the mesh path must run
+# scan-side chain -> counts pass -> ragged all-to-all -> merge-side
+# chain as ONE replayable stage
+PARTITION_CHAIN = [
+    {"op": "filter", "mask": 1},
+    {"op": "partition", "kind": "hash", "keys": [0], "num": 16},
+    {"op": "cast", "column": 0, "type_id": F64},
+]
+
 CHAOS_FLAGS = (
     "FAULTS", "RETRY_MAX", "RETRY_BASE_MS", "MESH_PROBE_S",
+    "SKEW_SPLIT", "SKEW_SPLIT_FACTOR",
 )
 
 
@@ -386,6 +396,130 @@ class TestPlanMesh:
         got = _tbl(plan_mod.run_plan(GLOBAL_CHAIN, t, mesh_runner=runner))
         assert got == want
         assert _counter("plan.mesh_declined") - declined == 1
+
+
+# ---------------------------------------------------------------------------
+# partition-op plans under chaos (ISSUE 17): the exchange boundary
+# replays losslessly at every ladder rung, and the salted skew-split
+# exchange recovers byte-identical under seeded shuffle faults
+# ---------------------------------------------------------------------------
+
+
+def _skewed_table(n: int = 20_000, seed: int = 7):
+    """~80% of rows carry ONE key: a single destination sees far past
+    SKEW_SPLIT_FACTOR x the mean, so the adaptive splitter must engage."""
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 1000, n, dtype=np.int64)
+    k[rng.random(n) < 0.8] = 1
+    v = rng.integers(-100, 100, n, dtype=np.int64)
+    return Table.from_pydict({"k": k, "v": v}), k, v
+
+
+def _agg_dict(agg, ngroups):
+    """Placement-free groupby content: key -> (sum, count). Works at any
+    mesh size (the ladder moves placement, never content)."""
+    counts = np.asarray(ngroups)
+    ndev = len(counts)
+    ks = np.asarray(agg["k"].data).reshape(ndev, -1)
+    sums = np.asarray(agg["sum_v"].data).reshape(ndev, -1)
+    cnts = np.asarray(agg["count_v"].data).reshape(ndev, -1)
+    got = {}
+    for d in range(ndev):
+        for i in range(int(counts[d])):
+            got[int(ks[d, i])] = (int(sums[d, i]), int(cnts[d, i]))
+    return got
+
+
+@pytest.mark.slow
+class TestPartitionPlanChaos:
+    """Slow tier: ~4.5 min of partition-stage compiles across mesh
+    sizes (the quick tier is near its premerge budget; premerge covers
+    the exchange parity + skew-split paths via ci/smoke-skew.sh)."""
+
+    @pytest.mark.parametrize("n", BOUNDARY_SIZES)
+    def test_partition_parity_at_bucket_edges(self, n):
+        config.set_flag("BUCKETS", "")
+        t = _plan_table(n)
+        want = _tbl(plan_mod.run_plan(PARTITION_CHAIN, t))
+        runner = parallel.MeshRunner(8)
+        got = _tbl(plan_mod.run_plan(PARTITION_CHAIN, t,
+                                     mesh_runner=runner))
+        assert got == want
+        assert runner.to_doc()["degraded"] is False
+
+    def test_partition_parity_through_full_ladder(self):
+        """Three dead-slice events walk the mesh 8 -> 4 -> 2 -> 1 with a
+        partition boundary mid-plan; every replay re-derives shard
+        layout, counts pass, and exchange capacity at the smaller size
+        and stays byte-identical — the exchange is mesh-size
+        independent by construction (dest device = pid*size//num)."""
+        config.set_flag("BUCKETS", "")
+        config.set_flag("RETRY_MAX", "0")
+        config.set_flag("METRICS", "1")
+        t = _plan_table(1024)
+        want = _tbl(plan_mod.run_plan(PARTITION_CHAIN, t))
+        config.set_flag("FAULTS", "seed=2,collective:transient:1:3")
+        runner = parallel.MeshRunner(8)
+        got = _tbl(plan_mod.run_plan(PARTITION_CHAIN, t,
+                                     mesh_runner=runner))
+        assert got == want
+        doc = runner.to_doc()
+        assert doc["degraded"] is True and doc["devices"] == 1
+        assert doc["replays"] == 3
+
+    def test_shuffle_faults_in_skew_split_replay_lossless(self, mesh):
+        """Seeded shuffle-site faults land inside the salted two-phase
+        exchange launches; lineage replay re-runs only the failed
+        launch and the merged result stays byte-identical."""
+        config.set_flag("METRICS", "1")
+        config.set_flag("RETRY_BASE_MS", "1")
+        t, k, v = _skewed_table()
+        aggs = [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")]
+        splits0 = _counter("shuffle.skew_splits")
+        agg, ng, ov = parallel.distributed_groupby(t, ["k"], aggs, mesh)
+        assert int(np.asarray(ov).max()) <= 0
+        want = _agg_dict(agg, ng)
+        # the splitter must actually have engaged on this shape
+        assert _counter("shuffle.skew_splits") > splits0
+        # and produced exactly the numpy oracle
+        oracle = {
+            int(u): (int(v[k == u].sum()), int((k == u).sum()))
+            for u in np.unique(k)
+        }
+        assert want == oracle
+        retries = _counter("shuffle.retries")
+        config.set_flag("FAULTS", "seed=11,shuffle:transient:1:2")
+        agg, ng, ov = parallel.distributed_groupby(t, ["k"], aggs, mesh)
+        assert int(np.asarray(ov).max()) <= 0
+        assert _agg_dict(agg, ng) == want
+        assert faults.injection_stats()["shuffle:transient"][
+            "injected"] == 2
+        assert _counter("shuffle.retries") - retries >= 2
+
+    def test_salted_exchange_mid_degradation_parity(self):
+        """A persistent fault during the salted exchange walks the
+        runner's ladder 8 -> 4; the replay re-plans the split at the
+        surviving size and the merged groups stay byte-identical."""
+        config.set_flag("METRICS", "1")
+        config.set_flag("RETRY_MAX", "0")
+        t, k, v = _skewed_table(seed=3)
+        aggs = [GroupbyAgg("v", "sum"), GroupbyAgg("v", "count")]
+        mesh8 = parallel.make_mesh(8)
+        agg, ng, ov = parallel.distributed_groupby(t, ["k"], aggs, mesh8)
+        want = _agg_dict(agg, ng)
+        config.set_flag("FAULTS", "seed=2,shuffle:transient:1:1")
+        runner = parallel.MeshRunner(8)
+        agg, ng, ov = runner.run_stage(
+            "chaos.skew_groupby",
+            lambda mesh: parallel.distributed_groupby(
+                t, ["k"], aggs, mesh
+            ),
+        )
+        config.set_flag("FAULTS", "")
+        assert int(np.asarray(ov).max()) <= 0
+        doc = runner.to_doc()
+        assert doc["degraded"] is True and doc["devices"] == 4
+        assert _agg_dict(agg, ng) == want
 
 
 # ---------------------------------------------------------------------------

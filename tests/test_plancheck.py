@@ -277,6 +277,40 @@ class TestInferenceRules:
         assert not rep["ok"]
         assert "STRING" in rep["ops"][0]["reason"]
 
+    def test_partition_schema_passthrough(self):
+        rep = _one([{"op": "partition", "kind": "hash", "keys": [0],
+                     "num": 8}])
+        assert rep["ok"], rep["ops"][0]["reason"]
+        out = rep["ops"][0]["out_schema"]
+        # pure row redistribution: schema and rows pass through unchanged
+        assert [c["type_id"] for c in out] == [I64, I64, B8, F64, STR]
+        assert rep["ops"][0]["rows_bound"] == 100
+        assert rep["ops"][0]["tier"] == "exact-only"
+        assert "exchange boundary" in rep["ops"][0]["reason"]
+
+    def test_partition_bad_kind_rejected(self):
+        rep = _one([{"op": "partition", "kind": "zorder", "num": 8}])
+        assert not rep["ok"]
+        assert "unknown partition kind" in rep["ops"][0]["reason"]
+
+    def test_partition_bad_num_rejected(self):
+        for num in (0, -3, True, "8", None):
+            rep = _one([{"op": "partition", "kind": "hash", "keys": [0],
+                         "num": num}])
+            assert not rep["ok"], num
+            assert "partition num" in rep["ops"][0]["reason"]
+
+    def test_partition_range_needs_keys(self):
+        rep = _one([{"op": "partition", "kind": "range", "num": 8}])
+        assert not rep["ok"]
+        assert "non-empty 'keys'" in rep["ops"][0]["reason"]
+
+    def test_partition_missing_key_rejected(self):
+        rep = _one([{"op": "partition", "kind": "hash", "keys": [17],
+                     "num": 8}])
+        assert not rep["ok"]
+        assert "out of range" in rep["ops"][0]["reason"]
+
     def test_to_rows_from_rows_roundtrip_schema(self):
         rep = _one([
             {"op": "to_rows"},

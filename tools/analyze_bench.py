@@ -547,6 +547,72 @@ def summarize_failures(raw: list) -> None:
         )
 
 
+def summarize_skew(raw: list, merged=None) -> None:
+    """Adaptive-shuffle-skew summary: the structured ``skew`` A/B block
+    the ``mesh_skew_adaptive`` arm emits (splitting off vs on with
+    seconds / recv-buffer / peak-RSS deltas) plus the skew fields on
+    plain ``4-skew`` entries and the merged ``shuffle.skew_*`` /
+    ``partition.*`` counters. Old BENCH files predate all of these —
+    silent skip, like the other summaries."""
+    if merged is None:
+        merged = _merge_metrics(raw)
+    blocks = [e for e in raw if isinstance(e.get("skew"), dict)]
+    plain = [
+        e for e in raw
+        if not isinstance(e.get("skew"), dict)
+        and ("skew_splits" in e or "max_over_mean" in e)
+    ]
+    c = merged["counters"]
+    ctr_keys = sorted(
+        k for k in c
+        if k.startswith("shuffle.skew_") or k.startswith("partition.")
+    )
+    if not (blocks or plain or ctr_keys):
+        return
+    print("\nadaptive shuffle skew:")
+    for e in blocks:
+        s = e["skew"]
+        off, on = s.get("off") or {}, s.get("on") or {}
+        d = s.get("deltas") or {}
+
+        def _f(v, fmt="{:.3f}"):
+            return "?" if v is None else fmt.format(v)
+
+        print(
+            f"  {e.get('name', '?'):42} factor={s.get('factor', '?')} "
+            f"splits={s.get('splits', '?')}"
+        )
+        print(
+            f"    off: {_f(off.get('seconds'))}s "
+            f"recv_buffer_rows={off.get('recv_buffer_rows', '?')} "
+            f"rss={off.get('peak_rss_mb', '?')}MB "
+            f"max/mean={_f(off.get('max_over_mean'), '{:.2f}')}"
+        )
+        print(
+            f"    on:  {_f(on.get('seconds'))}s "
+            f"recv_buffer_rows={on.get('recv_buffer_rows', '?')} "
+            f"rss={on.get('peak_rss_mb', '?')}MB "
+            f"max/mean={_f(on.get('max_over_mean'), '{:.2f}')}"
+        )
+        print(
+            f"    deltas (off-on): {_f(d.get('seconds'))}s, "
+            f"{d.get('recv_buffer_rows', '?')} recv rows, "
+            f"{d.get('peak_rss_mb', '?')} MB RSS"
+        )
+    for e in plain:
+        print(
+            f"  {str(e.get('name') or e.get('config', '?')):42} "
+            f"splits={e.get('skew_splits', '?')} "
+            f"max_recv_rows={e.get('max_recv_rows', '?')} "
+            f"max/mean={e.get('max_over_mean', '?')}"
+        )
+    if ctr_keys:
+        print(
+            "  counters: "
+            + ", ".join(f"{k}={int(c[k])}" for k in ctr_keys)
+        )
+
+
 def summarize_drift(drift) -> None:
     """Plan-stats drift summary from the headline ``drift`` block
     (record/plan-group counts and typed findings accumulated by the
@@ -582,6 +648,7 @@ def main() -> None:
         summarize_pipeline(raw, merged=merged)
         summarize_serving(raw)
         summarize_profile(raw)
+        summarize_skew(raw, merged=merged)
         summarize_failures(raw)
         summarize_drift(drift)
         return
@@ -612,6 +679,7 @@ def main() -> None:
     summarize_pipeline(raw, merged=merged)
     summarize_serving(raw)
     summarize_profile(raw)
+    summarize_skew(raw, merged=merged)
     summarize_failures(raw)
     summarize_drift(drift)
 

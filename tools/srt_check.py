@@ -40,13 +40,15 @@ Passes (each emits ``file:line:col`` findings):
   manual) in ``_ARM_TIERS``: un-tiered arms are how bench rounds
   r04/r05 silently blew the ``SRT_BENCH_BUDGET_S`` wall budget
   (rc=124, headline parsed=null).
-* **SRT008 dispatch-parity** — the three op registries of the dispatch
+* **SRT008 dispatch-parity** — the op registries of the dispatch
   plane (``runtime_bridge.DISPATCH_OPS``, the ``name == "..."`` arms
   of ``_dispatch_impl``, and ``plancheck._RULES``) must hold exactly
   the same op keys: an op added to the dispatcher without a plancheck
   inference rule would make the plan-time analyzer reject (or
   mis-infer) a runnable plan — the GpuOverrides-tag/exec drift bug
-  class, caught statically.
+  class, caught statically. The exchange plane rides the same pass:
+  every ``plan._EXCHANGE_OPS`` entry (the ops planmesh splits mesh
+  plans at) must appear in all three registries above.
 * **SRT009 host-sync** — implicit device->host synchronizations in the
   hot dispatch modules (``plan.py``, ``bucketed.py``): ``bool()``/
   ``int()``/``float()`` over device values (``.data``/``.validity``/
@@ -177,7 +179,7 @@ METRIC_NAMESPACES = frozenset({
     "session", "retry", "faults", "breaker", "fault", "spill", "lock",
     "shuffle", "distributed", "io", "probe", "bench", "groupby",
     "join", "sort", "profile", "stream", "checkpoint", "restore",
-    "mesh", "planstats", "drift",
+    "mesh", "planstats", "drift", "partition",
 })
 METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
@@ -927,6 +929,56 @@ def check_dispatch_parity(relpath: str, tree: ast.Module,
             f"plancheck rule {op!r} has no dispatch arm — the analyzer "
             "would tag an op the runtime cannot execute",
         )
+
+    # the exchange plane (4th registry): plan.py's _EXCHANGE_OPS names
+    # the ops planmesh treats as mesh segment boundaries; each must be
+    # a full dispatch citizen (DISPATCH_OPS + arm + plancheck rule), or
+    # the mesh path would split plans at an op the exact path cannot
+    # run and the analyzer cannot tag
+    plan_path = os.path.join(src_dir, "plan.py")
+    if os.path.exists(plan_path):
+        try:
+            with open(plan_path, "r", encoding="utf-8") as f:
+                plan_tree = ast.parse(f.read(), filename=plan_path)
+        except SyntaxError:
+            return findings  # plan.py's own scan reports the error
+        exchange: Optional[set] = None
+        exch_line = 1
+        for node in plan_tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "_EXCHANGE_OPS":
+                exch_line = node.lineno
+                exchange = _str_set_literal(node.value)
+        if exchange is None:
+            emit(
+                ops_assign,
+                "plan.py has no literal _EXCHANGE_OPS frozenset — the "
+                "exchange-plane side of the registry-parity pass reads "
+                "it statically",
+            )
+            return findings
+        for op in sorted(exchange - declared):
+            emit(
+                ops_assign,
+                f"exchange op {op!r} (plan.py _EXCHANGE_OPS, line "
+                f"{exch_line}) is not in DISPATCH_OPS — the mesh path "
+                "would split plans at an op the exact path cannot run",
+            )
+        for op in sorted(exchange - arms):
+            emit(
+                ops_assign,
+                f"exchange op {op!r} (plan.py _EXCHANGE_OPS, line "
+                f"{exch_line}) has no `name == ...` arm in "
+                "_dispatch_impl — no exact fallback for the boundary",
+            )
+        for op in sorted(exchange - rules):
+            emit(
+                ops_assign,
+                f"exchange op {op!r} (plan.py _EXCHANGE_OPS, line "
+                f"{exch_line}) has no plancheck inference rule "
+                f"(plancheck.py _RULES, line {rules_line})",
+            )
     return findings
 
 
