@@ -2251,6 +2251,35 @@ _EXTENDED_FLOOR_S = 300.0  # budget an extended arm needs left to start
 _TAIL_RESERVE_S = 480.0
 _MESH_STAGE_FLOOR_S = 150.0  # a CPU-mesh stage needs this left to start
 _ARROW_FLOOR_S = 120.0       # the Arrow 100M baseline likewise
+# TPC-DS-from-parquet mesh arm: opt-in AND capped to the same slice as
+# the skew arms (it previously ran ~30min worst case under an 1800s cap
+# and ate the whole budget tail — the r04 rc=124 postmortem)
+_TPCDS_ARM_CAP_S = 900.0
+
+# the chosen budget split, published as headline JSON "budget" so a
+# postmortem of a skipped/killed arm can see the split the run chose
+# without reverse-engineering it from env + source; set once in main()
+_BUDGET_DOC = None
+
+
+def _budget_doc(budget_s: float, source: str) -> dict:
+    return {
+        "budget_s": budget_s,
+        "source": source,
+        "tail_reserve_s": _TAIL_RESERVE_S,
+        "config_timeout_s": _CONFIG_TIMEOUT_S,
+        "extended_floor_s": _EXTENDED_FLOOR_S,
+        "mesh_stage_floor_s": _MESH_STAGE_FLOOR_S,
+        "arrow_floor_s": _ARROW_FLOOR_S,
+        "mesh_arm_caps_s": {
+            "skew_adaptive_ab": _arm_cap(900.0),
+            "skew_zipf": _arm_cap(900.0),
+            "tpcds": _arm_cap(_TPCDS_ARM_CAP_S),
+        },
+        "tpcds_opt_in": os.environ.get(
+            "SRT_BENCH_MESH_TPCDS", ""
+        ).strip().lower() in ("1", "true", "yes", "on"),
+    }
 
 
 def _run_one(name: str) -> None:
@@ -2605,6 +2634,7 @@ def _emit(entries, platform, arrow_rows_per_s=None):
             "platform": platform,
             "headline_source": source,
             "drift": _drift_block(),
+            "budget": _BUDGET_DOC,
             "configs": entries,
             "note": (
                 "Line re-printed after every config (take the LAST "
@@ -2628,12 +2658,20 @@ def main():
     # kill timeout; SRT_BENCH_DEADLINE_S kept as the legacy alias):
     # when exceeded, remaining configs are SKIPPED with structured
     # records and the headline line is still the last thing printed
+    if "SRT_BENCH_BUDGET_S" in os.environ:
+        budget_src = "env:SRT_BENCH_BUDGET_S"
+    elif "SRT_BENCH_DEADLINE_S" in os.environ:
+        budget_src = "env:SRT_BENCH_DEADLINE_S"
+    else:
+        budget_src = "default"
     budget_s = float(
         os.environ.get(
             "SRT_BENCH_BUDGET_S",
             os.environ.get("SRT_BENCH_DEADLINE_S", 3300),
         )
     )
+    global _BUDGET_DOC
+    _BUDGET_DOC = _budget_doc(budget_s, budget_src)
     t_start = time.time()
     deadline = t_start + budget_s
     # the arm walk's own deadline: earlier than the budget deadline by
@@ -2778,9 +2816,12 @@ def main():
     # structured {type:"timeout"} failure — never again the r04 rc=124
     # where a stage started with minutes left and ran unbounded past
     # the driver's kill, leaving parsed=null. The TPC-DS-from-parquet
-    # arm is additionally opt-in (SRT_BENCH_MESH_TPCDS=1): at ~30min
-    # worst case it ate the whole tail, and the skew arm already
-    # exercises the distributed exchange for the headline.
+    # arm is additionally opt-in (SRT_BENCH_MESH_TPCDS=1) AND trimmed
+    # to the same 900s slice as the skew arms (_TPCDS_ARM_CAP_S): under
+    # its old 1800s cap it could eat the whole tail even when opted in,
+    # and the skew arm already exercises the distributed exchange for
+    # the headline. The split the run chose is published as the
+    # headline's "budget" block.
     mesh_arms = [
         # the adaptive-skew A/B first: it carries the headline skew
         # block (seconds / recv-buffer / RSS deltas, splitting on vs
@@ -2795,7 +2836,7 @@ def main():
         "1", "true", "yes", "on"
     ):
         mesh_arms.append((tpcds_name, bench_tpcds_distributed,
-                          _arm_cap(1800.0)))
+                          _arm_cap(_TPCDS_ARM_CAP_S)))
     else:
         _progress(
             f"skipping {tpcds_name}: opt-in arm "

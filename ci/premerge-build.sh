@@ -37,7 +37,7 @@ python3 tools/srt_check.py
 # analog) — a driver must never ship a plan the runtime would reject.
 python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh \
   ci/smoke-chaos-mesh.sh ci/smoke-spill.sh ci/smoke-restart.sh \
-  ci/smoke-drift.sh ci/smoke-skew.sh
+  ci/smoke-drift.sh ci/smoke-skew.sh ci/smoke-trace.sh
 
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).
@@ -100,6 +100,14 @@ bash ci/smoke-restart.sh
 # a typed drift finding; `explain --drift` must render the store as
 # predicted-vs-observed percentiles.
 bash ci/smoke-drift.sh
+
+# Trace smoke: a traced serving request over the 2-device mesh — with
+# one client kill -9'd mid-stream — must leave per-process flight
+# dumps that tracequery merges into ONE trace (client.rpc + admission
+# + queue-wait + compile + per-segment execute + mesh exchange spans,
+# one shared trace id across >= 2 processes), and the live `trace`
+# command must return the slow-request log + Prometheus exposition.
+bash ci/smoke-trace.sh
 
 # Skew smoke: a seeded zipf stream through a plan carrying a
 # `partition` op must run on the 8-device mesh byte-identical to the

@@ -39,7 +39,7 @@ from typing import Callable, Optional
 
 import jax
 
-from ..utils import config, faults, flight, lockcheck, log, metrics
+from ..utils import config, faults, flight, lockcheck, log, metrics, tracing
 from .mesh import SHUFFLE_AXIS, MeshHealth, make_mesh
 
 
@@ -66,6 +66,22 @@ def run_collective(
     keep :func:`~..utils.faults.run_with_retry` semantics — they
     surface unchanged.
     """
+    # the exchange span: trace-tagged on the flight ring, so a merged
+    # trace shows every collective launch (and its retries — same span,
+    # same trace: replay never mints a fresh trace id) under the
+    # request that ran it
+    tok = tracing.span_begin(label)
+    err: Optional[str] = None
+    try:
+        return _run_collective(label, launch, site, donated, max_retries)
+    except BaseException as e:
+        err = type(e).__name__
+        raise
+    finally:
+        tracing.span_end(tok, error=err)
+
+
+def _run_collective(label, launch, site, donated, max_retries):
     attempt = 0
     while True:
         faults.check_cancel()
@@ -148,9 +164,24 @@ class MeshRunner:
             return int(self.mesh.shape[self.axis])
 
     def run_stage(self, label: str, stage: Callable[[object], object]):
-        """Run ``stage(mesh)`` with retry + degradation-replay."""
+        """Run ``stage(mesh)`` with retry + degradation-replay. The
+        whole ladder — replays and degradations included — runs inside
+        ONE trace-tagged ``mesh.stage`` span, so the ``mesh.replay`` /
+        ``mesh.degraded`` instants are attributed to the ORIGINAL
+        request's trace id (a replay never mints a fresh trace)."""
         with self._lock:
             self.stages += 1
+        tok = tracing.span_begin("mesh.stage")
+        err: Optional[str] = None
+        try:
+            return self._run_stage(label, stage)
+        except BaseException as e:
+            err = type(e).__name__
+            raise
+        finally:
+            tracing.span_end(tok, error=err)
+
+    def _run_stage(self, label: str, stage: Callable[[object], object]):
         while True:
             with self._lock:
                 mesh = self.mesh

@@ -46,7 +46,9 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
-from .utils import config, faults, flight, lockcheck, log, metrics, profiler
+from .utils import (
+    config, faults, flight, lockcheck, log, metrics, profiler, tracing,
+)
 
 DEFAULT_DEPTH = 2
 MAX_DEPTH = 64
@@ -149,8 +151,8 @@ class Pending:
     """
 
     __slots__ = (
-        "label", "_work", "_event", "_value", "_error", "_replayed",
-        "_replayable", "_orphaned", "_lock",
+        "label", "ctx", "_work", "_event", "_value", "_error",
+        "_replayed", "_replayable", "_orphaned", "_lock",
     )
 
     def __init__(
@@ -158,6 +160,11 @@ class Pending:
         replayable: bool = True,
     ):
         self.label = label
+        # trace context captured at construction (= enqueue time):
+        # contextvars do not flow into the pool threads by themselves,
+        # so the worker re-activates the submitter's context around the
+        # stage — its span lands in the submitting request's trace
+        self.ctx = tracing.current()
         self._work = work
         self._event = threading.Event()
         self._value = None
@@ -180,7 +187,8 @@ class Pending:
         try:
             # the span lands on the WORKER tid: flight/Chrome traces
             # show this stage as its own lane overlapping the caller's
-            with metrics.span("pipeline." + self.label):
+            with tracing.activate(self.ctx), \
+                    metrics.span("pipeline." + self.label):
                 self._value = self._work()
         except BaseException as e:
             self._error = e
@@ -323,7 +331,10 @@ class Pending:
                 error=f"{type(err).__name__}: {str(err)[:200]}",
             )
             try:
-                with metrics.span("pipeline.replay." + self.label):
+                # the replay stays in the ORIGINAL request's trace —
+                # a replay must never mint (or lose) the trace id
+                with tracing.activate(self.ctx), \
+                        metrics.span("pipeline.replay." + self.label):
                     self._value = self._work()
                 self._error = None
             except BaseException as e:

@@ -15,6 +15,7 @@ import contextlib
 import socket
 from typing import List, Optional, Sequence
 
+from ..utils import tracing
 from . import frames
 
 
@@ -207,8 +208,28 @@ class Client:
     def _rpc(self, header: dict, buffers: Sequence[bytes] = ()):
         if self._sock is None:
             raise RuntimeError("client is not connected")
-        frames.send_frame(self._sock, header, buffers)
-        resp, payload = frames.recv_frame(self._sock)
+        # trace-context stamp: propagate the ambient context if the
+        # caller has one, else mint a fresh per-request trace when the
+        # plane is on — the server joins it, so both processes' flight
+        # dumps share one trace id (tools/tracequery.py merges them)
+        ctx = tracing.current()
+        if ctx is None and tracing.context_enabled():
+            ctx = tracing.new_context()
+        if ctx is not None and "traceparent" not in header:
+            header["traceparent"] = ctx.header
+        with tracing.activate(ctx):
+            tok = tracing.span_begin("client.rpc")
+            try:
+                frames.send_frame(self._sock, header, buffers)
+                resp, payload = frames.recv_frame(self._sock)
+            except BaseException as e:
+                tracing.span_end(tok, error=type(e).__name__)
+                raise
+            tracing.span_end(
+                tok,
+                error=None if resp.get("ok")
+                else str((resp.get("error") or {}).get("type", "error")),
+            )
         if not resp.get("ok"):
             _raise_error(resp.get("error") or {})
         resp["_payload"] = payload
@@ -269,6 +290,12 @@ class Client:
 
     def stats(self) -> dict:
         return self._rpc({"cmd": "stats"})["stats"]
+
+    def trace(self) -> dict:
+        """Live introspection plane: the daemon's slow-request log
+        (top-K by duration, tail-sampled span detail) plus a
+        Prometheus-style text exposition of the metrics snapshot."""
+        return self._rpc({"cmd": "trace"})["trace"]
 
     def drain(self, deadline_s: Optional[float] = None) -> dict:
         """Rolling-restart drain: the daemon stops admitting, finishes

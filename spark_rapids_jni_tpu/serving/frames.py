@@ -25,6 +25,16 @@ on hello it sets the session's default request deadline, on a
 ``stream`` / ``plan`` command it bounds that one request — the server
 turns it into a ``faults.CancelToken`` checked between plan segments
 and stream batches, answering ``deadline_exceeded`` when it elapses.
+
+Hello and command headers may also carry ``traceparent``: the
+W3C-style trace-context header (``utils/tracing.py`` —
+``00-<32-hex trace_id>-<16-hex span_id>-01``). The client stamps it
+per request when the trace plane is on; the server joins the incoming
+trace (fresh hop span id, same trace id) and activates it as the
+ambient context for the request, so every span/instant either side
+records into its flight ring carries the same trace id and
+``tools/tracequery.py`` can merge the per-process dumps into one
+request timeline. A malformed header is ignored, never an error.
 """
 
 from __future__ import annotations

@@ -33,7 +33,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
-from ..utils import faults, flight, lockcheck, metrics, profiler
+from ..utils import faults, flight, lockcheck, metrics, profiler, tracing
 from .session import Session, SessionClosed, executing
 
 # deficit credited to a backlogged session per sweep, in rows, before
@@ -50,7 +50,8 @@ class Ticket:
 
     __slots__ = (
         "session", "fn", "cost", "label", "charge", "prof", "token",
-        "submit_t", "start_t", "end_t", "value", "error", "_event",
+        "ctx", "submit_t", "start_t", "end_t", "value", "error",
+        "_event",
     )
 
     def __init__(self, session: Session, fn: Callable[[], object],
@@ -63,6 +64,10 @@ class Ticket:
         self.charge = max(int(charge), 0)
         self.prof = prof
         self.token = token  # faults.CancelToken or None
+        # trace context captured at SUBMIT: contextvars do not flow
+        # into the executor pool by themselves, so the worker
+        # re-activates this around the work (utils/tracing.py)
+        self.ctx = tracing.current()
         self.submit_t = time.perf_counter()
         self.start_t: Optional[float] = None
         self.end_t: Optional[float] = None
@@ -271,18 +276,30 @@ class FairScheduler:
                 "serving.queue_wait_ms", wait_s * 1e3,
                 bounds=metrics.SPAN_MS_BOUNDS,
             )
-            try:
-                if t.token is not None:
-                    t.token.check()  # cancelled while queued: never run
-                with executing(sess, t), profiler.bound_session(t.prof), \
-                        faults.scoped_token(t.token):
-                    with metrics.span(
-                        "serving." + t.label, session=sess.name
-                    ):
-                        t.value = t.fn()
-            except BaseException as e:
-                t.error = e
-                faults.note_error_class(e, "serving." + t.label)
+            with tracing.activate(t.ctx):
+                if flight.enabled():
+                    # the wait is only measurable at dequeue: record
+                    # the queue-wait span retroactively with backdated
+                    # timestamps (both events on THIS thread, so the
+                    # exporter's per-tid B/E pairing holds)
+                    tp = None if t.ctx is None else t.ctx.header
+                    flight.record("B", "serving.queue_wait", tp,
+                                  t_ns=int(t.submit_t * 1e9))
+                    flight.record("E", "serving.queue_wait",
+                                  t_ns=int(t.start_t * 1e9))
+                try:
+                    if t.token is not None:
+                        t.token.check()  # cancelled while queued
+                    with executing(sess, t), \
+                            profiler.bound_session(t.prof), \
+                            faults.scoped_token(t.token):
+                        with metrics.span(
+                            "serving." + t.label, session=sess.name
+                        ):
+                            t.value = t.fn()
+                except BaseException as e:
+                    t.error = e
+                    faults.note_error_class(e, "serving." + t.label)
             t.end_t = time.perf_counter()
             lat_s = t.end_t - t.submit_t
             sess.note_latency(lat_s)

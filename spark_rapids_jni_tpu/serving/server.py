@@ -23,6 +23,8 @@ Commands (frame header ``cmd``):
 * ``download``   resident id -> wire batch
 * ``free``       reclaim one resident table's HBM now
 * ``stats``      server + per-session statistics
+* ``trace``      live introspection: tail-sampled slow-request log +
+                 Prometheus-style text exposition of the metrics
 * ``bye``        detach this connection (last detach tears the session
                  down with full table reclamation — as does a crash)
 
@@ -60,6 +62,7 @@ from ..utils import (
     planstats,
     profiler,
     spill,
+    tracing,
 )
 from . import durable, frames
 from .scheduler import Busy, FairScheduler
@@ -419,8 +422,15 @@ class Server:
             while True:
                 header, payload = frames.recv_frame(sock)
                 cmd = header.get("cmd")
+                # trace-context establishment, once per request: a
+                # valid peer `traceparent` is joined (same trace id,
+                # fresh hop span id), no header mints a fresh context
+                # when the plane is on — every span/instant the
+                # handlers record below inherits it ambiently
+                ctx = tracing.ensure_context(header.get("traceparent"))
                 if cmd == "hello":
-                    sess = self._cmd_hello(sock, header, sess)
+                    with tracing.activate(ctx):
+                        sess = self._cmd_hello(sock, header, sess)
                     continue
                 if cmd == "bye":
                     # detach BEFORE the ack: the client treats the bye
@@ -439,13 +449,18 @@ class Server:
                         )
                     ))
                     continue
+                t0 = time.perf_counter()
+                err: Optional[BaseException] = None
                 try:
-                    self._dispatch(sock, sess, cmd, header, payload)
+                    with tracing.activate(ctx):
+                        self._dispatch(sock, sess, cmd, header, payload)
                 except (BrokenPipeError, ConnectionError, OSError):
                     raise
                 # srt: allow-broad-except(every failure becomes a typed error frame via _error_header; the client always gets an answer, never a hang)
                 except BaseException as e:
+                    err = e
                     frames.send_frame(sock, _error_header(e))
+                self._note_request(cmd, sess, ctx, t0, err)
         except (ConnectionError, OSError, frames.ProtocolError):
             # disconnect / crash mid-stream: the finally below detaches
             # and (on last detach) tears the session down with full
@@ -458,6 +473,26 @@ class Server:
                 self._conns.discard(sock)
             if sess is not None:
                 self._detach(sess, clean=clean)
+
+    @staticmethod
+    def _note_request(cmd, sess, ctx, t0: float,
+                      err: Optional[BaseException]) -> None:
+        """Feed one finished request into the tail-sampled slow-request
+        log behind the ``trace`` command. The span detail is passed as
+        a callable so the flight-tail walk only runs when the record
+        samples in (SLO breach or typed error — utils/tracing.py)."""
+        if ctx is None:
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        tracing.note_request(
+            "serving." + str(cmd), ms,
+            trace_id=ctx.trace_id,
+            session=sess.name,
+            error=_error_type(err) if err is not None else None,
+            spans=lambda: tracing.trace_span_records(
+                flight.tail_records(), ctx.trace_id
+            ),
+        )
 
     # -- session lifecycle ------------------------------------------------
     def _cmd_hello(self, sock, header, prev: Optional[Session]):
@@ -689,6 +724,10 @@ class Server:
             frames.send_frame(sock, {"ok": True, **resp})
         elif cmd == "stats":
             frames.send_frame(sock, {"ok": True, "stats": self.stats()})
+        elif cmd == "trace":
+            frames.send_frame(
+                sock, {"ok": True, "trace": self.trace_doc()}
+            )
         else:
             frames.send_frame(sock, _error_header(
                 frames.ProtocolError(f"unknown command {cmd!r}")
@@ -876,9 +915,12 @@ class Server:
             return e
         finally:
             scope.__exit__(None, None, None)
-        metas, buffers = frames.batches_to_parts(results)
-        sess.stats["bytes_out"] += sum(len(b) for b in buffers)
-        frames.send_frame(sock, {"ok": True, "results": metas}, buffers)
+        with metrics.span("serving.reply_serialize", session=sess.name):
+            metas, buffers = frames.batches_to_parts(results)
+            sess.stats["bytes_out"] += sum(len(b) for b in buffers)
+            frames.send_frame(
+                sock, {"ok": True, "results": metas}, buffers
+            )
         return None
 
     def _cmd_upload(self, sock, sess, header, payload) -> None:
@@ -1058,6 +1100,19 @@ class Server:
                 "restore": self._restore_doc,
             },
             "sessions": sessions,
+        }
+
+    def trace_doc(self) -> dict:
+        """The live introspection plane behind the ``trace`` command:
+        the tail-sampled slow-request log (slowest first, bounded to
+        TRACE_TOPK, span detail only for SLO breaches / typed errors)
+        plus a Prometheus-style text exposition of the metrics
+        snapshot — scrape-able without restarting the daemon."""
+        return {
+            "slo_ms": float(config.get_flag("TRACE_SLO_MS")),
+            "topk": int(config.get_flag("TRACE_TOPK")),
+            "slow_requests": tracing.slow_requests(),
+            "prometheus": metrics.prometheus_text(),
         }
 
 

@@ -31,7 +31,7 @@ from collections import OrderedDict, deque
 from typing import Dict, Optional, Tuple
 
 from .. import runtime_bridge as rb
-from ..utils import buckets, faults, hbm, lockcheck, metrics, spill
+from ..utils import buckets, faults, hbm, lockcheck, metrics, spill, tracing
 
 # Global reverse map rb_id -> (owning session, charged bytes): the spill
 # tier's residency events carry rb ids, and the owning session credits /
@@ -121,7 +121,19 @@ class Session:
         in-flight work when that is what blocks it. Raises the typed
         :class:`OverBudget` when the estimate can never fit (it exceeds
         the budget minus the session's resident tables), and
-        :class:`SessionClosed` if torn down while waiting."""
+        :class:`SessionClosed` if torn down while waiting. The whole
+        wait — spill rounds included — shows up in the request's trace
+        as a ``serving.admission`` span."""
+        tok = tracing.span_begin("serving.admission")
+        try:
+            got = self._admit(estimate, wait)
+        except BaseException as e:
+            tracing.span_end(tok, error=type(e).__name__)
+            raise
+        tracing.span_end(tok)
+        return got
+
+    def _admit(self, estimate: int, wait: bool) -> int:
         est = max(int(estimate), 0)
         faults.inject("hbm_admit")
         while True:

@@ -45,6 +45,7 @@ from . import lockcheck
 from . import log
 from . import metrics
 from . import profiler
+from . import tracing
 
 # default ladder: 1024, 2048, ... 2^23 (8.4M rows). The cap keeps the
 # fused join graphs the bucketed runners build below the TPU worker
@@ -408,12 +409,15 @@ def cached_jit(
         if log.enabled("DEBUG", "buckets"):
             log.log("DEBUG", "buckets", "compile_cache_miss", name=name,
                     size=size)
-        if profiler.session_active():
+        if profiler.session_active() or tracing.context_enabled():
             # jax.jit compiles lazily at the FIRST call: hand this
             # caller (the miss winner — the launch about to pay the
             # compile) a transient wrapper that times that call and
             # attributes it as compile_s to the active segment. The
             # cache keeps the raw jfn, so steady state is untouched.
+            # The wrapper also opens the trace-tagged `compile.jit`
+            # span, so a traced request shows its compile wall even
+            # without an active profile session.
             cur = profiler.time_first_call(cur, name)
     else:
         # another thread built the same key first; use theirs

@@ -435,6 +435,22 @@ _FLAGS = {
             "degradation ladder drops to fewer devices",
         ),
         Flag(
+            "TRACE_SLO_MS", 250.0,
+            _parse_nonneg_float("TRACE_SLO_MS"),
+            "slow-request SLO threshold in milliseconds for the trace "
+            "plane's tail sampling (utils/tracing.py): a finished "
+            "serving request at or over this duration — or one ending "
+            "in a typed error — keeps its full span detail in the "
+            "slow-request log; faster requests keep only the summary "
+            "row. 0 keeps detail for every request",
+        ),
+        Flag(
+            "TRACE_TOPK", 32,
+            _parse_positive_int("TRACE_TOPK"),
+            "slow-request log depth: the serving `trace` command "
+            "returns the top-K finished requests by duration",
+        ),
+        Flag(
             "LOCKCHECK", False, _as_bool,
             "dynamic lock-order detector (utils/lockcheck.py): on = "
             "every tracked package lock records per-thread held sets "

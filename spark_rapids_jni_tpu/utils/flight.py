@@ -171,7 +171,7 @@ def capacity() -> int:
     return len(_SLOTS) if _SLOTS is not None else 0
 
 
-def record(ph: str, name: str, arg=None) -> None:
+def record(ph: str, name: str, arg=None, t_ns: Optional[int] = None) -> None:
     """Record one event. THE hot path: a generation compare when
     disabled; a sequence fetch + timestamp + one list-slot store when
     on. No lock — ``next()`` on ``itertools.count`` and a list index
@@ -182,7 +182,12 @@ def record(ph: str, name: str, arg=None) -> None:
     from the CAPTURED slots list (capacity is always a power of two),
     never from a second global — pairing the list with a separately
     published mask could index out of bounds across a concurrent
-    resize."""
+    resize.
+
+    ``t_ns`` (perf_counter_ns timebase) backdates the event: the
+    scheduler records a queue-wait span AFTER the wait is known, with
+    the B stamped at submit time — both events land on the recording
+    thread so the Chrome exporter's per-tid pairing still holds."""
     if _GEN != config.generation():
         _refresh()
     slots = _SLOTS
@@ -191,7 +196,7 @@ def record(ph: str, name: str, arg=None) -> None:
     seq = next(_SEQ)
     slots[seq & (len(slots) - 1)] = (
         seq,
-        time.perf_counter_ns(),
+        time.perf_counter_ns() if t_ns is None else int(t_ns),
         threading.get_ident(),
         ph,
         name,

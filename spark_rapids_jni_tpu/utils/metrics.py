@@ -283,7 +283,11 @@ class _Span:
             self._trace_cm = tracing.trace_range(self.qualname)
             self._trace_cm.__enter__()
         if _GATE_FLIGHT:
-            flight.record("B", self.qualname)
+            # the ambient trace context rides the B arg (one contextvar
+            # read; None outside a traced request, and flight omits
+            # None args) — the join key tracequery/assign_trace_ids
+            # merge per-process dumps on
+            flight.record("B", self.qualname, tracing.current_traceparent())
         self._t0 = time.perf_counter()
         return self
 
@@ -414,6 +418,69 @@ def snapshot() -> dict:
                 for k, t in _SELF.items()
             },
         }
+
+
+def _prom_name(name: str) -> str:
+    """Registry name -> Prometheus metric name: ``srt_`` prefix, dots
+    and every other non-[a-zA-Z0-9_] character become underscores."""
+    return "srt_" + "".join(
+        c if (c.isalnum() or c == "_") else "_" for c in name
+    )
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Prometheus text-exposition rendering of the metrics snapshot —
+    the serving daemon's ``trace`` command returns this alongside the
+    slow-request log so one scrape-shaped payload carries the whole
+    registry. Counters/bytes render as ``counter``, gauges as ``gauge``
+    (plus a ``_high_water`` series), timers as a summary-shaped
+    ``_count``/``_total_seconds`` pair, histograms as a classic
+    cumulative ``_bucket{le=...}`` family."""
+    if snap is None:
+        snap = snapshot()
+    lines: List[str] = []
+
+    def emit(name: str, kind: str, series) -> None:
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value in series:
+            lines.append(f"{name}{labels} {value}")
+
+    for k in sorted(snap.get("counters", {})):
+        emit(_prom_name(k) + "_total", "counter",
+             [("", snap["counters"][k])])
+    for k in sorted(snap.get("bytes", {})):
+        emit(_prom_name(k) + "_bytes_total", "counter",
+             [("", snap["bytes"][k])])
+    for k in sorted(snap.get("gauges", {})):
+        g = snap["gauges"][k]
+        emit(_prom_name(k), "gauge", [("", g["value"])])
+        emit(_prom_name(k) + "_high_water", "gauge",
+             [("", g["high_water"])])
+    for k in sorted(snap.get("timers", {})):
+        t = snap["timers"][k]
+        base = _prom_name(k) + "_seconds"
+        emit(base + "_count", "counter", [("", t["count"])])
+        emit(base + "_total", "counter", [("", t["total_s"])])
+    for k in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][k]
+        base = _prom_name(k)
+        lines.append(f"# TYPE {base} histogram")
+        cum = 0
+        for bound, n in zip(h["bounds"], h["counts"]):
+            cum += n
+            lines.append(f'{base}_bucket{{le="{bound}"}} {cum}')
+        cum += h["counts"][len(h["bounds"])] if (
+            len(h["counts"]) > len(h["bounds"])
+        ) else 0
+        lines.append(f'{base}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{base}_count {h['count']}")
+        lines.append(f"{base}_sum {h['sum']}")
+    for k in sorted(snap.get("span_self", {})):
+        s = snap["span_self"][k]
+        base = _prom_name(k) + "_self_seconds"
+        emit(base + "_count", "counter", [("", s["count"])])
+        emit(base + "_total", "counter", [("", s["self_s"])])
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def reset() -> None:

@@ -64,6 +64,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from . import config
 from . import flight
 from . import lockcheck
+from . import tracing
 
 _HOST = socket.gethostname()
 
@@ -256,6 +257,10 @@ class ProfileSession:
         self.pid = os.getpid()
         self.host = _HOST
         self.epoch_ns = time.time_ns()
+        # the request trace this session observes (None outside any
+        # traced request): lets a tracequery join profile sessions to
+        # the flight-ring span tree by one key
+        self.trace_id = tracing.current_trace_id()
         self.batches = batches
         # the stats-store key parts + embedded static prediction
         # (planstats drift layer); None when the caller has none
@@ -309,6 +314,8 @@ class ProfileSession:
             "boundary": boundary,
             "unattributed_s": max(self.wall_s - covered, 0.0),
         }
+        if self.trace_id is not None:
+            doc["trace_id"] = self.trace_id
         if self.batches is not None:
             doc["batches"] = self.batches
         if self.schema is not None:
@@ -705,11 +712,17 @@ def time_first_call(fn, name: str):
         if done[0]:
             return fn(*args, **kwargs)
         done[0] = True
+        # the compile span: trace-tagged on the flight ring, so the
+        # request that paid the cache miss shows the trace+compile
+        # wall in its merged trace (profiler sits below metrics in the
+        # import graph — the tracing span pair is the sanctioned path)
+        tok = tracing.span_begin("compile.jit")
         t0 = time.perf_counter()
         try:
             return fn(*args, **kwargs)
         finally:
             note_compile(name, time.perf_counter() - t0)
+            tracing.span_end(tok)
 
     wrapper.__name__ = getattr(fn, "__name__", name)
     return wrapper

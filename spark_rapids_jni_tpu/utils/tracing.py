@@ -1,4 +1,4 @@
-"""Profiler range annotation — the NVTX analog (SURVEY.md §5.1).
+"""Profiler ranges + the request trace-context plane — the NVTX analog.
 
 The reference toggles NVTX ranges from Java via the
 ``ai.rapids.cudf.nvtx.enabled`` system property (pom.xml:85,200-201); the
@@ -9,16 +9,56 @@ Perfetto/XProf traces captured with ``jax.profiler.trace``.
 Enabled via the ``SPARK_RAPIDS_TPU_TRACE`` flag (utils/config.py); when
 off, ``trace_range`` is a no-op with near-zero overhead, matching the
 reference's ship-it-disabled default.
+
+On top of the ranges, this module owns the **trace context** (ISSUE 18
+tentpole): a per-request ``trace_id``/``span_id`` pair held in a
+``contextvars`` ambient context, carried across the serving wire as a
+W3C-traceparent-style header, and stamped onto every span the metrics
+plane records into the flight ring — the one join key the four
+telemetry silos (metrics registry, flight ring, query profiler,
+planstats store) previously lacked. Rules of the plane:
+
+* the context is AMBIENT: ``activate(ctx)`` binds it on the current
+  thread/task; plain function calls and same-thread retries (lineage
+  replay, the mesh degradation ladder) inherit it for free — a replay
+  must never mint a fresh trace;
+* contexts do NOT flow into pool threads by themselves: the scheduler
+  captures the submitter's context into the ticket and the pipeline
+  captures it at ``Pending`` construction, re-activating around the
+  work body;
+* span records reuse the flight ring's lock-cheap event path — the
+  traceparent rides as the ``arg`` of the span's ``"B"`` event, so the
+  always-on cost stays at the ring's ~100ns/event and the disabled
+  path at one cached gate check (``span_begin``/``span_end``, asserted
+  within 2x of disabled ``flight.record()`` in tests);
+* instants recorded by code that never heard of tracing
+  (``mesh.replay``, ``shuffle.giveup``) are attributed after the fact
+  by :func:`assign_trace_ids`: per thread, every event inside a
+  trace-tagged span belongs to that span's trace.
+
+The tail-sampled slow-request log (:func:`note_request` /
+:func:`slow_requests`) backs the serving daemon's ``trace`` command:
+top-K finished requests by duration, with full span detail kept only
+for requests that breached ``SPARK_RAPIDS_TPU_TRACE_SLO_MS`` or ended
+in a typed error. ``tools/tracequery.py`` merges per-process flight
+dumps by trace id on top of :func:`assign_trace_ids`.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import heapq
+import itertools
 import os
+import re
 import sys
-from typing import Iterator, Optional
+import time
+from typing import Iterator, List, Optional
 
 from . import config
+from . import flight
+from . import lockcheck
 
 
 def tracing_enabled() -> bool:
@@ -53,6 +93,329 @@ def annotate(name: Optional[str] = None):
         return inner
 
     return wrap
+
+
+# ---------------------------------------------------------------------------
+# Trace context — per-request identity threaded through every layer
+# ---------------------------------------------------------------------------
+
+
+class TraceContext:
+    """One request's identity: ``trace_id`` (32 hex chars, shared by
+    every span of the request across threads and processes) plus
+    ``span_id`` (16 hex chars, this hop). ``header`` is the precomputed
+    W3C-traceparent wire form (``00-<trace_id>-<span_id>-01``) so the
+    hot tagging path is an attribute read, not a format call."""
+
+    __slots__ = ("trace_id", "span_id", "header")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.header = f"00-{trace_id}-{span_id}-01"
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.header})"
+
+
+_CTX: "contextvars.ContextVar[Optional[TraceContext]]" = (
+    contextvars.ContextVar("srt_trace_ctx", default=None)
+)
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_context(trace_id: Optional[str] = None) -> TraceContext:
+    """Mint a context: a fresh trace when ``trace_id`` is None, else a
+    new hop span under the given trace. THE id mint — srt-check SRT011
+    flags serving handlers that hand-roll trace ids instead."""
+    return TraceContext(trace_id or new_trace_id(), new_span_id())
+
+
+def child_context(ctx: TraceContext) -> TraceContext:
+    """A new hop under ``ctx``'s trace (the receiver side of a wire
+    hop: same trace_id, fresh span_id)."""
+    return new_context(ctx.trace_id)
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    """Wire encoding for hello/command headers (serving/frames.py)."""
+    return ctx.header
+
+
+def parse_traceparent(value) -> Optional[TraceContext]:
+    """Wire header -> :class:`TraceContext`. Anything malformed (wrong
+    field widths, non-hex, all-zero ids, the reserved ``ff`` version)
+    degrades to None — a bad peer header must never fail the request
+    it arrived on. Future versions with the same field shape are
+    accepted, per the W3C forward-compatibility rule."""
+    if not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, _flags = m.groups()
+    if version == "ff" or set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return TraceContext(trace_id, span_id)
+
+
+def current() -> Optional[TraceContext]:
+    """The ambient context (None outside any traced request)."""
+    return _CTX.get()
+
+
+def current_traceparent() -> Optional[str]:
+    """Wire/tag form of the ambient context — THE hot tagging path
+    (one contextvar read + one attribute access), called once per span
+    begin by metrics._Span."""
+    ctx = _CTX.get()
+    return None if ctx is None else ctx.header
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _CTX.get()
+    return None if ctx is None else ctx.trace_id
+
+
+class activate:
+    """Bind ``ctx`` as the ambient trace context for the scope's
+    duration (``None`` = no-op scope). Restores the previous binding on
+    exit, exception path included. This is how captured contexts cross
+    thread hops: scheduler workers and pipeline workers re-activate the
+    submitter's context around each work item."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self) -> Optional[TraceContext]:
+        if self._ctx is not None:
+            self._token = _CTX.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _CTX.reset(self._token)
+            self._token = None
+        return False
+
+
+# cached gate (the metrics._GATE_GEN discipline): the context plane is
+# live when the flight ring records (trace spans are only observable
+# through it) or the TRACE flag is on
+_CTX_GEN = -1
+_CTX_ON = False
+
+
+def context_enabled() -> bool:
+    """True when serving should mint/propagate trace contexts (cheap
+    cached gate, invalidated by config.generation())."""
+    global _CTX_GEN, _CTX_ON
+    if _CTX_GEN != config.generation():
+        _CTX_ON = bool(config.get_flag("TRACE")) or flight.enabled()
+        _CTX_GEN = config.generation()
+    return _CTX_ON
+
+
+def ensure_context(traceparent=None) -> Optional[TraceContext]:
+    """Server-side context establishment for ONE incoming request: a
+    valid peer header joins that trace with a fresh hop span id (a
+    retried or replayed request therefore keeps its original trace —
+    replay must never mint a new one), no header mints a fresh context
+    when the plane is on, and a disabled plane yields None."""
+    ctx = parse_traceparent(traceparent)
+    if ctx is not None:
+        return child_context(ctx)
+    if context_enabled():
+        return new_context()
+    return None
+
+
+def span_begin(name: str):
+    """Trace-layer span open: one trace-tagged ``"B"`` event on the
+    flight ring (the traceparent rides as the event arg). Returns the
+    token ``span_end`` closes; None when the ring is off — the
+    disabled path is one cached gate check, the flight ``record()``
+    cost class (asserted within 2x of disabled record() in tests).
+    Callers below metrics in the import graph (profiler) use this
+    pair; everything else gets the same tagging through
+    ``metrics.span``."""
+    if not flight.enabled():
+        return None
+    ctx = _CTX.get()
+    flight.record("B", name, None if ctx is None else ctx.header)
+    return name
+
+
+def span_end(token, error: Optional[str] = None) -> None:
+    """Close a :func:`span_begin` span (no-op on a None token)."""
+    if token is not None:
+        flight.record("E", token, error)
+
+
+# ---------------------------------------------------------------------------
+# tail-sampled slow-request log — the serving `trace` command's data
+# ---------------------------------------------------------------------------
+
+_SLOW_LOCK = lockcheck.make_lock("tracing.slow")
+_SLOW: List[tuple] = []  # min-heap of (ms, seq, record)
+_SLOW_SEQ = itertools.count()
+
+
+def note_request(label: str, duration_ms: float, *,
+                 trace_id: Optional[str] = None,
+                 session: Optional[str] = None,
+                 error: Optional[str] = None,
+                 spans=None) -> None:
+    """Feed one FINISHED request into the slow-request log: top-K by
+    duration (``SPARK_RAPIDS_TPU_TRACE_TOPK``), tail-sampled — the
+    ``spans`` detail is kept only when the request breached the SLO
+    threshold (``SPARK_RAPIDS_TPU_TRACE_SLO_MS``) or ended in a typed
+    error, so the always-on cost stays one cached gate plus a bounded
+    heap push. ``spans`` may be a callable evaluated only when the
+    record samples in (pulling span detail out of the flight tail is
+    itself not free)."""
+    if not context_enabled():
+        return
+    slo_ms = float(config.get_flag("TRACE_SLO_MS"))
+    topk = int(config.get_flag("TRACE_TOPK"))
+    ms = float(duration_ms)
+    rec: dict = {"label": str(label), "ms": round(ms, 3),
+                 "t_s": time.time()}
+    if trace_id:
+        rec["trace_id"] = trace_id
+    if session:
+        rec["session"] = session
+    if error:
+        rec["error"] = str(error)
+    if error or ms >= slo_ms:
+        detail = spans() if callable(spans) else spans
+        if detail:
+            rec["spans"] = detail
+    with _SLOW_LOCK:
+        heapq.heappush(_SLOW, (ms, next(_SLOW_SEQ), rec))
+        while len(_SLOW) > topk:
+            heapq.heappop(_SLOW)
+
+
+def slow_requests() -> List[dict]:
+    """The slow-request log, slowest first (bounded to TRACE_TOPK)."""
+    with _SLOW_LOCK:
+        items = sorted(_SLOW, key=lambda t: (t[0], t[1]), reverse=True)
+    return [dict(rec) for _, _, rec in items]
+
+
+def reset_requests() -> None:
+    """Drop the slow-request log (test isolation; serving restarts)."""
+    with _SLOW_LOCK:
+        del _SLOW[:]
+
+
+# ---------------------------------------------------------------------------
+# trace attribution over flight events — the tracequery substrate
+# ---------------------------------------------------------------------------
+
+
+def assign_trace_ids(events) -> List[dict]:
+    """Annotate flight-event dicts with the trace that owns them.
+
+    Per thread, walked in seq order: a ``"B"`` whose arg parses as a
+    traceparent opens a trace scope; every event recorded while a scope
+    is open inherits the innermost scope's trace id — so instants
+    emitted by code that never heard of tracing (``mesh.replay``,
+    ``shuffle.giveup``, compile-cache misses) land in the right
+    request. Returns copies with a ``trace_id`` key added where one
+    applies; events outside any scope pass through untagged. Tolerates
+    older/partial dumps (missing seq/tid/arg keys, non-dict rows)."""
+    out: List[dict] = []
+    stacks: dict = {}  # tid -> [(name, trace_id-or-None), ...]
+    evs = [e for e in events if isinstance(e, dict)]
+    for e in sorted(evs, key=lambda e: e.get("seq", 0)):
+        tid = e.get("tid", 0)
+        stack = stacks.setdefault(tid, [])
+        ph, name = e.get("ph"), e.get("name", "?")
+        e = dict(e)
+        if ph == "B":
+            ctx = parse_traceparent(e.get("arg"))
+            trace = ctx.trace_id if ctx is not None else (
+                stack[-1][1] if stack else None
+            )
+            stack.append((name, trace))
+        elif ph == "E":
+            trace = stack[-1][1] if stack else None
+            # same top-down match as the Chrome exporter: an E closes
+            # the innermost open span with its name
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == name:
+                    trace = stack.pop(i)[1]
+                    break
+        else:
+            trace = stack[-1][1] if stack else None
+        if trace:
+            e["trace_id"] = trace
+        out.append(e)
+    return out
+
+
+def trace_span_records(events, trace_id: str) -> List[dict]:
+    """Flattened span/instant records of ONE trace: the compact span
+    detail the slow-request log samples and tests assert on. Begin/end
+    pairs are matched per thread into ``{name, tid, t_ns, dur_ms}``
+    records (plus ``error`` from the E arg); unmatched opens — the
+    kill-mid-stage case — come back with ``unterminated: true``;
+    instants keep their payload under ``arg``."""
+    spans: List[dict] = []
+    open_: dict = {}  # tid -> stack of B events
+    for e in assign_trace_ids(events):
+        if e.get("trace_id") != trace_id:
+            continue
+        ph = e.get("ph")
+        tid = e.get("tid", 0)
+        if ph == "B":
+            open_.setdefault(tid, []).append(e)
+        elif ph == "E":
+            stack = open_.get(tid) or []
+            begin = None
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i].get("name") == e.get("name"):
+                    begin = stack.pop(i)
+                    break
+            rec: dict = {"name": e.get("name", "?"), "tid": tid}
+            if begin is not None:
+                rec["t_ns"] = begin.get("t_ns", 0)
+                rec["dur_ms"] = round(
+                    (e.get("t_ns", 0) - begin.get("t_ns", 0)) / 1e6, 3
+                )
+            if e.get("arg") is not None:
+                rec["error"] = e["arg"]
+            spans.append(rec)
+        elif ph in ("I", "C"):
+            rec = {"name": e.get("name", "?"), "tid": tid,
+                   "t_ns": e.get("t_ns", 0), "instant": True}
+            if e.get("arg") is not None:
+                rec["arg"] = e["arg"]
+            spans.append(rec)
+    for tid, stack in open_.items():
+        for b in stack:
+            spans.append({
+                "name": b.get("name", "?"), "tid": tid,
+                "t_ns": b.get("t_ns", 0), "unterminated": True,
+            })
+    spans.sort(key=lambda r: r.get("t_ns", 0))
+    return spans
 
 
 # ---------------------------------------------------------------------------
@@ -109,11 +472,16 @@ def to_chrome_trace(
     alone), and ``t0_ns`` pins the timeline origin so several dumps
     share one clock (``merge_chrome_traces``).
     """
-    evs = sorted(events, key=lambda e: e.get("seq", 0))
+    # tolerate older/partial flight formats: non-dict rows are dropped,
+    # missing keys degrade (tid 0, t_ns 0, unknown ph -> instant)
+    evs = sorted(
+        (e for e in events if isinstance(e, dict)),
+        key=lambda e: e.get("seq", 0),
+    )
     if not evs:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    t0 = min(e["t_ns"] for e in evs) if t0_ns is None else t0_ns
-    t_end = max(e["t_ns"] for e in evs)
+    t0 = min(e.get("t_ns", 0) for e in evs) if t0_ns is None else t0_ns
+    t_end = max(e.get("t_ns", 0) for e in evs)
 
     def us(t_ns: int) -> float:
         return round((t_ns - t0) / 1e3, 3)
@@ -122,11 +490,13 @@ def to_chrome_trace(
     tids: list = []
     open_spans: dict = {}  # tid -> stack of B events
     for e in evs:
-        tid = e["tid"]
+        tid = e.get("tid", 0)
         if tid not in open_spans:
             open_spans[tid] = []
             tids.append(tid)
-        ph, name = e["ph"], e["name"]
+        ph, name = e.get("ph", "I"), e.get("name", "?")
+        if "t_ns" not in e:
+            e = dict(e, t_ns=t0)
         if ph == "B":
             open_spans[tid].append(e)
         elif ph == "E":
@@ -149,6 +519,9 @@ def to_chrome_trace(
             args = {}
             if e.get("arg") is not None:
                 args["error"] = e["arg"]
+            if begin is not None and begin.get("arg") is not None:
+                # a trace-tagged span: the traceparent rode the B arg
+                args["traceparent"] = begin["arg"]
             if begin is None:
                 x["ts"] = us(t0)
                 x["dur"] = us(e["t_ns"])
@@ -160,14 +533,31 @@ def to_chrome_trace(
                 x["args"] = args
             out.append(x)
         elif ph == "C":
-            out.append({
-                "name": name,
-                "ph": "C",
-                "pid": pid,
-                "tid": tid,
-                "ts": us(e["t_ns"]),
-                "args": {"value": e.get("arg", 0)},
-            })
+            arg = e.get("arg", 0)
+            if isinstance(arg, (int, float)):
+                out.append({
+                    "name": name,
+                    "ph": "C",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": us(e["t_ns"]),
+                    "args": {"value": arg},
+                })
+            else:
+                # a counter sample with a non-numeric payload would
+                # break the Chrome counter track (and used to be
+                # dropped silently): keep it visible as an instant
+                # carrying the string form
+                out.append({
+                    "name": name,
+                    "cat": _chrome_cat(name),
+                    "ph": "i",
+                    "s": "t",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": us(e["t_ns"]),
+                    "args": {"arg": str(arg)},
+                })
         else:  # "I" and anything future-shaped degrades to an instant
             ev = {
                 "name": name,
@@ -184,6 +574,9 @@ def to_chrome_trace(
     # crash case: spans still open at the end of the tail run to t_end
     for tid, stack in open_spans.items():
         for begin in stack:
+            args = {"unterminated": True}
+            if begin.get("arg") is not None:
+                args["traceparent"] = begin["arg"]
             out.append({
                 "name": begin["name"],
                 "cat": _chrome_cat(begin["name"]),
@@ -192,7 +585,7 @@ def to_chrome_trace(
                 "tid": tid,
                 "ts": us(begin["t_ns"]),
                 "dur": round((t_end - begin["t_ns"]) / 1e3, 3),
-                "args": {"unterminated": True},
+                "args": args,
             })
     meta = [{
         "name": "process_name",

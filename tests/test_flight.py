@@ -416,6 +416,66 @@ class TestChromeExport:
             "traceEvents": [], "displayTimeUnit": "ms",
         }
 
+    def test_traceparent_b_arg_lands_in_x_args(self):
+        # the trace layer rides the span's B arg (utils/tracing.py):
+        # the exporter must surface it as args.traceparent on the X —
+        # including the unterminated crash shape
+        tp = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+        events = [
+            {"seq": 0, "t_ns": 1_000, "tid": 1, "ph": "B",
+             "name": "serving.stream", "arg": tp},
+            {"seq": 1, "t_ns": 2_000, "tid": 1, "ph": "E",
+             "name": "serving.stream"},
+            {"seq": 2, "t_ns": 3_000, "tid": 1, "ph": "B",
+             "name": "mesh.stage", "arg": tp},
+        ]
+        xs = {
+            e["name"]: e
+            for e in tracing.to_chrome_trace(events)["traceEvents"]
+            if e["ph"] == "X"
+        }
+        assert xs["serving.stream"]["args"]["traceparent"] == tp
+        unterm = xs["mesh.stage"]
+        assert unterm["args"]["unterminated"] is True
+        assert unterm["args"]["traceparent"] == tp
+
+    def test_non_numeric_counter_degrades_to_instant(self):
+        # a C sample with a string payload would break the Chrome
+        # counter track — it must come back as a visible instant
+        events = [
+            {"seq": 0, "t_ns": 1_000, "tid": 1, "ph": "C",
+             "name": "resident.live", "arg": "3 tables"},
+            {"seq": 1, "t_ns": 2_000, "tid": 1, "ph": "C",
+             "name": "resident.live", "arg": 3},
+        ]
+        out = tracing.to_chrome_trace(events)["traceEvents"]
+        instants = [e for e in out if e["ph"] == "i"]
+        counters = [e for e in out if e["ph"] == "C"]
+        assert len(instants) == 1
+        assert instants[0]["args"]["arg"] == "3 tables"
+        assert len(counters) == 1
+        assert counters[0]["args"]["value"] == 3
+
+    def test_older_partial_formats_tolerated(self):
+        # non-dict rows and missing seq/tid/t_ns keys (older dumps)
+        # must degrade, not crash the postmortem tool
+        events = [
+            "junk-row",
+            None,
+            {"ph": "I", "name": "legacy.instant"},
+            {"seq": 1, "t_ns": 2_000, "tid": 1, "ph": "B",
+             "name": "legacy.span"},
+            {"seq": 2, "t_ns": 3_000, "tid": 1, "ph": "E",
+             "name": "legacy.span"},
+        ]
+        out = tracing.to_chrome_trace(events)["traceEvents"]
+        assert [e["name"] for e in out if e["ph"] == "i"] == [
+            "legacy.instant"
+        ]
+        assert [e["name"] for e in out if e["ph"] == "X"] == [
+            "legacy.span"
+        ]
+
     def test_live_dispatch_covers_three_subsystems(self):
         """Acceptance: a wire dispatch with flight on yields spans from
         >= 3 subsystems (dispatch, wire serde, bucketed) plus a counter

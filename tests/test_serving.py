@@ -28,7 +28,9 @@ from spark_rapids_jni_tpu import runtime_bridge as rb
 from spark_rapids_jni_tpu import serving
 from spark_rapids_jni_tpu.serving import scheduler as sched_mod
 from spark_rapids_jni_tpu.serving import session as session_mod
-from spark_rapids_jni_tpu.utils import buckets, config, metrics, profiler
+from spark_rapids_jni_tpu.utils import (
+    buckets, config, flight, metrics, profiler, tracing,
+)
 
 I64 = int(dt.TypeId.INT64)
 B8 = int(dt.TypeId.BOOL8)
@@ -49,9 +51,12 @@ def _clean_flags():
     pipeline.drain()
     for name in ("PIPELINE", "BUCKETS", "METRICS", "HBM_BUDGET_GB",
                  "SERVE_MAX_SESSIONS", "SERVE_QUEUE_DEPTH",
-                 "SERVE_SESSION_HBM_FRACTION", "SERVE_PORT"):
+                 "SERVE_SESSION_HBM_FRACTION", "SERVE_PORT",
+                 "FLIGHT", "TRACE", "TRACE_SLO_MS", "TRACE_TOPK"):
         config.clear_flag(name)
     pipeline.depth()  # flag now off: tears the worker pool down
+    flight.reset()
+    tracing.reset_requests()
 
 
 def _string_wire(strings):
@@ -681,4 +686,76 @@ def test_malformed_plan_frame_is_typed_bad_request():
             # connection still usable after all three rejections
             got = c.stream(CHAIN, [_batch(32)])
             assert len(got) == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: the live introspection plane — the `trace` command
+# ---------------------------------------------------------------------------
+
+
+def test_trace_command_returns_slow_request_log_and_prometheus():
+    """The daemon's ``trace`` command: a traced stream shows up in the
+    tail-sampled slow-request log under the CLIENT's trace id (the
+    server joins the wire traceparent, it never re-mints), with span
+    detail sampled in because TRACE_SLO_MS=0 makes every request an
+    SLO breach, alongside a Prometheus exposition of the registry."""
+    config.set_flag("FLIGHT", True)
+    config.set_flag("METRICS", True)
+    config.set_flag("TRACE_SLO_MS", "0")
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="traced") as c:
+            ctx = tracing.new_context()
+            with tracing.activate(ctx):
+                got = c.stream(CHAIN, [_batch(512)])
+            assert len(got) == 1
+            doc = c.trace()
+    assert doc["slo_ms"] == 0.0
+    assert doc["topk"] == int(config.get_flag("TRACE_TOPK"))
+    mine = [r for r in doc["slow_requests"]
+            if r.get("trace_id") == ctx.trace_id]
+    assert mine, (ctx.trace_id, doc["slow_requests"])
+    rec = mine[0]
+    assert rec["label"] == "serving.stream"
+    assert rec["session"] == "traced"
+    assert rec["ms"] >= 0.0
+    # span detail sampled in (SLO breach): server-side spans are
+    # attributed to the CLIENT's trace id across the wire hop
+    names = {s["name"] for s in rec["spans"]}
+    assert "serving.queue_wait" in names, names
+    assert any(n.startswith("serving.stream") for n in names), names
+    prom = doc["prometheus"]
+    assert "# TYPE" in prom and "srt_serving_requests_total" in prom
+
+
+def test_trace_command_tail_sampling_drops_fast_request_detail():
+    # default SLO (250ms): a fast healthy stream is LOGGED but its
+    # span detail is not kept — that is the tail-sampling contract
+    config.set_flag("FLIGHT", True)
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="fast") as c:
+            ctx = tracing.new_context()
+            with tracing.activate(ctx):
+                c.stream(CHAIN, [_batch(64)])
+            doc = c.trace()
+    mine = [r for r in doc["slow_requests"]
+            if r.get("trace_id") == ctx.trace_id
+            and r["label"] == "serving.stream"]
+    assert mine and all("spans" not in r for r in mine), mine
+    assert isinstance(doc["prometheus"], str)
+
+
+def test_untraced_client_still_lands_in_slow_request_log():
+    # no client context: the server MINTS one per request (the plane is
+    # on because the flight ring records) — requests are never invisible
+    config.set_flag("FLIGHT", True)
+    with serving.serve() as srv:
+        with serving.Client(srv.port, name="plain") as c:
+            c.stream(CHAIN, [_batch(64)])
+            doc = c.trace()
+    streams = [r for r in doc["slow_requests"]
+               if r["label"] == "serving.stream"
+               and r.get("session") == "plain"]
+    assert streams and all(
+        len(r.get("trace_id", "")) == 32 for r in streams
+    ), streams
     assert rb.resident_table_count() == 0

@@ -1,0 +1,546 @@
+"""Trace-context plane (ISSUE 18 tentpole): per-request identity.
+
+The contract under test: ``new_context`` is THE id mint (32-hex trace
+id + 16-hex hop span id, W3C-traceparent wire header); ``activate``
+binds the ambient context with exception-safe restore; malformed peer
+headers degrade to None, never to a failed request; scheduler tickets
+and pipeline pendings capture the submitter's context at submit time
+and re-activate it on the worker — and a lineage replay (pipeline sync
+replay, the mesh degradation ladder) stays in the ORIGINAL request's
+trace, never minting a fresh id. Instants emitted by code that never
+heard of tracing (``mesh.replay``, ``mesh.degraded``,
+``shuffle.giveup``) are attributed to the enclosing trace-tagged span
+by ``assign_trace_ids``. The tail-sampled slow-request log keeps span
+detail only for SLO breaches and typed errors, bounded to TRACE_TOPK.
+Acceptance: the disabled ``span_begin``/``span_end`` pair stays within
+2x of one disabled ``flight.record()`` call.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from spark_rapids_jni_tpu import pipeline
+from spark_rapids_jni_tpu import parallel
+from spark_rapids_jni_tpu.serving import scheduler as sched_mod
+from spark_rapids_jni_tpu.serving import session as session_mod
+from spark_rapids_jni_tpu.utils import config, faults, flight, metrics
+from spark_rapids_jni_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolated(monkeypatch):
+    for env in ("SPARK_RAPIDS_TPU_FLIGHT", "SPARK_RAPIDS_TPU_FLIGHT_DUMP",
+                "SPARK_RAPIDS_TPU_METRICS", "SPARK_RAPIDS_TPU_TRACE"):
+        monkeypatch.delenv(env, raising=False)
+    flight.reset()
+    metrics.reset()
+    tracing.reset_requests()
+    yield
+    pipeline.drain()
+    for f in ("FLIGHT", "FLIGHT_DUMP", "METRICS", "TRACE",
+              "TRACE_SLO_MS", "TRACE_TOPK", "PIPELINE", "FAULTS",
+              "RETRY_MAX"):
+        config.clear_flag(f)
+    pipeline.depth()  # PIPELINE now off: tears the worker pool down
+    flight.reset()
+    metrics.reset()
+    tracing.reset_requests()
+
+
+# ---------------------------------------------------------------------------
+# context identity + the ambient binding
+# ---------------------------------------------------------------------------
+
+
+class TestContext:
+    def test_mint_shapes(self):
+        ctx = tracing.new_context()
+        assert len(ctx.trace_id) == 32
+        assert len(ctx.span_id) == 16
+        int(ctx.trace_id, 16)
+        int(ctx.span_id, 16)
+        assert ctx.header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+    def test_mints_are_distinct(self):
+        ids = {tracing.new_context().trace_id for _ in range(32)}
+        assert len(ids) == 32
+
+    def test_child_keeps_trace_changes_span(self):
+        parent = tracing.new_context()
+        child = tracing.child_context(parent)
+        assert child.trace_id == parent.trace_id
+        assert child.span_id != parent.span_id
+
+    def test_ambient_activate_restores(self):
+        assert tracing.current() is None
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            assert tracing.current() is ctx
+            assert tracing.current_traceparent() == ctx.header
+            assert tracing.current_trace_id() == ctx.trace_id
+            inner = tracing.new_context()
+            with tracing.activate(inner):
+                assert tracing.current() is inner
+            assert tracing.current() is ctx
+        assert tracing.current() is None
+        assert tracing.current_traceparent() is None
+        assert tracing.current_trace_id() is None
+
+    def test_activate_none_is_noop(self):
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            with tracing.activate(None):
+                assert tracing.current() is ctx
+
+    def test_activate_restores_on_exception(self):
+        ctx = tracing.new_context()
+        with pytest.raises(RuntimeError):
+            with tracing.activate(ctx):
+                raise RuntimeError("boom")
+        assert tracing.current() is None
+
+
+class TestTraceparentWire:
+    def test_roundtrip(self):
+        ctx = tracing.new_context()
+        back = tracing.parse_traceparent(tracing.format_traceparent(ctx))
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+
+    def test_case_and_whitespace_tolerated(self):
+        ctx = tracing.new_context()
+        back = tracing.parse_traceparent("  " + ctx.header.upper() + " ")
+        assert back is not None and back.trace_id == ctx.trace_id
+
+    def test_future_version_accepted(self):
+        ctx = tracing.new_context()
+        assert tracing.parse_traceparent("01" + ctx.header[2:]) is not None
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        42,
+        "",
+        "garbage",
+        "00-abc-def-01",                                  # wrong widths
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",        # non-hex
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",        # zero trace
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",        # zero span
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",        # reserved ver
+        "00-" + "1" * 32 + "-" + "2" * 16,                # missing flags
+    ])
+    def test_malformed_degrades_to_none(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+
+class TestEnsureContext:
+    def test_valid_header_joins_trace(self):
+        peer = tracing.new_context()
+        ctx = tracing.ensure_context(peer.header)
+        assert ctx is not None
+        assert ctx.trace_id == peer.trace_id
+        assert ctx.span_id != peer.span_id  # fresh hop
+
+    def test_disabled_plane_no_header_yields_none(self):
+        assert not tracing.context_enabled()
+        assert tracing.ensure_context(None) is None
+
+    def test_trace_flag_mints(self):
+        config.set_flag("TRACE", True)
+        assert tracing.context_enabled()
+        ctx = tracing.ensure_context(None)
+        assert ctx is not None and len(ctx.trace_id) == 32
+
+    def test_flight_ring_enables_plane(self):
+        config.set_flag("FLIGHT", True)
+        assert tracing.context_enabled()
+        assert tracing.ensure_context(None) is not None
+
+    def test_malformed_header_mints_fresh(self):
+        config.set_flag("TRACE", True)
+        ctx = tracing.ensure_context("00-zzz-bad-01")
+        assert ctx is not None and len(ctx.trace_id) == 32
+
+    def test_gate_follows_config_generation(self):
+        assert not tracing.context_enabled()
+        config.set_flag("TRACE", True)
+        assert tracing.context_enabled()
+        config.clear_flag("TRACE")
+        assert not tracing.context_enabled()
+
+
+# ---------------------------------------------------------------------------
+# span records on the flight ring + post-hoc trace attribution
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_disabled_ring_yields_none_token(self):
+        tok = tracing.span_begin("plan.segment")
+        assert tok is None
+        tracing.span_end(tok)  # no-op, no crash
+        assert flight.tail_records() == []
+
+    def test_span_carries_traceparent_as_b_arg(self):
+        config.set_flag("FLIGHT", True)
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            tok = tracing.span_begin("plan.segment")
+            tracing.span_end(tok)
+        evs = flight.tail_records()
+        begins = [e for e in evs if e["ph"] == "B"]
+        ends = [e for e in evs if e["ph"] == "E"]
+        assert begins and begins[0]["arg"] == ctx.header
+        assert ends and ends[0]["name"] == "plan.segment"
+
+    def test_span_without_ambient_context_untagged(self):
+        config.set_flag("FLIGHT", True)
+        tok = tracing.span_begin("plan.segment")
+        tracing.span_end(tok)
+        begins = [e for e in flight.tail_records() if e["ph"] == "B"]
+        assert begins and begins[0].get("arg") is None
+
+    def test_span_end_error_rides_e_arg(self):
+        config.set_flag("FLIGHT", True)
+        tok = tracing.span_begin("mesh.stage")
+        tracing.span_end(tok, error="Degraded")
+        ends = [e for e in flight.tail_records() if e["ph"] == "E"]
+        assert ends and ends[0]["arg"] == "Degraded"
+
+    def test_assign_trace_ids_scope_inheritance(self):
+        config.set_flag("FLIGHT", True)
+        flight.record("I", "before.scope")  # outside: stays untagged
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            tok = tracing.span_begin("serving.stream")
+            flight.record("I", "mesh.replay", "stage-0")
+            inner = tracing.span_begin("plan.segment")
+            flight.record("I", "compile_cache.miss", "k")
+            tracing.span_end(inner)
+            tracing.span_end(tok)
+        flight.record("I", "after.scope")
+        tagged = tracing.assign_trace_ids(flight.tail_records())
+        by_name = {e["name"]: e for e in tagged if e["ph"] == "I"}
+        assert by_name["mesh.replay"]["trace_id"] == ctx.trace_id
+        assert by_name["compile_cache.miss"]["trace_id"] == ctx.trace_id
+        assert "trace_id" not in by_name["before.scope"]
+        assert "trace_id" not in by_name["after.scope"]
+
+    def test_assign_trace_ids_per_tid_isolation(self):
+        # synthetic events: two threads, one traced, one not — the
+        # per-tid stack walk must not leak the scope across tids
+        ctx = tracing.new_context()
+        events = [
+            {"seq": 0, "t_ns": 10, "tid": 1, "ph": "B",
+             "name": "serving.stream", "arg": ctx.header},
+            {"seq": 1, "t_ns": 20, "tid": 2, "ph": "I",
+             "name": "other.thread", "arg": None},
+            {"seq": 2, "t_ns": 30, "tid": 1, "ph": "I",
+             "name": "mesh.replay", "arg": "s"},
+            {"seq": 3, "t_ns": 40, "tid": 1, "ph": "E",
+             "name": "serving.stream", "arg": None},
+            {"seq": 4, "t_ns": 50, "tid": 1, "ph": "I",
+             "name": "after", "arg": None},
+            "not-a-dict",  # older/partial dumps pass through the walk
+        ]
+        tagged = {
+            e["name"]: e for e in tracing.assign_trace_ids(events)
+        }
+        assert tagged["mesh.replay"]["trace_id"] == ctx.trace_id
+        assert tagged["serving.stream"]["trace_id"] == ctx.trace_id
+        assert "trace_id" not in tagged["other.thread"]
+        assert "trace_id" not in tagged["after"]
+
+    def test_trace_span_records_shapes(self):
+        ctx = tracing.new_context()
+        events = [
+            {"seq": 0, "t_ns": 1_000_000, "tid": 1, "ph": "B",
+             "name": "serving.stream", "arg": ctx.header},
+            {"seq": 1, "t_ns": 1_500_000, "tid": 1, "ph": "I",
+             "name": "mesh.degraded", "arg": "stage:4"},
+            {"seq": 2, "t_ns": 3_000_000, "tid": 1, "ph": "E",
+             "name": "serving.stream", "arg": "Degraded"},
+            {"seq": 3, "t_ns": 4_000_000, "tid": 1, "ph": "B",
+             "name": "wire.upload", "arg": ctx.header},
+            # no E for wire.upload: the kill-mid-stage case
+        ]
+        recs = tracing.trace_span_records(events, ctx.trace_id)
+        by_name = {r["name"]: r for r in recs}
+        stream = by_name["serving.stream"]
+        assert stream["dur_ms"] == 2.0
+        assert stream["error"] == "Degraded"
+        inst = by_name["mesh.degraded"]
+        assert inst["instant"] is True and inst["arg"] == "stage:4"
+        assert by_name["wire.upload"]["unterminated"] is True
+        # a foreign trace id matches nothing
+        assert tracing.trace_span_records(events, "f" * 32) == []
+
+
+# ---------------------------------------------------------------------------
+# tail-sampled slow-request log (the `trace` command's data)
+# ---------------------------------------------------------------------------
+
+
+class TestSlowRequestLog:
+    def test_disabled_plane_drops(self):
+        tracing.note_request("serving.stream", 999.0)
+        assert tracing.slow_requests() == []
+
+    def test_below_slo_keeps_record_drops_span_detail(self):
+        config.set_flag("TRACE", True)
+        evaluated = []
+
+        def spans():
+            evaluated.append(1)
+            return [{"name": "x"}]
+
+        tracing.note_request("serving.stream", 1.0, trace_id="a" * 32,
+                             session="tenant", spans=spans)
+        recs = tracing.slow_requests()
+        assert len(recs) == 1
+        assert recs[0]["label"] == "serving.stream"
+        assert recs[0]["trace_id"] == "a" * 32
+        assert recs[0]["session"] == "tenant"
+        assert "spans" not in recs[0]
+        assert not evaluated  # tail sampling: the callable never ran
+
+    def test_slo_breach_samples_span_detail(self):
+        config.set_flag("TRACE", True)
+        config.set_flag("TRACE_SLO_MS", "5")
+        tracing.note_request(
+            "serving.stream", 6.0,
+            spans=lambda: [{"name": "mesh.stage", "dur_ms": 5.5}],
+        )
+        recs = tracing.slow_requests()
+        assert recs[0]["spans"] == [{"name": "mesh.stage", "dur_ms": 5.5}]
+
+    def test_typed_error_samples_below_slo(self):
+        config.set_flag("TRACE", True)
+        tracing.note_request(
+            "serving.stream", 0.5, error="Degraded",
+            spans=lambda: [{"name": "mesh.stage"}],
+        )
+        recs = tracing.slow_requests()
+        assert recs[0]["error"] == "Degraded"
+        assert recs[0]["spans"] == [{"name": "mesh.stage"}]
+
+    def test_topk_bound_keeps_slowest_first(self):
+        config.set_flag("TRACE", True)
+        config.set_flag("TRACE_TOPK", "4")
+        for ms in (7.0, 3.0, 9.0, 1.0, 5.0, 8.0, 2.0, 6.0):
+            tracing.note_request("serving.stream", ms)
+        recs = tracing.slow_requests()
+        assert [r["ms"] for r in recs] == [9.0, 8.0, 7.0, 6.0]
+
+    def test_reset_drops_log(self):
+        config.set_flag("TRACE", True)
+        tracing.note_request("serving.stream", 1.0)
+        assert tracing.slow_requests()
+        tracing.reset_requests()
+        assert tracing.slow_requests() == []
+
+
+class TestPrometheusText:
+    def test_renders_registry_families(self):
+        config.set_flag("METRICS", True)
+        metrics.counter_add("shuffle.retries", 3)
+        metrics.gauge_set("mesh.devices", 4)
+        metrics.hist_observe("serving.queue_wait_ms", 1.5,
+                             bounds=metrics.SPAN_MS_BOUNDS)
+        text = metrics.prometheus_text()
+        assert "# TYPE srt_shuffle_retries_total counter" in text
+        assert "srt_shuffle_retries_total 3" in text
+        assert "# TYPE srt_mesh_devices gauge" in text
+        assert 'srt_serving_queue_wait_ms_bucket{le="' in text
+
+    def test_explicit_snapshot_renders_without_flag(self):
+        snap = {"counters": {"plan.mesh_fallbacks": 2}}
+        text = metrics.prometheus_text(snap)
+        assert "srt_plan_mesh_fallbacks_total 2" in text
+
+    def test_empty_snapshot_empty_exposition(self):
+        # METRICS off: the snapshot is empty and so is the exposition —
+        # the serving `trace` smoke sets METRICS=1 for exactly this
+        assert metrics.prometheus_text({}) == ""
+
+
+# ---------------------------------------------------------------------------
+# propagation across thread hops: scheduler tickets, pipeline pendings
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerPropagation:
+    def test_ticket_captures_and_worker_reactivates(self):
+        config.set_flag("FLIGHT", True)
+        sched = sched_mod.FairScheduler(workers=1).start()
+        sess = session_mod.Session("s", "tenant", 1.0, 1 << 40)
+        sched.register(sess)
+        try:
+            ctx = tracing.new_context()
+            with tracing.activate(ctx):
+                t = sched.submit(
+                    sess, tracing.current_trace_id, label="probe"
+                )
+            assert t.ctx is ctx  # captured at SUBMIT, not at run
+            assert t.result() == ctx.trace_id  # worker re-activated it
+            bare = sched.submit(
+                sess, tracing.current_trace_id, label="probe"
+            )
+            assert bare.ctx is None and bare.result() is None
+        finally:
+            sched.unregister(sess)
+            sched.stop()
+        # the retroactive queue-wait span rides the request's trace
+        waits = [
+            e for e in flight.tail_records()
+            if e["ph"] == "B" and e["name"] == "serving.queue_wait"
+        ]
+        assert any(e["arg"] == ctx.header for e in waits), waits
+
+
+class TestPipelinePropagation:
+    def test_pending_captures_and_worker_reactivates(self):
+        config.set_flag("PIPELINE", "2")
+        assert pipeline.enabled()
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            p = pipeline.submit(tracing.current_trace_id, "probe")
+        assert p.ctx is ctx
+        assert p.resolve() == ctx.trace_id
+
+    def test_sync_replay_keeps_original_trace(self):
+        # the worker run fails; the sync replay runs on a thread with
+        # NO ambient context — it must re-activate the captured one,
+        # never mint a fresh trace
+        config.set_flag("PIPELINE", "2")
+        calls = []
+
+        def work():
+            calls.append(tracing.current_trace_id())
+            if len(calls) == 1:
+                raise faults.TransientDeviceError("UNAVAILABLE: flake")
+            return tracing.current_trace_id()
+
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            p = pipeline.submit(work, "probe")
+        assert tracing.current() is None
+        assert p.resolve() == ctx.trace_id
+        assert calls == [ctx.trace_id, ctx.trace_id]
+
+
+# ---------------------------------------------------------------------------
+# chaos attribution (satellite): replay/degradation instants keep the
+# ORIGINAL request's trace id — a replay never mints a fresh trace
+# ---------------------------------------------------------------------------
+
+
+def _trace_of(events, name):
+    tagged = [
+        e for e in tracing.assign_trace_ids(events)
+        if e.get("name") == name and e.get("ph") == "I"
+    ]
+    assert tagged, f"no {name!r} instant on the ring"
+    return {e.get("trace_id") for e in tagged}
+
+
+class TestChaosTraceAttribution:
+    def test_shuffle_giveup_donated_inherits_trace(self):
+        config.set_flag("FLIGHT", True)
+
+        def launch():
+            raise faults.TransientDeviceError("UNAVAILABLE: mid-donate")
+
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            with pytest.raises(faults.TransientDeviceError):
+                parallel.run_collective(
+                    "shuffle.all_to_all", launch, donated=True
+                )
+        evs = flight.tail_records()
+        assert _trace_of(evs, "shuffle.giveup") == {ctx.trace_id}
+        # the exchange span itself closed with the error class
+        ends = [e for e in evs if e["ph"] == "E"
+                and e["name"] == "shuffle.all_to_all"]
+        assert ends and ends[0]["arg"] == "TransientDeviceError"
+
+    def test_shuffle_giveup_exhausted_inherits_trace(self):
+        config.set_flag("FLIGHT", True)
+        config.set_flag("RETRY_BASE_MS", "0")
+
+        def launch():
+            raise faults.TransientDeviceError("UNAVAILABLE: persistent")
+
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            with pytest.raises(faults.TransientDeviceError):
+                parallel.run_collective(
+                    "shuffle.exchange", launch, max_retries=1
+                )
+        assert _trace_of(
+            flight.tail_records(), "shuffle.giveup"
+        ) == {ctx.trace_id}
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+    )
+    def test_mesh_ladder_instants_inherit_trace(self):
+        config.set_flag("FLIGHT", True)
+        config.set_flag("RETRY_MAX", "0")
+        runner = parallel.MeshRunner(8)
+
+        def stage(mesh):
+            if int(mesh.shape["shuffle"]) > 2:
+                raise faults.TransientDeviceError("UNAVAILABLE: slice")
+            return "ok"
+
+        ctx = tracing.new_context()
+        with tracing.activate(ctx):
+            assert runner.run_stage("chaos.stage", stage) == "ok"
+        evs = flight.tail_records()
+        # 8 -> 4 -> 2: two replays, two degradations, ONE trace
+        assert _trace_of(evs, "mesh.replay") == {ctx.trace_id}
+        assert _trace_of(evs, "mesh.degraded") == {ctx.trace_id}
+        tagged = tracing.assign_trace_ids(evs)
+        ids = {e["trace_id"] for e in tagged if "trace_id" in e}
+        assert ids == {ctx.trace_id}, ids  # the ladder minted nothing
+        stages = [e for e in tagged if e.get("name") == "mesh.stage"
+                  and e.get("ph") == "B"]
+        assert stages and stages[0]["arg"] == ctx.header
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the disabled span pair stays in record()'s cost class
+# ---------------------------------------------------------------------------
+
+
+class TestOverhead:
+    def test_disabled_span_pair_within_2x_of_disabled_record(self):
+        assert not flight.enabled()
+        iters = 100_000
+
+        def best_of(fn, reps=5):
+            fn()  # warm the cached gate
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    fn()
+                best = min(best, time.perf_counter() - t0)
+            return best / iters
+
+        record_s = best_of(lambda: flight.record("I", "overhead.probe"))
+
+        def pair():
+            tracing.span_end(tracing.span_begin("overhead.probe"))
+
+        pair_s = best_of(pair)
+        assert pair_s <= 2.0 * record_s + 200e-9, (
+            f"disabled span_begin/span_end pair costs {pair_s * 1e9:.0f}"
+            f"ns/op vs {record_s * 1e9:.0f}ns/op for disabled "
+            "flight.record() — the trace layer broke the disabled-path "
+            "cost class (<= 2x record + 200ns slack)"
+        )

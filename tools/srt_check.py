@@ -63,6 +63,17 @@ Passes (each emits ``file:line:col`` findings):
   discipline). A raw ``open(..., "a")`` on a stats path bypasses the
   framing, and a torn write there corrupts history for every later
   reader. Justified sites carry ``# srt: allow-stats-append(<reason>)``.
+* **SRT011 trace-context** — trace-plane discipline, both halves: a
+  string-literal span name handed to ``tracing.span_begin`` /
+  ``trace_range`` must follow the same dotted-name grammar and
+  registered-namespace rule as SRT006 (span names land on the flight
+  ring and merge into dashboards next to metric names — one typo
+  splits a request's spans across two rows); and serving modules must
+  not hand-roll trace ids (``uuid``/``os.urandom``/``secrets`` flowing
+  into a trace-named binding): ``tracing.new_context()`` is the one
+  mint, which is what keeps ids W3C-shaped and the ambient context the
+  single source of truth. Justified sites carry
+  ``# srt: allow-trace-context(<reason>)``.
 * **SRT000 bad-pragma** — a suppression pragma with a missing reason
   or an unknown pass name is itself a finding: silent suppression
   grows back the prose problem this tool replaces.
@@ -179,7 +190,7 @@ METRIC_NAMESPACES = frozenset({
     "session", "retry", "faults", "breaker", "fault", "spill", "lock",
     "shuffle", "distributed", "io", "probe", "bench", "groupby",
     "join", "sort", "profile", "stream", "checkpoint", "restore",
-    "mesh", "planstats", "drift", "partition",
+    "mesh", "planstats", "drift", "partition", "client", "compile",
 })
 METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
@@ -188,6 +199,19 @@ METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 METRIC_FNS = frozenset({
     "counter_add", "bytes_add", "timer_record", "gauge_set",
     "hist_observe", "self_time_record", "span",
+})
+
+# SRT011: tracing entry points whose FIRST string arg is a span name
+# (rides the SRT006 grammar: span names land on the flight ring next
+# to metric names)
+TRACE_SPAN_FNS = frozenset({"span_begin", "trace_range"})
+
+# SRT011: calls that mint random identity. In serving modules a result
+# of one of these flowing into a trace-named binding bypasses
+# tracing.new_context(), the one sanctioned trace-id mint.
+_MINT_CALLS = frozenset({
+    "uuid1", "uuid4", "urandom", "token_hex", "token_bytes",
+    "getrandbits",
 })
 
 BENCH_TIERS = frozenset({"headline", "extended", "manual"})
@@ -205,6 +229,7 @@ PASS_PRAGMAS = {
     "SRT008": "dispatch-parity",
     "SRT009": "host-sync",
     "SRT010": "stats-append",
+    "SRT011": "trace-context",
 }
 PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
 LOOSE_PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-")
@@ -390,6 +415,32 @@ def _names_in(tree: ast.AST):
                 yield sub.value.id
 
 
+def _mints_id(node: ast.AST) -> bool:
+    """True when the subtree calls a random-identity mint
+    (``uuid.uuid4()``, ``os.urandom()``, ``secrets.token_hex()``...)."""
+    return any(
+        isinstance(sub, ast.Call) and _call_name(sub) in _MINT_CALLS
+        for sub in ast.walk(node)
+    )
+
+
+def _trace_named(node: ast.AST) -> bool:
+    """True when a binding target / dict key names trace identity
+    (``trace_id = ...``, ``header["traceparent"] = ...``)."""
+    if isinstance(node, ast.Name):
+        return "trace" in node.id
+    if isinstance(node, ast.Attribute):
+        return "trace" in node.attr
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return "trace" in sl.value
+        return _trace_named(node.value)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return "trace" in node.value
+    return False
+
+
 # ---------------------------------------------------------------------------
 # per-file analysis
 # ---------------------------------------------------------------------------
@@ -407,6 +458,11 @@ class _FileChecker(ast.NodeVisitor):
         self.is_config = norm == CONFIG_MODULE
         self.determinism = norm in DETERMINISM_MODULES
         self.hot_sync = norm in HOT_SYNC_MODULES
+        # SRT011 mint-check scope: the serving tier (tracing.py itself
+        # owns the os.urandom mint and lives in utils/)
+        self.in_serving = norm.startswith(
+            os.path.join("spark_rapids_jni_tpu", "serving") + os.sep
+        )
         # SRT009: per-function sets of local names bound from
         # device-producing calls (conservative: any call not in
         # HOST_CALLS and not itself flagged as a sync)
@@ -591,6 +647,28 @@ class _FileChecker(ast.NodeVisitor):
 
     def visit_Assign(self, node):
         self._classify_assign(node)
+        if self.in_serving and any(
+            _trace_named(t) for t in node.targets
+        ) and _mints_id(node.value):
+            self._emit(
+                "SRT011", node,
+                "hand-rolled trace id in a serving module — "
+                "tracing.new_context() / tracing.ensure_context() is "
+                "the one mint (W3C-shaped ids, ambient context as the "
+                "single source of truth)",
+            )
+        self.generic_visit(node)
+
+    def visit_Dict(self, node):
+        if self.in_serving:
+            for k, v in zip(node.keys, node.values):
+                if k is not None and _trace_named(k) and _mints_id(v):
+                    self._emit(
+                        "SRT011", v,
+                        "hand-rolled trace id under a trace-named key "
+                        "in a serving module — mint through "
+                        "tracing.new_context() / ensure_context()",
+                    )
         self.generic_visit(node)
 
     def _check_host_sync(self, node: ast.Call, name: str) -> None:
@@ -713,6 +791,28 @@ class _FileChecker(ast.NodeVisitor):
                     "it in tools/srt_check.py METRIC_NAMESPACES (one "
                     "reviewed line) or reuse an existing namespace",
                 )
+
+        if name in TRACE_SPAN_FNS and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                sname = a.value
+                if not METRIC_NAME_RE.match(sname):
+                    self._emit(
+                        "SRT011", node,
+                        f"span name {sname!r} is not dotted-lowercase "
+                        "([a-z0-9_] segments joined by '.') — span "
+                        "names land on the flight ring next to metric "
+                        "names and follow the same grammar",
+                    )
+                elif sname.split(".", 1)[0] not in METRIC_NAMESPACES:
+                    self._emit(
+                        "SRT011", node,
+                        f"span name {sname!r} uses unregistered "
+                        f"namespace {sname.split('.', 1)[0]!r} — "
+                        "register it in tools/srt_check.py "
+                        "METRIC_NAMESPACES (one reviewed line) or "
+                        "reuse an existing namespace",
+                    )
         self.generic_visit(node)
 
 

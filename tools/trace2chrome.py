@@ -51,10 +51,15 @@ def _events_from(doc) -> list:
             ):
                 events.extend(f["flight_tail"])
     # several configs may carry the same parent-process tail: dedup by
-    # (seq, t_ns) so the timeline doesn't stack identical spans
+    # (seq, t_ns) so the timeline doesn't stack identical spans. Older
+    # or corrupt dumps may carry non-dict rows — drop them here, the
+    # same tolerance the exporter applies (a postmortem tool must read
+    # every format that ever wrote a dump)
     seen = set()
     out = []
     for e in events:
+        if not isinstance(e, dict):
+            continue
         key = (e.get("seq"), e.get("t_ns"))
         if key in seen:
             continue

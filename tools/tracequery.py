@@ -1,0 +1,297 @@
+"""Merge per-process flight dumps and render ONE request's trace.
+
+The trace-context plane (utils/tracing.py) stamps every span the
+serving daemon, the scheduler executors, the pipeline workers and the
+mesh tier record with the request's W3C-style trace id; each process
+involved writes its own ``SPARK_RAPIDS_TPU_FLIGHT_DUMP``. This tool is
+the read side: give it the dumps, and it
+
+* ``--list``           enumerates the trace ids present across all
+                       dumps (process count, span count, wall span);
+* ``--trace <id>``     renders that request's span tree — every span /
+                       instant from every process, aligned onto one
+                       clock and indented by nesting, so queue wait,
+                       admission, compile, per-segment execute and
+                       exchange launches read top-to-bottom;
+* ``--chrome out.json`` (with ``--trace``) writes a Chrome-trace /
+                       Perfetto JSON filtered to that one trace id,
+                       one process track per dump.
+
+Clock alignment reuses the flight dump's wall-clock anchors
+(``epoch_ns`` + ``anchor_perf_ns``): each dump's monotonic timestamps
+shift to wall time and the earliest event across all dumps becomes the
+shared origin — the ``tracing.merge_chrome_traces`` discipline. Trace
+attribution is per dump (thread ids and seq numbers are process-local,
+so :func:`assign_trace_ids` must run before any merge). A ``<id>``
+prefix is accepted anywhere a full 32-hex trace id is expected.
+
+Usage:
+    python tools/tracequery.py --list server.json worker*.json
+    python tools/tracequery.py --trace 4bf92f35 server.json worker.json
+    python tools/tracequery.py --trace 4bf92f35 --chrome req.trace.json \
+        server.json worker.json
+
+Tolerates older flight formats the way the exporter does: non-dict
+rows are dropped, missing keys degrade, dumps without anchors merge
+unshifted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+# pure-stdlib analysis: keep the import off the accelerator plugin
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from spark_rapids_jni_tpu.utils.tracing import (  # noqa: E402
+    assign_trace_ids,
+    merge_chrome_traces,
+    trace_span_records,
+)
+
+
+def load_dump(path: str) -> dict:
+    """One flight dump, parsed whole or line-wise (the trace2chrome
+    discipline: a dump embedded in log output still loads)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+        for line in text.splitlines():
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        if doc is None:
+            raise
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a flight dump (expected object)")
+    return doc
+
+
+def _proc_label(d: dict) -> str:
+    name = f"{d.get('host', '?')}:{d.get('pid', '?')}"
+    sid = d.get("session_id")
+    if sid:
+        name = f"{name} [{str(sid)[:8]}]"
+    return name
+
+
+def _shift_ns(d: dict) -> int:
+    """perf-counter -> wall-clock shift for one dump (0 when the dump
+    predates the anchors — it merges unshifted rather than failing)."""
+    epoch, anchor = d.get("epoch_ns"), d.get("anchor_perf_ns")
+    if epoch is None or anchor is None:
+        return 0
+    return int(epoch) - int(anchor)
+
+
+def _dump_events(d: dict) -> list:
+    return [
+        e for e in (d.get("events") or [])
+        if isinstance(e, dict) and "t_ns" in e
+    ]
+
+
+def collect(paths) -> list:
+    """[(dump, trace-tagged events)] — attribution runs PER DUMP:
+    thread ids and seq numbers are process-local."""
+    out = []
+    for p in paths:
+        d = load_dump(p)
+        d["_path"] = p
+        out.append((d, assign_trace_ids(_dump_events(d))))
+    return out
+
+
+def resolve_trace_id(tagged_dumps, prefix: str) -> str:
+    """Expand a trace-id prefix to the unique full id it names."""
+    want = prefix.strip().lower()
+    hits = sorted({
+        e["trace_id"]
+        for _, evs in tagged_dumps
+        for e in evs
+        if e.get("trace_id", "").startswith(want)
+    })
+    if not hits:
+        raise SystemExit(
+            f"tracequery: no trace matching {prefix!r} in the given "
+            "dumps (was SPARK_RAPIDS_TPU_TRACE/FLIGHT on end to end?)"
+        )
+    if len(hits) > 1:
+        raise SystemExit(
+            f"tracequery: trace prefix {prefix!r} is ambiguous: "
+            + ", ".join(h[:12] for h in hits)
+        )
+    return hits[0]
+
+
+def list_traces(tagged_dumps) -> list:
+    """Summaries of every trace across the dumps, earliest first."""
+    traces: dict = {}
+    for d, evs in tagged_dumps:
+        shift = _shift_ns(d)
+        proc = _proc_label(d)
+        for e in evs:
+            tid_ = e.get("trace_id")
+            if not tid_:
+                continue
+            t = traces.setdefault(tid_, {
+                "trace_id": tid_, "procs": set(), "events": 0,
+                "first_ns": None, "last_ns": None, "names": set(),
+            })
+            t["procs"].add(proc)
+            t["events"] += 1
+            w = e.get("t_ns", 0) + shift
+            t["first_ns"] = w if t["first_ns"] is None else min(
+                t["first_ns"], w
+            )
+            t["last_ns"] = w if t["last_ns"] is None else max(
+                t["last_ns"], w
+            )
+            t["names"].add(e.get("name", "?"))
+    out = []
+    for t in sorted(traces.values(), key=lambda t: t["first_ns"] or 0):
+        out.append({
+            "trace_id": t["trace_id"],
+            "processes": sorted(t["procs"]),
+            "events": t["events"],
+            "wall_ms": round((t["last_ns"] - t["first_ns"]) / 1e6, 3),
+            "names": sorted(t["names"]),
+        })
+    return out
+
+
+def merged_records(tagged_dumps, trace_id: str) -> list:
+    """One trace's span/instant records from every dump, on the shared
+    wall clock, sorted by start time."""
+    recs = []
+    for d, _ in tagged_dumps:
+        shift = _shift_ns(d)
+        proc = _proc_label(d)
+        for r in trace_span_records(_dump_events(d), trace_id):
+            r = dict(r)
+            r["proc"] = proc
+            r["t_ns"] = r.get("t_ns", 0) + shift
+            recs.append(r)
+    recs.sort(key=lambda r: (r.get("t_ns", 0), r.get("proc", "")))
+    return recs
+
+
+def render_tree(recs, trace_id: str) -> str:
+    """The span tree: indentation = interval containment per
+    (process, thread) lane; offsets are ms from the trace origin."""
+    if not recs:
+        return f"trace {trace_id}: no spans"
+    origin = min(r.get("t_ns", 0) for r in recs)
+    lines = [f"trace {trace_id}"]
+    stacks: dict = {}  # (proc, tid) -> [end_ns, ...] of open spans
+    for r in recs:
+        key = (r.get("proc"), r.get("tid"))
+        stack = stacks.setdefault(key, [])
+        t = r.get("t_ns", 0)
+        while stack and stack[-1] <= t:
+            stack.pop()
+        depth = len(stack)
+        off = (t - origin) / 1e6
+        if r.get("instant"):
+            tail = "· " + str(r.get("name", "?"))
+            if r.get("arg") is not None:
+                tail += f" [{r['arg']}]"
+        elif r.get("unterminated"):
+            tail = f"{r.get('name', '?')} (unterminated)"
+        else:
+            dur = r.get("dur_ms")
+            tail = str(r.get("name", "?"))
+            if dur is not None:
+                tail += f" ({dur:.3f} ms)"
+                stack.append(t + int(dur * 1e6))
+            if r.get("error") is not None:
+                tail += f" !{r['error']}"
+        lines.append(
+            f"{off:>12.3f} ms  {r.get('proc', '?'):<28} "
+            f"{'  ' * depth}{tail}"
+        )
+    return "\n".join(lines)
+
+
+def chrome_for_trace(tagged_dumps, trace_id: str) -> dict:
+    """Merged Chrome trace filtered to one trace id (per-dump filter
+    BEFORE the merge, so B/E pairing and process tracks stay intact)."""
+    filtered = []
+    for d, evs in tagged_dumps:
+        keep = [
+            {k: v for k, v in e.items() if k != "trace_id"}
+            for e in evs
+            if e.get("trace_id") == trace_id
+        ]
+        if keep:
+            filtered.append(dict(d, events=keep))
+    return merge_chrome_traces(filtered)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge flight dumps; render one request's trace"
+    )
+    ap.add_argument("dumps", nargs="+", help="flight dump files")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="list the trace ids across all dumps")
+    ap.add_argument("--trace", help="trace id (or unique prefix)")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="with --trace: write a filtered Chrome trace")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output instead of the tree")
+    args = ap.parse_args(argv)
+    tagged = collect(args.dumps)
+    if not any(evs for _, evs in tagged):
+        print(
+            "tracequery: no flight events in the given dumps "
+            "(was SPARK_RAPIDS_TPU_FLIGHT_DUMP enabled?)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.list_ or not args.trace:
+        for t in list_traces(tagged):
+            if args.json:
+                print(json.dumps(t, sort_keys=True))
+            else:
+                print(
+                    f"{t['trace_id']}  procs={len(t['processes'])} "
+                    f"events={t['events']} wall={t['wall_ms']}ms  "
+                    + " ".join(t["names"][:6])
+                )
+        return 0
+    trace_id = resolve_trace_id(tagged, args.trace)
+    if args.chrome:
+        trace = chrome_for_trace(tagged, trace_id)
+        with open(args.chrome, "w") as f:
+            json.dump(trace, f, indent=1, sort_keys=True)
+            f.write("\n")
+        n = sum(
+            1 for e in trace["traceEvents"] if e.get("ph") == "X"
+        )
+        print(
+            f"wrote {args.chrome}: {n} spans of trace {trace_id[:12]} "
+            "— open at https://ui.perfetto.dev"
+        )
+        return 0
+    recs = merged_records(tagged, trace_id)
+    if args.json:
+        for r in recs:
+            print(json.dumps(r, sort_keys=True))
+    else:
+        print(render_tree(recs, trace_id))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
