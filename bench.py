@@ -2094,6 +2094,176 @@ def bench_spill_stream(platform, tables=12, rows=1 << 15):
     }
 
 
+def bench_kernel_ab(platform, workload, total_rows=2_097_152,
+                    batch_rows=None):
+    """Config: the Pallas kernel tier A/B (kernels/registry.py) — the
+    SAME resident dispatch stream with SPARK_RAPIDS_TPU_KERNELS=on vs
+    off. Batches sit inside the kernel predicates' envelope (pow2
+    bucket, within the VMEM bounds) so the ON arm actually launches;
+    the entry carries the kernel.launches/declines/fallbacks counters
+    that prove it, and a clean run must report ZERO fallbacks (the
+    tier's never-changes-bytes contract, byte-checked here on the last
+    batch and exhaustively by tests/test_kernel_tier.py).
+    SRT_BENCH_KERNEL_ROWS scales total_rows for smoke runs."""
+    import os as _os
+    import time as _time
+
+    from spark_rapids_jni_tpu import dtype as dt
+    from spark_rapids_jni_tpu import runtime_bridge as rb
+    from spark_rapids_jni_tpu.utils import buckets as buckets_mod
+    from spark_rapids_jni_tpu.utils import config as srt_config
+    from spark_rapids_jni_tpu.utils import metrics as srt_metrics
+
+    _metrics_enable()  # the kernel.* counters ARE this config's story
+    if batch_rows is None:
+        # each workload's largest pow2 batch inside its kernel's VMEM
+        # predicate: packed_sort carries (3 + 4 payload) u32 words/row
+        # against SORT_MAX_WORDS, the hash kernels bound rows directly
+        batch_rows = (1 << 14) if workload == "sort" else (1 << 16)
+    raw = _os.environ.get("SRT_BENCH_KERNEL_ROWS", "").strip()
+    if raw:
+        total_rows = max(batch_rows, int(raw))
+    nb = max(1, total_rows // batch_rows)
+    rng = np.random.default_rng(61)
+    i64 = int(dt.TypeId.INT64)
+
+    ids = []
+    rest_ids = []
+    if workload == "sort":
+        chain = [{"op": "sort_by", "keys": [{"column": 0}]}]
+        for _ in range(nb):
+            k = rng.integers(-(1 << 40), 1 << 40, batch_rows,
+                             dtype=np.int64)
+            v = rng.integers(-1000, 1000, batch_rows, dtype=np.int64)
+            ids.append(rb.table_upload_wire(
+                [i64, i64], [0, 0], [k.tobytes(), v.tobytes()],
+                [None, None], batch_rows,
+            ))
+    elif workload == "groupby":
+        chain = [{"op": "groupby", "by": [0],
+                  "aggs": [{"column": 1, "agg": "sum"},
+                           {"column": 1, "agg": "count"}]}]
+        for _ in range(nb):
+            k = rng.integers(0, 50_000, batch_rows, dtype=np.int64)
+            v = rng.integers(-1000, 1000, batch_rows, dtype=np.int64)
+            ids.append(rb.table_upload_wire(
+                [i64, i64], [0, 0], [k.tobytes(), v.tobytes()],
+                [None, None], batch_rows,
+            ))
+    elif workload == "transpose":
+        schema = [dt.INT64, dt.FLOAT64, dt.INT32, dt.BOOL8]
+        chain = [
+            {"op": "to_rows"},
+            {"op": "from_rows",
+             "type_ids": [int(d.id) for d in schema],
+             "scales": [0] * len(schema)},
+        ]
+        for _ in range(nb):
+            datas = [
+                rng.integers(-(1 << 40), 1 << 40, batch_rows,
+                             dtype=np.int64).tobytes(),
+                rng.standard_normal(batch_rows).tobytes(),
+                rng.integers(-(1 << 30), 1 << 30, batch_rows,
+                             dtype=np.int32).tobytes(),
+                rng.integers(0, 2, batch_rows).astype(np.bool_).tobytes(),
+            ]
+            ids.append(rb.table_upload_wire(
+                [int(d.id) for d in schema], [0] * len(schema), datas,
+                [None] * len(schema), batch_rows,
+            ))
+    elif workload == "join":
+        # existing batched-join sizing: a resident unique-key build
+        # side probed by every stream batch (the kernel's sweet spot —
+        # duplicate build keys decline to the exact path)
+        chain = [{"op": "join", "on": [0], "how": "inner"}]
+        build_n = 1 << 16
+        bk = rng.permutation(2 * build_n)[:build_n].astype(np.int64)
+        bv = rng.integers(-1000, 1000, build_n, dtype=np.int64)
+        rest_ids = [rb.table_upload_wire(
+            [i64, i64], [0, 0], [bk.tobytes(), bv.tobytes()],
+            [None, None], build_n,
+        )]
+        for _ in range(nb):
+            k = rng.integers(0, 2 * build_n, batch_rows, dtype=np.int64)
+            v = rng.integers(-1000, 1000, batch_rows, dtype=np.int64)
+            ids.append(rb.table_upload_wire(
+                [i64, i64], [0, 0], [k.tobytes(), v.tobytes()],
+                [None, None], batch_rows,
+            ))
+    else:
+        raise ValueError(f"unknown kernel A/B workload {workload!r}")
+
+    def stream():
+        """One full pass: every batch through the chain; the last
+        output is downloaded (the completion barrier) and returned for
+        the parity check."""
+        t0 = _time.perf_counter()
+        out = None
+        for tid in ids:
+            cur, owned = tid, False
+            for op in chain:
+                nxt = rb.table_op_resident(json.dumps(op),
+                                           [cur] + rest_ids)
+                if owned:
+                    rb.table_free(cur)
+                cur, owned = nxt, True
+            out = rb.table_download_wire(cur)
+            rb.table_free(cur)
+        return _time.perf_counter() - t0, out
+
+    warm_reps = 3
+
+    def run_mode(mode):
+        srt_config.set_flag("KERNELS", mode)
+        try:
+            buckets_mod.cache_clear()
+            cold_s, _ = stream()
+            srt_metrics.reset()
+            warm_s, out = stream()
+            for _ in range(warm_reps - 1):
+                warm_s = min(warm_s, stream()[0])
+            snap = _metrics_snapshot() or {}
+        finally:
+            srt_config.clear_flag("KERNELS")
+        return cold_s, warm_s, out, snap
+
+    try:
+        off_cold_s, off_warm_s, off_out, _ = run_mode("off")
+        on_cold_s, on_warm_s, on_out, snap = run_mode("on")
+    finally:
+        for tid in ids + rest_ids:
+            rb.table_free(tid)
+    assert off_out == on_out, (
+        f"kernel tier changed bytes on {workload}"
+    )
+    ctr = snap.get("counters", {})
+    launches = int(ctr.get("kernel.launches", 0))
+    fallbacks = int(ctr.get("kernel.fallbacks", 0))
+    assert launches > 0, f"kernel ON arm never launched ({workload})"
+    assert fallbacks == 0, (
+        f"clean kernel run reported {fallbacks} fallback(s) ({workload})"
+    )
+    return {
+        "config": "kernel",
+        "name": f"kernel_{workload}_ab_{nb}x{batch_rows}",
+        "rows": nb * batch_rows,
+        "batches": nb,
+        "batch_rows": batch_rows,
+        "kernel_off_cold_seconds": round(off_cold_s, 4),
+        "kernel_off_warm_seconds": round(off_warm_s, 4),
+        "kernel_on_cold_seconds": round(on_cold_s, 4),
+        "kernel_on_warm_seconds": round(on_warm_s, 4),
+        "warm_speedup": round(off_warm_s / on_warm_s, 3)
+        if on_warm_s else None,
+        "kernel": {
+            "launches": launches,
+            "declines": int(ctr.get("kernel.declines", 0)),
+            "fallbacks": fallbacks,
+        },
+        "platform": platform,
+    }
+
+
 # Each device config runs in its OWN subprocess: a TPU worker crash or a
 # tunnel hang inside one config must cost that one entry, not every
 # config after it (observed: the r3 100M-join crash killed the client
@@ -2144,6 +2314,19 @@ _SUBPROCESS_CONFIGS = {
     "sort_packed": bench_sort_packed,
     "sort_packed_gather": bench_sort_packed_gather,
     "chunk_sort_ab": bench_chunk_sort_ab,
+    # kernel tier A/Bs (kernels/registry.py): dispatch stream with
+    # SPARK_RAPIDS_TPU_KERNELS on vs off, byte-parity asserted
+    "kernel_sort_ab": lambda p: bench_kernel_ab(p, "sort"),
+    "kernel_groupby_ab": lambda p: bench_kernel_ab(p, "groupby"),
+    "kernel_transpose_ab": lambda p: bench_kernel_ab(p, "transpose"),
+    "kernel_join_ab": lambda p: bench_kernel_ab(p, "join", 8_388_608),
+    "kernel_sort100m_ab": lambda p: bench_kernel_ab(p, "sort", 100_007_936),
+    "kernel_groupby100m_ab": lambda p: bench_kernel_ab(
+        p, "groupby", 100_007_936
+    ),
+    "kernel_transpose100m_ab": lambda p: bench_kernel_ab(
+        p, "transpose", 100_007_936
+    ),
     "strings": bench_strings,
     "resident": bench_resident_chain,
     "bucketed_stream": bench_bucketed_stream,
@@ -2185,6 +2368,12 @@ _ARM_TIERS = {
     "fused_plan": "headline",
     "serving_multiquery": "headline",
     "spill_stream": "headline",
+    # kernel tier: the three cheapest A/B pairs prove the headline
+    # claim (on vs off wall time + launch counters); the 100M variants
+    # and the join pair refine in the extended tier
+    "kernel_sort_ab": "headline",
+    "kernel_groupby_ab": "headline",
+    "kernel_transpose_ab": "headline",
     "groupby16m": "extended",
     # decisive cheap A/Bs first: plain-XLA gather arms compile fast,
     # the Pallas engines (slow Mosaic compiles) right after
@@ -2192,15 +2381,18 @@ _ARM_TIERS = {
     "groupby16m_flat_sort": "extended",
     "groupby16m_gather": "extended",
     "chunk_sort_ab": "extended",
+    "kernel_join_ab": "extended",
     "strings": "extended",
     "transpose": "extended",
-    "transpose_pallas": "extended",
     "resident": "extended",
     "bucketed_stream": "extended",
     "pipelined_stream": "extended",
     "parquet": "extended",
     "parquet_device": "extended",
     # 100M tier: likely winners first
+    "kernel_groupby100m_ab": "extended",
+    "kernel_sort100m_ab": "extended",
+    "kernel_transpose100m_ab": "extended",
     "groupby100m_gather": "extended",
     "groupby100m": "extended",
     "groupby_highcard": "extended",
@@ -2223,6 +2415,10 @@ _ARM_TIERS = {
     "groupby100m_packed_pallas32": "manual",
     "groupby100m_packed": "manual",
     "groupby100m_chunked": "manual",
+    # superseded by kernel_transpose_ab: the kernel tier runs the same
+    # Pallas transpose pair through the dispatch plane with counters
+    # and byte parity; the ad-hoc arm stays for one-off comparisons
+    "transpose_pallas": "manual",
 }
 _HEADLINE_LADDER = tuple(
     a for a, t in _ARM_TIERS.items() if t == "headline"

@@ -37,7 +37,8 @@ python3 tools/srt_check.py
 # analog) — a driver must never ship a plan the runtime would reject.
 python3 tools/plancheck_literals.py bench.py ci/smoke-chaos.sh \
   ci/smoke-chaos-mesh.sh ci/smoke-spill.sh ci/smoke-restart.sh \
-  ci/smoke-drift.sh ci/smoke-skew.sh ci/smoke-trace.sh
+  ci/smoke-drift.sh ci/smoke-skew.sh ci/smoke-trace.sh \
+  ci/smoke-kernels.sh
 
 # Native build: forced reconfigure on CI (the
 # -Dlibcudf.build.configure=true of premerge-build.sh:26).
@@ -100,6 +101,12 @@ bash ci/smoke-restart.sh
 # a typed drift finding; `explain --drift` must render the store as
 # predicted-vs-observed percentiles.
 bash ci/smoke-drift.sh
+
+# Kernel tier smoke: the static report must tag kernel-eligible ops, a
+# KERNELS=on dispatch stream must launch with byte parity vs off, a
+# seeded kernel fault must fall back cleanly, and the kernel.<name>
+# spans must survive the Perfetto trace merge.
+bash ci/smoke-kernels.sh
 
 # Trace smoke: a traced serving request over the 2-device mesh — with
 # one client kill -9'd mid-stream — must leave per-process flight

@@ -11,12 +11,29 @@ fusing multi-column passes matters:
   kernel pair's TPU replacement; 48 KB shared memory -> VMEM blocks, warp
   ballots -> vectorized bit-weight reductions).
 * ``hashing`` — fused multi-column Murmur3 table hashing in one VMEM pass.
+* ``bitonic_sort`` — batched VMEM-resident bitonic sort networks.
+* ``hash_table`` — VMEM-resident open-addressing hash build/probe (the
+  join/groupby inner loop).
+* ``registry`` — the kernel tier: one dispatchable entry per accelerated
+  inner loop, selected under ``SPARK_RAPIDS_TPU_KERNELS`` with
+  exact-path-fallback discipline.
 
 Every kernel has an ``interpret=`` escape hatch so the CPU test tier
 (tests/conftest.py) exercises the same code path the TPU runs.
+
+Kernel submodules import LAZILY (module ``__getattr__``): environments
+whose jax build lacks Pallas support must still import this package —
+the registry probes :func:`pallas_capability` and degrades every kernel
+to a clean ``kernel.declines`` with a labeled warning instead of an
+import-time failure.
 """
 
+import importlib
+
 import jax
+
+_SUBMODULES = ("bitonic_sort", "hash_table", "hashing", "registry",
+               "row_transpose")
 
 
 def on_tpu() -> bool:
@@ -36,6 +53,39 @@ def default_interpret() -> bool:
     return not on_tpu()
 
 
-from . import hashing, row_transpose  # noqa: E402,F401
+_capability: "tuple[bool, str] | None" = None
 
-__all__ = ["row_transpose", "hashing", "on_tpu", "default_interpret"]
+
+def pallas_capability() -> "tuple[bool, str]":
+    """(available, detail): can this jax build load Pallas at all?
+
+    Probed once, never raises — a missing/broken Pallas install answers
+    ``(False, "<reason>")`` and the kernel tier declines every launch
+    (kernels/registry.py) instead of failing at import time."""
+    global _capability
+    if _capability is None:
+        try:
+            importlib.import_module("jax.experimental.pallas")
+            _capability = (True, "")
+        # srt: allow-broad-except(capability probing must never raise; any import failure means "no Pallas" and the registry declines cleanly)
+        except Exception as e:
+            _capability = (
+                False, f"jax.experimental.pallas: {type(e).__name__}: "
+                f"{str(e)[:160]}",
+            )
+    return _capability
+
+
+def __getattr__(name: str):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES))
+
+
+__all__ = ["bitonic_sort", "hash_table", "hashing", "registry",
+           "row_transpose", "on_tpu", "default_interpret",
+           "pallas_capability"]

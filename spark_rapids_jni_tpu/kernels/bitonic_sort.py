@@ -328,3 +328,140 @@ def batched_sort_u32(
         else:
             outp.append(s.astype(p.dtype))
     return (out[0], *outp)
+
+
+# ---------------------------------------------------------------------------
+# loop-form variant — the kernel tier's engine. The unrolled networks
+# above trace one program op per compare-exchange (log2(T)^2 / 2 stages
+# x rolls x operands), which Mosaic wants but which makes interpret-mode
+# tracing quadratically expensive (minutes at T=1024 — unusable for the
+# CPU tier-1 parity gate). This variant runs the SAME network as two
+# nested lax loops with gather-by-computed-partner (i XOR j) inside the
+# kernel body: tracing is O(1) in T, so the registry's interpret path
+# compiles in seconds. The vector gathers put it in the same Mosaic
+# bucket as hash_table.py (may refuse to lower on a real TPU today) —
+# the kernel tier's fallback discipline absorbs that; the roll-based
+# networks above remain the Mosaic-native engines for the bench arms.
+# ---------------------------------------------------------------------------
+
+
+def _kernel_u64_looped(n_payload: int, c: int, t: int):
+    """refs = hi, lo + payloads in (C, T); out adds the perm. One
+    program over the whole batch, stable via the riding iota."""
+
+    def body(*refs):
+        ins = refs[: 2 + n_payload]
+        outs = refs[2 + n_payload:]
+        i = jax.lax.broadcasted_iota(jnp.int32, (c, t), 1)
+        ops0 = (ins[0][...], ins[1][...], i) + tuple(
+            r[...] for r in ins[2:]
+        )
+
+        def stage(ops, k, j):
+            p = jnp.bitwise_xor(i, j)  # partner index, same for every row
+            partner = tuple(
+                jnp.take_along_axis(x, p, axis=1) for x in ops
+            )
+            hi_, lo_, idx_ = ops[0], ops[1], ops[2]
+            p_hi, p_lo, p_idx = partner[0], partner[1], partner[2]
+            p_lt = (
+                (p_hi < hi_)
+                | ((p_hi == hi_) & (p_lo < lo_))
+                | ((p_hi == hi_) & (p_lo == lo_) & (p_idx < idx_))
+            )
+            is_low = (i & j) == 0
+            asc = (i & k) == 0
+            keep_min = is_low == asc
+            take = jnp.where(keep_min, p_lt, ~p_lt)
+            return tuple(
+                jnp.where(take, pv, xv) for pv, xv in zip(partner, ops)
+            )
+
+        n_k = max(t.bit_length() - 1, 0)  # log2(t) outer stages
+
+        def outer(kk, ops):
+            k = jnp.int32(1) << (kk + 1)
+
+            def inner(s, ops):
+                j = jnp.int32(1) << (kk - s)
+                return stage(ops, k, j)
+
+            return jax.lax.fori_loop(0, kk + 1, inner, ops)
+
+        ops = jax.lax.fori_loop(0, n_k, outer, ops0)
+        for r, v in zip(outs, (ops[0], ops[1], ops[2]) + ops[3:]):
+            r[...] = v
+
+    return body
+
+
+@functools.lru_cache(maxsize=64)
+def _sort_call_looped(n_payload: int, c: int, t: int, interpret: bool):
+    def fn(*arrays):
+        return pl.pallas_call(
+            _kernel_u64_looped(n_payload, c, t),
+            out_shape=[
+                jax.ShapeDtypeStruct((c, t), jnp.uint32) for _ in range(2)
+            ] + [jax.ShapeDtypeStruct((c, t), jnp.int32)] + [
+                jax.ShapeDtypeStruct((c, t), jnp.uint32)
+                for _ in range(n_payload)
+            ],
+            interpret=interpret,
+        )(*arrays)
+
+    return jax.jit(fn)
+
+
+def batched_sort_u64_looped(
+    key: jax.Array, *payloads: jax.Array, interpret: bool | None = None
+):
+    """:func:`batched_sort_u64` semantics (stable, same payload dtype
+    rules) on the loop-form kernel — O(1) tracing cost in T."""
+    if interpret is None:
+        interpret = default_interpret()
+    c, t = key.shape
+    _check_pow2(t)
+    hi = (key >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = key.astype(jnp.uint32)
+    split = []
+    wide = []
+    for p in payloads:
+        if p.dtype.itemsize == 8:
+            pb = jax.lax.bitcast_convert_type(p, jnp.uint64)
+            split.append((pb >> jnp.uint64(32)).astype(jnp.uint32))
+            split.append(pb.astype(jnp.uint32))
+            wide.append(True)
+        elif p.dtype.itemsize == 4:
+            split.append(jax.lax.bitcast_convert_type(p, jnp.uint32))
+            wide.append(False)
+        else:
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                raise TypeError(
+                    f"narrow float payload {p.dtype} would lose bits "
+                    "through the u32 widening; cast it to float32 first"
+                )
+            split.append(p.astype(jnp.uint32))
+            wide.append(False)
+    out = _sort_call_looped(len(split), c, t, bool(interpret))(
+        hi, lo, *split
+    )
+    s_key = (out[0].astype(jnp.uint64) << jnp.uint64(32)) | out[1].astype(
+        jnp.uint64
+    )
+    perm = out[2]
+    outp = []
+    k = 3
+    for p, w in zip(payloads, wide):
+        if w:
+            v = (
+                out[k].astype(jnp.uint64) << jnp.uint64(32)
+            ) | out[k + 1].astype(jnp.uint64)
+            outp.append(jax.lax.bitcast_convert_type(v, p.dtype))
+            k += 2
+        elif p.dtype.itemsize == 4:
+            outp.append(jax.lax.bitcast_convert_type(out[k], p.dtype))
+            k += 1
+        else:
+            outp.append(out[k].astype(p.dtype))
+            k += 1
+    return (s_key, perm, *outp)

@@ -446,8 +446,15 @@ def _dispatch_once(
     # dispatcher behind the chip — the lockcheck shim reports it
     lockcheck.note_blocking("device_dispatch")
     with metrics.span("dispatch." + name):
-        out = None
-        if buckets.enabled():
+        # the kernel tier (kernels/registry.py) is consulted FIRST:
+        # hand-written Pallas runners under SPARK_RAPIDS_TPU_KERNELS,
+        # byte-identical over the logical rows, declining/falling back
+        # to the bucketed/exact chain below. The flag-off path is one
+        # generation check (<5 µs contract, test_kernel_tier.py).
+        from .kernels import registry as kernel_registry
+
+        out = kernel_registry.dispatch_kernel(op, table, rest, name)
+        if out is None and buckets.enabled():
             from . import bucketed
 
             out = bucketed.dispatch_bucketed(op, table, rest, name)

@@ -54,6 +54,18 @@ def _parse_formulation(v: str) -> str:
     return got
 
 
+def _parse_kernels(v: str) -> str:
+    got = v.strip().lower()
+    if got not in ("on", "off", "auto"):
+        # a typo'd A/B arm must fail loudly, not silently measure the
+        # default routing under the wrong label (GROUPBY_FORMULATION
+        # precedent)
+        raise ValueError(
+            f"KERNELS must be on|off|auto, got {v!r}"
+        )
+    return got
+
+
 def _parse_port(v: str) -> int:
     try:
         got = int(v.strip())
@@ -217,6 +229,17 @@ _FLAGS = {
             "large-input eager groupby routing: single (one variadic "
             "sort - the round-5 on-chip winner) | packed | chunked "
             "(the two-level designs, kept for A/B)",
+        ),
+        Flag(
+            "KERNELS", "auto", _parse_kernels,
+            "Pallas kernel tier (kernels/registry.py): on = try every "
+            "applicable hand-written kernel runner (interpret-mode off "
+            "TPU, so tests/CI exercise the kernel code path on CPU) | "
+            "off = never | auto (default) = only on a real TPU, where "
+            "Mosaic compiles the kernels natively. Any kernel error or "
+            "decline replays the op on the bucketed/exact path "
+            "(metered kernel.fallbacks / kernel.declines) — the tier "
+            "can change performance, never bytes",
         ),
         Flag(
             "FLIGHT", "", str,

@@ -169,8 +169,12 @@ def retryable_class(cls: type) -> bool:
 #                shard_map launch of a distributed op or mesh stage
 #   mesh         parallel/mesh.py: mesh construction (make_mesh) and
 #                the MeshHealth heartbeat probe
+#   kernel       kernels/registry.py dispatch_kernel: the Pallas
+#                kernel-tier launch boundary (a seeded fault here must
+#                fall back to the bucketed/exact path byte-identically)
 SITES = ("dispatch", "compile", "serde", "hbm_admit", "serve_accept",
-         "spill", "checkpoint", "shuffle", "collective", "mesh")
+         "spill", "checkpoint", "shuffle", "collective", "mesh",
+         "kernel")
 
 KINDS = ("transient", "oom", "permanent")
 

@@ -239,6 +239,10 @@ def _compact_static(report) -> Optional[dict]:
             ],
             "rows_out_bound": report.get("rows_out_bound"),
             "est_hbm_peak_bytes": report.get("est_hbm_peak_bytes"),
+            # statically kernel-eligible op indices (plancheck kernel
+            # tier) — lets planstats correlate predicted eligibility
+            # with observed kernel.launches/declines
+            "kernel_ops": list(report.get("kernel_ops") or []),
         }
     # srt: allow-broad-except(malformed static report degrades to no prediction; the profiler must never fail the query it observes)
     except Exception:

@@ -74,6 +74,15 @@ Passes (each emits ``file:line:col`` findings):
   mint, which is what keeps ids W3C-shaped and the ambient context the
   single source of truth. Justified sites carry
   ``# srt: allow-trace-context(<reason>)``.
+* **SRT012 kernel-parity** — the kernel-tier registries (the SRT008
+  discipline applied to ``kernels/registry.py``): the ``KERNEL_NAMES``
+  literal, the ``_REGISTRY`` dict keys, and plancheck's
+  ``_KERNEL_RULES`` table must hold exactly the same kernel names, the
+  ``kernel`` metric namespace must be registered here, and every
+  ``_REGISTRY`` entry must be a well-formed ``KernelSpec(...)`` whose
+  name argument matches its key. A kernel added to one registry
+  without the others would launch untagged (no static eligibility,
+  unattributed counters) or tag ops the runtime cannot accelerate.
 * **SRT000 bad-pragma** — a suppression pragma with a missing reason
   or an unknown pass name is itself a finding: silent suppression
   grows back the prose problem this tool replaces.
@@ -191,6 +200,7 @@ METRIC_NAMESPACES = frozenset({
     "shuffle", "distributed", "io", "probe", "bench", "groupby",
     "join", "sort", "profile", "stream", "checkpoint", "restore",
     "mesh", "planstats", "drift", "partition", "client", "compile",
+    "kernel",
 })
 METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
@@ -230,6 +240,7 @@ PASS_PRAGMAS = {
     "SRT009": "host-sync",
     "SRT010": "stats-append",
     "SRT011": "trace-context",
+    "SRT012": "kernel-parity",
 }
 PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
 LOOSE_PRAGMA_RE = re.compile(r"#\s*srt:\s*allow-")
@@ -1082,6 +1093,149 @@ def check_dispatch_parity(relpath: str, tree: ast.Module,
     return findings
 
 
+def check_kernel_parity(relpath: str, tree: ast.Module,
+                        pragmas: _Pragmas,
+                        src_dir: str) -> List[Finding]:
+    """Runs when the scanned module IS the kernel registry (it defines
+    both ``KERNEL_NAMES`` and ``_REGISTRY``): the kernel-tier parity
+    pass, mirroring SRT008 for the kernel plane. The KERNEL_NAMES
+    literal, the _REGISTRY dict keys, and the sibling plancheck.py's
+    _KERNEL_RULES table must hold exactly the same names; every
+    _REGISTRY entry must be a ``KernelSpec(...)`` whose name argument
+    matches its key; and the ``kernel`` metric namespace must be
+    registered so the tier's counters/spans pass SRT006."""
+    names_assign: Optional[ast.Assign] = None
+    declared: Optional[set] = None
+    reg_assign: Optional[ast.Assign] = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if node.targets[0].id == "KERNEL_NAMES":
+                names_assign = node
+                declared = _str_set_literal(node.value)
+            elif node.targets[0].id == "_REGISTRY":
+                reg_assign = node
+    if names_assign is None or reg_assign is None:
+        return []  # not the kernel-registry module
+    findings: List[Finding] = []
+
+    def emit(node, msg):
+        line = getattr(node, "lineno", 1)
+        if not pragmas.suppresses("SRT012", line):
+            findings.append(Finding(
+                "SRT012", relpath, line,
+                getattr(node, "col_offset", 0), msg,
+            ))
+
+    if declared is None:
+        emit(
+            names_assign,
+            "KERNEL_NAMES must be a pure string-literal frozenset — "
+            "the kernel-parity pass reads it statically",
+        )
+        return findings
+    if not isinstance(reg_assign.value, ast.Dict):
+        emit(
+            reg_assign,
+            "_REGISTRY must be a literal dict keyed by kernel-name "
+            "strings — the kernel-parity pass reads it statically",
+        )
+        return findings
+
+    registered: set = set()
+    for k, v in zip(reg_assign.value.keys, reg_assign.value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            emit(k or reg_assign,
+                 "_REGISTRY keys must be kernel-name string literals")
+            continue
+        registered.add(k.value)
+        # malformed-entry check: a KernelSpec(...) whose first/name
+        # argument is the key itself
+        spec_name = None
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
+                and v.func.id == "KernelSpec":
+            if v.args and isinstance(v.args[0], ast.Constant):
+                spec_name = v.args[0].value
+            for kw in v.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    spec_name = kw.value.value
+        else:
+            emit(v, f"_REGISTRY[{k.value!r}] is not a KernelSpec(...) "
+                    "literal")
+            continue
+        if spec_name != k.value:
+            emit(v, f"_REGISTRY[{k.value!r}] names its KernelSpec "
+                    f"{spec_name!r} — key and spec name must match")
+
+    for kn in sorted(registered - declared):
+        emit(names_assign,
+             f"_REGISTRY entry {kn!r} missing from KERNEL_NAMES")
+    for kn in sorted(declared - registered):
+        emit(names_assign,
+             f"KERNEL_NAMES entry {kn!r} has no _REGISTRY spec — "
+             "orphan name?")
+
+    # the metric namespace the tier's counters/spans live under
+    if "kernel" not in METRIC_NAMESPACES:
+        emit(
+            names_assign,
+            "the 'kernel' metric namespace is not registered in "
+            "tools/srt_check.py METRIC_NAMESPACES — kernel.launches/"
+            "declines/fallbacks would fail SRT006",
+        )
+
+    # the analyzer side: plancheck._KERNEL_RULES one directory up
+    pc_path = os.path.join(os.path.dirname(src_dir), "plancheck.py")
+    if not os.path.exists(pc_path):
+        emit(
+            names_assign,
+            "no plancheck.py above the kernel registry — every kernel "
+            "needs a static eligibility rule (_KERNEL_RULES)",
+        )
+        return findings
+    try:
+        with open(pc_path, "r", encoding="utf-8") as f:
+            pc_tree = ast.parse(f.read(), filename=pc_path)
+    except SyntaxError:
+        return findings  # plancheck.py's own scan reports the error
+    rules: Optional[set] = None
+    rules_line = 1
+    for node in pc_tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "_KERNEL_RULES" \
+                and isinstance(node.value, ast.Dict):
+            rules_line = node.lineno
+            rules = set()
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                    k.value, str
+                ):
+                    rules.add(k.value)
+    if rules is None:
+        emit(
+            names_assign,
+            "plancheck.py has no literal _KERNEL_RULES table — the "
+            "kernel-parity pass (and the static kernel tag) need one "
+            "rule per registered kernel",
+        )
+        return findings
+    for kn in sorted(declared - rules):
+        emit(
+            names_assign,
+            f"kernel {kn!r} has no plancheck eligibility rule "
+            f"(plancheck.py _KERNEL_RULES, line {rules_line}) — the "
+            "static report would never tag its ops",
+        )
+    for kn in sorted(rules - declared):
+        emit(
+            names_assign,
+            f"plancheck kernel rule {kn!r} has no registry spec — the "
+            "analyzer would tag ops no kernel accelerates",
+        )
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # SRT010: plan-stats store writes go through the CRC-framed helper
 # ---------------------------------------------------------------------------
@@ -1202,6 +1356,10 @@ def scan_file(path: str, repo_root: str = REPO_ROOT) -> List[Finding]:
     findings.extend(check_bench_tiers(relpath, tree, pragmas))
     findings.extend(check_stats_append(relpath, tree, pragmas))
     findings.extend(check_dispatch_parity(
+        relpath, tree, pragmas,
+        os.path.dirname(os.path.abspath(path)),
+    ))
+    findings.extend(check_kernel_parity(
         relpath, tree, pragmas,
         os.path.dirname(os.path.abspath(path)),
     ))
